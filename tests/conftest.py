@@ -1,0 +1,28 @@
+"""Test harness config: virtual 8-device CPU mesh + x64 for parity mode.
+
+The multi-chip story is tested without TPU hardware by forcing 8 host
+platform devices (SURVEY.md §4: this replaces the reference's absent fake
+backend layer). x64 is enabled so the Yuma-0 variant's float64 quantization
+divide (reference yumas.py:81) is honored; all framework arrays stay
+explicitly float32.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402,F401
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")

@@ -1,0 +1,69 @@
+"""Golden parity: the full total-dividends surface vs the CPU reference.
+
+The parity artifact (SURVEY.md §3.2) is the 14 cases x 9 versions x
+3 validators x 4 bond_penalty CSV set written by the reference's
+`total_dividends_sheet_generator`. `tests/golden/*_full.csv` pins those
+values at full float precision (generated from the reference in this
+container); every value must match to ~1e-6 — the 6-decimal CSV surface.
+"""
+
+import csv
+import os
+from dataclasses import replace
+
+import pytest
+
+from tests.conftest import GOLDEN_DIR
+from yuma_simulation_tpu.models.config import (
+    SimulationHyperparameters,
+    YumaParams,
+    YumaSimulationNames,
+)
+from yuma_simulation_tpu.reporting.tables import generate_total_dividends_table
+from yuma_simulation_tpu.scenarios import cases
+
+NAMES = YumaSimulationNames()
+TOL = 1.5e-6
+
+
+def canonical_versions():
+    base = YumaParams()
+    liquid = YumaParams(liquid_alpha=True)
+    y4 = YumaParams(bond_alpha=0.025, alpha_high=0.99, alpha_low=0.9)
+    y4l = replace(y4, liquid_alpha=True)
+    return [
+        (NAMES.YUMA_RUST, base),
+        (NAMES.YUMA, base),
+        (NAMES.YUMA_LIQUID, liquid),
+        (NAMES.YUMA2, base),
+        (NAMES.YUMA3, base),
+        (NAMES.YUMA31, base),
+        (NAMES.YUMA32, base),
+        (NAMES.YUMA4, y4),
+        (NAMES.YUMA4_LIQUID, y4l),
+    ]
+
+
+def load_golden(beta):
+    path = os.path.join(GOLDEN_DIR, f"total_dividends_b{beta}_full.csv")
+    with open(path) as f:
+        return list(csv.DictReader(f))
+
+
+@pytest.mark.parametrize("beta", [0, 0.5, 0.99, 1.0])
+def test_total_dividends_parity(beta):
+    golden = load_golden(beta)
+    hp = SimulationHyperparameters(bond_penalty=float(beta))
+    df = generate_total_dividends_table(cases, canonical_versions(), hp)
+
+    assert list(df["Case"]) == [row["Case"] for row in golden]
+    worst = (0.0, None)
+    for i, row in enumerate(golden):
+        for col, val in row.items():
+            if col == "Case":
+                continue
+            got = float(df[col][i])
+            diff = abs(got - float(val))
+            if diff > worst[0]:
+                worst = (diff, (row["Case"], col, float(val), got))
+    assert worst[0] < TOL, f"beta={beta}: worst mismatch {worst}"

@@ -1,0 +1,79 @@
+"""Generate golden parity artifacts by running the REFERENCE implementation.
+
+Outputs (committed, used by tests/):
+  tests/golden/total_dividends_b{beta}.csv  - full 14x9x3 total-dividend surface per beta
+  tests/golden/kernel_goldens.npz           - single-epoch kernel outputs on hand inputs
+"""
+import sys
+sys.path.insert(0, "/root/reference/src")
+
+import numpy as np
+import torch
+
+from yuma_simulation._internal.cases import cases
+from yuma_simulation._internal.simulation_utils import generate_total_dividends_table
+from yuma_simulation._internal.yumas import (
+    SimulationHyperparameters, YumaParams, YumaSimulationNames, YumaConfig,
+    Yuma, Yuma2, Yuma3, Yuma4, YumaRust,
+)
+from dataclasses import replace
+
+def versions():
+    base = YumaParams()
+    liquid = YumaParams(liquid_alpha=True)
+    y4 = YumaParams(bond_alpha=0.025, alpha_high=0.99, alpha_low=0.9)
+    y4l = replace(y4, liquid_alpha=True)
+    n = YumaSimulationNames()
+    return [
+        (n.YUMA_RUST, base), (n.YUMA, base), (n.YUMA_LIQUID, liquid),
+        (n.YUMA2, base), (n.YUMA3, base), (n.YUMA31, base), (n.YUMA32, base),
+        (n.YUMA4, y4), (n.YUMA4_LIQUID, y4l),
+    ]
+
+def main():
+    torch.manual_seed(0)
+    for beta in [0, 0.5, 0.99, 1.0]:
+        hp = SimulationHyperparameters(bond_penalty=beta)
+        df = generate_total_dividends_table(cases, versions(), hp)
+        df.to_csv(f"tests/golden/total_dividends_b{beta}.csv", index=False, float_format="%.6f")
+        # full precision copy for tight tolerance checks
+        df.to_csv(f"tests/golden/total_dividends_b{beta}_full.csv", index=False, float_format="%.17g")
+        print("done beta", beta, flush=True)
+
+    # single-epoch kernel goldens on hand inputs
+    rng = np.random.default_rng(42)
+    out = {}
+    W0 = torch.tensor([[1.0,0.0],[1.0,0.0],[1.0,0.0]])
+    W1 = torch.tensor([[0.0,1.0],[1.0,0.0],[1.0,0.0]])
+    Wr = torch.tensor(rng.random((4,5)), dtype=torch.float32)
+    Sr = torch.tensor([0.4,0.3,0.2,0.1], dtype=torch.float32)
+    S = torch.tensor([0.8,0.1,0.1])
+    Bprev = torch.tensor(rng.random((4,5)), dtype=torch.float32)
+    cfg = YumaConfig(simulation=SimulationHyperparameters(), yuma_params=YumaParams())
+    cfg_liq = YumaConfig(simulation=SimulationHyperparameters(), yuma_params=YumaParams(liquid_alpha=True))
+    cases_in = {
+        "h0": (W0, S, None, cfg), "h1": (W1, S, None, cfg),
+        "r_none": (Wr, Sr, None, cfg), "r_prev": (Wr, Sr, Bprev, cfg),
+        "r_liq": (Wr, Sr, Bprev, cfg_liq),
+    }
+    for tag, (W, St, B, c) in cases_in.items():
+        for kname, fn in [("rust", YumaRust), ("y1", Yuma), ("y3", Yuma3), ("y4", Yuma4)]:
+            res = fn(W.clone(), St.clone(), None if B is None else B.clone(), c)
+            for k, v in res.items():
+                if isinstance(v, torch.Tensor):
+                    out[f"{tag}/{kname}/{k}"] = v.detach().numpy()
+        res = Yuma2(W.clone(), None, St.clone(), None if B is None else B.clone(), c)
+        for k, v in res.items():
+            if isinstance(v, torch.Tensor):
+                out[f"{tag}/y2/{k}"] = v.detach().numpy()
+        W_prev = torch.tensor(rng.random(W.shape), dtype=torch.float32)
+        out[f"{tag}/y2p/__W_prev"] = W_prev.numpy()
+        res = Yuma2(W.clone(), W_prev, St.clone(), None if B is None else B.clone(), c)
+        for k, v in res.items():
+            if isinstance(v, torch.Tensor):
+                out[f"{tag}/y2p/{k}"] = v.detach().numpy()
+    np.savez("tests/golden/kernel_goldens.npz", **out)
+    print("kernel goldens:", len(out), "arrays")
+
+if __name__ == "__main__":
+    main()

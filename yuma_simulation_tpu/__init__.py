@@ -1,0 +1,30 @@
+"""yuma_simulation_tpu — a TPU-native (JAX/XLA/Pallas) framework for Yuma consensus simulation.
+
+A ground-up redesign of the capabilities of the reference `yuma-simulation`
+package (see /root/reference) for TPU hardware:
+
+- the per-epoch consensus kernel is a single jitted function
+  (:mod:`yuma_simulation_tpu.models.epoch`), with the per-miner
+  stake-weighted-median bisection vectorized over the whole weight matrix
+  (:mod:`yuma_simulation_tpu.ops.consensus`);
+- the epoch loop is a :func:`jax.lax.scan`
+  (:mod:`yuma_simulation_tpu.simulation.engine`);
+- scenario/hyperparameter sweeps are :func:`jax.vmap` batches
+  (:mod:`yuma_simulation_tpu.simulation.sweep`);
+- pod scale-out shards the scenario batch over an ICI mesh with
+  :func:`jax.shard_map` (:mod:`yuma_simulation_tpu.parallel`);
+- a Pallas TPU kernel fuses the consensus bisection into one VMEM-resident
+  pass (:mod:`yuma_simulation_tpu.ops.pallas_consensus`).
+
+Public, versioned API surface lives under :mod:`yuma_simulation_tpu.v1`
+(mirroring the reference's ApiVer contract, reference README.md:10-18).
+"""
+
+__version__ = "0.1.0"
+
+from yuma_simulation_tpu.models.config import (  # noqa: F401
+    SimulationHyperparameters,
+    YumaConfig,
+    YumaParams,
+    YumaSimulationNames,
+)

@@ -1,0 +1,19 @@
+"""The Yuma consensus model family: configs, the unified epoch kernel, variants."""
+
+from yuma_simulation_tpu.models.config import (  # noqa: F401
+    SimulationHyperparameters,
+    YumaConfig,
+    YumaParams,
+    YumaSimulationNames,
+)
+from yuma_simulation_tpu.models.epoch import BondsMode, yuma_epoch  # noqa: F401
+from yuma_simulation_tpu.models.variants import (  # noqa: F401
+    ResetMode,
+    VariantSpec,
+    Yuma,
+    Yuma2,
+    Yuma3,
+    Yuma4,
+    YumaRust,
+    variant_for_version,
+)

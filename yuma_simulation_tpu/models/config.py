@@ -1,0 +1,146 @@
+"""Hyperparameter configuration as JAX pytrees.
+
+The reference keeps two plain dataclasses flattened onto a combined
+`YumaConfig` via `setattr` (reference yumas.py:7-45). Here the same shape is
+kept but registered as a pytree with `jax.tree_util.register_dataclass`:
+
+- float fields are *data* (pytree leaves) so they can be traced, swept with
+  `vmap`, and donated — a `bond_alpha x kappa` grid is one batched config;
+- structural fields (`liquid_alpha`, `consensus_precision`, the quantile
+  overrides) are *metadata* (static), so each combination compiles its own
+  specialized XLA program with no runtime branching.
+
+Flattened attribute access (`config.kappa`, `config.bond_alpha`, ...) is
+provided with properties rather than `setattr`, keeping the dataclasses
+frozen/hashable-by-structure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+from jax import tree_util
+
+
+@tree_util.register_dataclass
+@dataclass(frozen=True)
+class SimulationHyperparameters:
+    """Global sweep-level knobs (reference yumas.py:7-14)."""
+
+    kappa: float = 0.5
+    bond_penalty: float = 1.0
+    total_epoch_emission: float = 100.0
+    validator_emission_ratio: float = 0.41
+    total_subnet_stake: float = 1_000_000.0
+    consensus_precision: int = dataclasses.field(
+        default=100_000, metadata=dict(static=True)
+    )
+
+
+@tree_util.register_dataclass
+@dataclass(frozen=True)
+class YumaParams:
+    """Per-version knobs (reference yumas.py:17-27)."""
+
+    bond_alpha: float = 0.1
+    alpha_high: float = 0.9
+    alpha_low: float = 0.7
+    decay_rate: float = 0.1
+    capacity_alpha: float = 0.1
+    liquid_alpha: bool = dataclasses.field(default=False, metadata=dict(static=True))
+    override_consensus_high: Optional[float] = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
+    override_consensus_low: Optional[float] = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
+
+
+@tree_util.register_dataclass
+@dataclass(frozen=True)
+class YumaConfig:
+    """Combined config with flattened read access (reference yumas.py:29-45)."""
+
+    simulation: SimulationHyperparameters = field(
+        default_factory=SimulationHyperparameters
+    )
+    yuma_params: YumaParams = field(default_factory=YumaParams)
+
+    # --- flattened simulation fields ---
+    @property
+    def kappa(self):
+        return self.simulation.kappa
+
+    @property
+    def bond_penalty(self):
+        return self.simulation.bond_penalty
+
+    @property
+    def total_epoch_emission(self):
+        return self.simulation.total_epoch_emission
+
+    @property
+    def validator_emission_ratio(self):
+        return self.simulation.validator_emission_ratio
+
+    @property
+    def total_subnet_stake(self):
+        return self.simulation.total_subnet_stake
+
+    @property
+    def consensus_precision(self):
+        return self.simulation.consensus_precision
+
+    # --- flattened yuma-params fields ---
+    @property
+    def bond_alpha(self):
+        return self.yuma_params.bond_alpha
+
+    @property
+    def liquid_alpha(self):
+        return self.yuma_params.liquid_alpha
+
+    @property
+    def alpha_high(self):
+        return self.yuma_params.alpha_high
+
+    @property
+    def alpha_low(self):
+        return self.yuma_params.alpha_low
+
+    @property
+    def decay_rate(self):
+        return self.yuma_params.decay_rate
+
+    @property
+    def capacity_alpha(self):
+        return self.yuma_params.capacity_alpha
+
+    @property
+    def override_consensus_high(self):
+        return self.yuma_params.override_consensus_high
+
+    @property
+    def override_consensus_low(self):
+        return self.yuma_params.override_consensus_low
+
+
+@dataclass(frozen=True)
+class YumaSimulationNames:
+    """Canonical display names of the 9 built-in versions (yumas.py:48-58).
+
+    These strings are the dispatch keys used throughout the public API, so
+    they match the reference byte-for-byte.
+    """
+
+    YUMA_RUST: str = "Yuma 0 (subtensor)"
+    YUMA: str = "Yuma 1 (paper)"
+    YUMA_LIQUID: str = "Yuma 1 (paper) - liquid alpha on"
+    YUMA2: str = "Yuma 2 (Adrian-Fish)"
+    YUMA3: str = "Yuma 3 (Rhef)"
+    YUMA31: str = "Yuma 3.1 (Rhef+reset)"
+    YUMA32: str = "Yuma 3.2 (Rhef+conditional)"
+    YUMA4: str = "Yuma 4 (Rhef+relative bonds)"
+    YUMA4_LIQUID: str = "Yuma 4 (Rhef+relative bonds) - liquid alpha on"

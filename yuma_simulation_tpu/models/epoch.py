@@ -1,0 +1,224 @@
+"""The unified Yuma epoch kernel: one jittable function, static variant switches.
+
+The reference implements five near-identical kernel functions (`YumaRust`,
+`Yuma`, `Yuma2`, `Yuma3`, `Yuma4`, reference yumas.py:61-606) that share
+~70% of their body. Here the shared pipeline —
+
+    row-normalize W -> normalize S -> prerank -> bisection consensus ->
+    u16 quantization -> clip -> rank / incentive / trust
+
+— is written once, and the five bonds models hang off a static
+:class:`BondsMode` switch, so each variant compiles to its own fully fused
+XLA program with zero runtime branching. The kernel is written for a single
+scenario (`W[V, M]`, `S[V]`); batching over scenarios and hyperparameters is
+done *outside* with `jax.vmap`, and pod scale-out with `shard_map`
+(see :mod:`yuma_simulation_tpu.simulation` / :mod:`yuma_simulation_tpu.parallel`).
+
+Parity-critical details reproduced from the reference (SURVEY.md §2.2):
+epsilon placement, u16 truncation, the float64 quantization divide in the
+Yuma-0 variant, strict bisection comparisons, `nan_to_num` placement, the
+first-epoch EMA special case, and Yuma 3's `2^64 - 1` capacity constant
+entering float32 arithmetic.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.ops.consensus import (
+    quantize_u16,
+    stake_weighted_median,
+    stake_weighted_median_sorted,
+)
+from yuma_simulation_tpu.ops.liquid import liquid_alpha_rate
+from yuma_simulation_tpu.ops.normalize import normalize_stake, normalize_weight_rows
+
+MAXINT = float(2**64 - 1)
+
+
+class BondsMode(enum.Enum):
+    """The five bonds models behind the nine named versions."""
+
+    EMA_RUST = "ema_rust"  # Yuma 0: col-norm bonds w/ eps, EMA re-normalized
+    EMA = "ema"  # Yuma 1: blended-weight bonds, plain EMA
+    EMA_PREV = "ema_prev"  # Yuma 2: clip & bond against previous weights
+    CAPACITY = "capacity"  # Yuma 3.x: stake-capacity bond purchases
+    RELATIVE = "relative"  # Yuma 4: per-(validator, miner) bonds in [0, 1]
+
+
+_EMA_MODES = (BondsMode.EMA_RUST, BondsMode.EMA, BondsMode.EMA_PREV)
+
+
+def _rate_vm(rate, like: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast a scalar or per-miner `[M]` EMA rate against `[V, M]`."""
+    rate = jnp.asarray(rate, like.dtype)
+    return rate  # 0-dim and [M] both broadcast correctly against [V, M]
+
+
+def yuma_epoch(
+    W: jnp.ndarray,
+    S: jnp.ndarray,
+    B_old: Optional[jnp.ndarray] = None,
+    config: Optional[YumaConfig] = None,
+    *,
+    bonds_mode: BondsMode = BondsMode.EMA,
+    W_prev: Optional[jnp.ndarray] = None,
+    first_epoch=None,
+    miner_mask: Optional[jnp.ndarray] = None,
+    consensus_impl: str = "bisect",
+    precision_config: Optional[lax.Precision] = lax.Precision.HIGHEST,
+) -> dict:
+    """One consensus epoch. Returns the reference's named-output dict.
+
+    Args:
+      W: raw validator->miner weights `[V, M]`.
+      S: raw stake `[V]`.
+      B_old: carried bond state `[V, M]`, or None on the first epoch.
+      config: hyperparameters (a traced pytree; `liquid_alpha` and the
+        quantile overrides are static).
+      bonds_mode: static variant switch.
+      W_prev: previous epoch's *normalized* weights (EMA_PREV only). None
+        means "use this epoch's weights" (the reference's first-epoch
+        fallback, yumas.py:299-300).
+      first_epoch: for in-scan use where `B_old` is always an array —
+        a traced bool selecting the fresh-bond branch of the EMA modes.
+        None (default) derives it statically from `B_old is None`.
+      miner_mask: optional `[M]` 0/1 mask for padded miner columns in
+        heterogeneous `vmap` batches.
+      consensus_impl: "bisect" (default; iteration-exact with the
+        reference) or "sorted" (closed-form sort-based fast path).
+      precision_config: matmul precision for the stake contractions.
+    """
+    config = config if config is not None else YumaConfig()
+    dtype = W.dtype
+
+    W_n = normalize_weight_rows(W)
+    S_n = normalize_stake(jnp.asarray(S, dtype))
+
+    # Prerank (stake-weighted column sums of un-clipped weights).
+    P = jnp.einsum("v,vm->m", S_n, W_n, precision=precision_config)
+
+    # Consensus + u16 quantization. Yuma 0 performs the normalizing divide
+    # in float64 (reference yumas.py:81,97); honored when x64 is enabled,
+    # otherwise it degrades to float32 (bench/fast mode).
+    if consensus_impl == "sorted":
+        C_raw = stake_weighted_median_sorted(
+            W_n, S_n, config.kappa, config.consensus_precision
+        )
+    else:
+        C_raw = stake_weighted_median(
+            W_n,
+            S_n,
+            config.kappa,
+            config.consensus_precision,
+            precision_config=precision_config,
+        )
+    rust64 = bonds_mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64
+    C = quantize_u16(
+        C_raw,
+        sum_dtype=jnp.float64 if rust64 else None,
+        out_dtype=dtype,
+        miner_mask=miner_mask,
+    )
+
+    # Clip, rank, incentive, trust.
+    clip_base = W_n if bonds_mode is not BondsMode.EMA_PREV else (
+        W_n if W_prev is None else W_prev
+    )
+    W_clipped = jnp.minimum(clip_base, C)
+    R = jnp.einsum("v,vm->m", S_n, W_clipped, precision=precision_config)
+    incentive = jnp.nan_to_num(R / R.sum())
+    T = jnp.nan_to_num(R / P)
+    T_v = W_clipped.sum(axis=-1) / W_n.sum(axis=-1)
+
+    out = {
+        "weight": W_n,
+        "stake": S_n,
+        "server_prerank": P,
+        "server_consensus_weight": C,
+        "consensus_clipped_weight": W_clipped,
+        "server_rank": R,
+        "server_incentive": incentive,
+    }
+
+    # Liquid-alpha EMA rate (EMA families and RELATIVE; yumas.py:118-140 etc.).
+    nan = jnp.asarray(jnp.nan, dtype)
+    a = b = nan
+    bond_alpha = jnp.asarray(config.bond_alpha, dtype)
+    if config.liquid_alpha and bonds_mode is not BondsMode.CAPACITY:
+        bond_alpha, a, b = liquid_alpha_rate(
+            C,
+            config.alpha_low,
+            config.alpha_high,
+            override_consensus_high=config.override_consensus_high,
+            override_consensus_low=config.override_consensus_low,
+        )
+
+    if bonds_mode in _EMA_MODES:
+        if bonds_mode is BondsMode.EMA_RUST:
+            B = S_n[:, None] * W_clipped
+            B = B / (B.sum(axis=0) + 1e-6)
+            B = jnp.nan_to_num(B)
+        else:
+            beta = jnp.asarray(config.bond_penalty, dtype)
+            bond_base = W_n if bonds_mode is BondsMode.EMA else clip_base
+            W_b = (1.0 - beta) * bond_base + beta * W_clipped
+            B = S_n[:, None] * W_b
+            B = B / B.sum(axis=0)  # no epsilon here (yumas.py:228,342)
+            B = jnp.nan_to_num(B)
+            out["weight_for_bond"] = W_b
+
+        rate = _rate_vm(bond_alpha, B)
+        if B_old is None:
+            B_ema = B
+        else:
+            ema = rate * B + (1.0 - rate) * B_old
+            B_ema = ema if first_epoch is None else jnp.where(first_epoch, B, ema)
+        if bonds_mode is BondsMode.EMA_RUST:
+            B_ema = jnp.nan_to_num(B_ema / (B_ema.sum(axis=0) + 1e-6))
+
+        D = (B_ema * incentive).sum(axis=-1)
+        out.update(
+            server_trust=T,
+            validator_trust=T_v,
+            validator_bond=B,
+            validator_ema_bond=B_ema,
+            bond_alpha=bond_alpha,
+            alpha_a=a,
+            alpha_b=b,
+        )
+
+    elif bonds_mode is BondsMode.CAPACITY:
+        B_prev = jnp.zeros_like(W_n) if B_old is None else B_old
+        capacity = S_n * jnp.asarray(MAXINT, dtype)
+        capacity_per_bond = S_n[:, None] * jnp.asarray(MAXINT, dtype)
+        remaining = jnp.clip(capacity_per_bond - B_prev, min=0.0)
+        cap_alpha = (jnp.asarray(config.capacity_alpha, dtype) * capacity)[:, None]
+        purchase = jnp.minimum(cap_alpha, remaining) * W_n
+        B = (1.0 - jnp.asarray(config.decay_rate, dtype)) * B_prev + purchase
+        B = jnp.minimum(B, capacity_per_bond)
+        D = (B * incentive).sum(axis=-1)
+        out.update(server_trust=T, validator_trust=T_v, validator_bonds=B)
+
+    elif bonds_mode is BondsMode.RELATIVE:
+        B_prev = jnp.zeros_like(W_n) if B_old is None else B_old
+        rate = _rate_vm(bond_alpha, W_n)
+        B_decayed = B_prev * (1.0 - rate)
+        remaining = jnp.clip(1.0 - B_decayed, min=0.0)
+        purchase = jnp.minimum(rate * W_n, remaining)
+        B = jnp.clip(B_decayed + purchase, max=1.0)
+        D = S_n * (B * incentive).sum(axis=-1)
+        out["validator_bonds"] = B
+
+    else:  # pragma: no cover
+        raise ValueError(f"unknown bonds mode: {bonds_mode}")
+
+    out["validator_reward"] = D
+    out["validator_reward_normalized"] = D / (D.sum() + 1e-6)
+    return out

@@ -1,0 +1,131 @@
+"""The nine named Yuma versions: dispatch specs + reference-style wrappers.
+
+`run_simulation` in the reference dispatches on the version *display string*
+(reference simulation_utils.py:52-93), carrying a variant-specific bond
+state and reset rule. :class:`VariantSpec` captures that dispatch table as
+static data consumed by the scan engine; the module also exposes
+`YumaRust` / `Yuma` / `Yuma2` / `Yuma3` / `Yuma4` functions with the
+reference call signatures (yumas.py:61,175,285,399,494) for users porting
+notebook code one function at a time.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.models.config import YumaConfig, YumaSimulationNames
+from yuma_simulation_tpu.models.epoch import BondsMode, yuma_epoch
+
+
+class ResetMode(enum.Enum):
+    """Bond-reset injection rule (reference simulation_utils.py:62-88)."""
+
+    NONE = "none"
+    ALWAYS = "always"  # Yuma 3.1: reset at the case's reset epoch
+    CONDITIONAL = "conditional"  # Yuma 3.2 / 4: only if the miner's previous
+    # epoch consensus weight was exactly zero
+
+
+@dataclass(frozen=True)
+class VariantSpec:
+    """Static description of one named version for the scan engine."""
+
+    name: str
+    bonds_mode: BondsMode
+    reset_mode: ResetMode = ResetMode.NONE
+    # Which kernel output is carried as the bond state across epochs.
+    bond_state_key: str = "validator_ema_bond"
+    # Whether the normalized weights are carried for next epoch's clipping.
+    carries_prev_weights: bool = False
+
+
+_NAMES = YumaSimulationNames()
+
+YUMA_VERSIONS: dict[str, VariantSpec] = {
+    _NAMES.YUMA_RUST: VariantSpec(_NAMES.YUMA_RUST, BondsMode.EMA_RUST),
+    _NAMES.YUMA: VariantSpec(_NAMES.YUMA, BondsMode.EMA),
+    _NAMES.YUMA_LIQUID: VariantSpec(_NAMES.YUMA_LIQUID, BondsMode.EMA),
+    _NAMES.YUMA2: VariantSpec(
+        _NAMES.YUMA2, BondsMode.EMA_PREV, carries_prev_weights=True
+    ),
+    _NAMES.YUMA3: VariantSpec(
+        _NAMES.YUMA3, BondsMode.CAPACITY, bond_state_key="validator_bonds"
+    ),
+    _NAMES.YUMA31: VariantSpec(
+        _NAMES.YUMA31,
+        BondsMode.CAPACITY,
+        ResetMode.ALWAYS,
+        bond_state_key="validator_bonds",
+    ),
+    _NAMES.YUMA32: VariantSpec(
+        _NAMES.YUMA32,
+        BondsMode.CAPACITY,
+        ResetMode.CONDITIONAL,
+        bond_state_key="validator_bonds",
+    ),
+    _NAMES.YUMA4: VariantSpec(
+        _NAMES.YUMA4,
+        BondsMode.RELATIVE,
+        ResetMode.CONDITIONAL,
+        bond_state_key="validator_bonds",
+    ),
+    _NAMES.YUMA4_LIQUID: VariantSpec(
+        _NAMES.YUMA4_LIQUID,
+        BondsMode.RELATIVE,
+        ResetMode.CONDITIONAL,
+        bond_state_key="validator_bonds",
+    ),
+}
+
+
+def variant_for_version(yuma_version: str) -> VariantSpec:
+    """Resolve a display-string version name to its static spec."""
+    try:
+        return YUMA_VERSIONS[yuma_version]
+    except KeyError:
+        raise ValueError("Invalid Yuma function.") from None
+
+
+# --- Reference-signature wrappers (drop-in for yumas.py kernels) ---
+
+
+def YumaRust(W, S, B_old=None, config: Optional[YumaConfig] = None) -> dict:
+    """Yuma 0 (subtensor) epoch — reference yumas.py:61-172."""
+    return yuma_epoch(
+        jnp.asarray(W), S, B_old, config, bonds_mode=BondsMode.EMA_RUST
+    )
+
+
+def Yuma(W, S, B_old=None, config: Optional[YumaConfig] = None) -> dict:
+    """Yuma 1 (paper) epoch — reference yumas.py:175-282."""
+    return yuma_epoch(jnp.asarray(W), S, B_old, config, bonds_mode=BondsMode.EMA)
+
+
+def Yuma2(W, W_prev, S, B_old=None, config: Optional[YumaConfig] = None) -> dict:
+    """Yuma 2 (Adrian-Fish) epoch — reference yumas.py:285-396."""
+    return yuma_epoch(
+        jnp.asarray(W),
+        S,
+        B_old,
+        config,
+        bonds_mode=BondsMode.EMA_PREV,
+        W_prev=None if W_prev is None else jnp.asarray(W_prev),
+    )
+
+
+def Yuma3(W, S, B_old=None, config: Optional[YumaConfig] = None) -> dict:
+    """Yuma 3 (Rhef) epoch — reference yumas.py:399-491."""
+    return yuma_epoch(
+        jnp.asarray(W), S, B_old, config, bonds_mode=BondsMode.CAPACITY
+    )
+
+
+def Yuma4(W, S, B_old=None, config: Optional[YumaConfig] = None) -> dict:
+    """Yuma 4 (relative bonds) epoch — reference yumas.py:494-606."""
+    return yuma_epoch(
+        jnp.asarray(W), S, B_old, config, bonds_mode=BondsMode.RELATIVE
+    )

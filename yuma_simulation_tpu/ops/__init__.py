@@ -1,0 +1,11 @@
+"""Numerical building blocks of the Yuma epoch kernel (pure jittable functions)."""
+
+from yuma_simulation_tpu.ops.consensus import (  # noqa: F401
+    quantize_u16,
+    stake_weighted_median,
+)
+from yuma_simulation_tpu.ops.liquid import liquid_alpha_rate  # noqa: F401
+from yuma_simulation_tpu.ops.normalize import (  # noqa: F401
+    normalize_stake,
+    normalize_weight_rows,
+)

@@ -1,0 +1,88 @@
+"""Liquid-alpha: consensus-dependent per-miner bond EMA rates.
+
+Mirrors the liquid-alpha block duplicated through the reference kernels
+(yumas.py:118-140, 231-253, 345-367, 546-568): fit a logistic between the
+0.25/0.75 consensus quantiles (with overrides and a degenerate-quantile
+fallback to the 0.99 quantile) and map each miner's consensus weight to an
+EMA rate `bond_alpha in [1-alpha_high, 1-alpha_low]`.
+
+Parity notes:
+- `a`/`b` combine float64 Python `math.log` scalars with the float32
+  quantile tensors, so they materialize as float32 — reproduced here by
+  computing the logs in Python when the bounds are static floats;
+- the logistic is evaluated as `e ** (-a*C + b)` (a power with base
+  `math.e`), not `exp`, matching the reference's rounding behavior;
+- the degenerate-quantile check is a data-dependent branch in the
+  reference; under `jit` it becomes a `jnp.where` on identically computed
+  quantities.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def _logit(x) -> float:
+    # log(1/x - 1), the inverse sigmoid, on a static Python float.
+    return math.log(1.0 / x - 1.0)
+
+
+def liquid_alpha_rate(
+    C: jnp.ndarray,
+    alpha_low,
+    alpha_high,
+    *,
+    override_consensus_high: Optional[float] = None,
+    override_consensus_low: Optional[float] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-miner EMA rate from quantized consensus.
+
+    Args:
+      C: quantized consensus weights `[..., M]`.
+      alpha_low / alpha_high: sigmoid clamp bounds (static floats in the
+        reference; traced scalars are also supported for sweeps).
+      override_consensus_high / low: optional static quantile overrides.
+
+    Returns:
+      `(bond_alpha[..., M], a, b)` where `a`, `b` are the fitted logistic
+      coefficients (scalars, or `[...]` when batched).
+    """
+    dtype = C.dtype
+
+    if override_consensus_high is not None:
+        c_high = jnp.asarray(override_consensus_high, dtype)
+    else:
+        c_high = jnp.quantile(C, 0.75, axis=-1)
+    if override_consensus_low is not None:
+        c_low = jnp.asarray(override_consensus_low, dtype)
+    else:
+        c_low = jnp.quantile(C, 0.25, axis=-1)
+
+    if override_consensus_high is None:
+        # Degenerate spread: fall back to the 0.99 quantile (yumas.py:132-133).
+        c_high = jnp.where(
+            c_high == c_low, jnp.quantile(C, 0.99, axis=-1), c_high
+        )
+
+    if isinstance(alpha_high, (int, float)) and isinstance(alpha_low, (int, float)):
+        logit_high = _logit(alpha_high)
+        logit_low = _logit(alpha_low)
+    else:
+        alpha_high = jnp.asarray(alpha_high, dtype)
+        alpha_low = jnp.asarray(alpha_low, dtype)
+        logit_high = jnp.log(1.0 / alpha_high - 1.0)
+        logit_low = jnp.log(1.0 / alpha_low - 1.0)
+
+    a = (logit_high - logit_low) / (c_low - c_high)
+    b = logit_low + a * c_low
+    if a.ndim:  # batched quantiles broadcast against [..., M]
+        a_b = a[..., None]
+        b_b = b[..., None]
+    else:
+        a_b, b_b = a, b
+    alpha = 1.0 / (1.0 + jnp.asarray(math.e, dtype) ** (-a_b * C + b_b))
+    bond_alpha = 1.0 - jnp.clip(alpha, alpha_low, alpha_high)
+    return bond_alpha.astype(dtype), a, b

@@ -1,0 +1,31 @@
+"""Row/column normalizations with the reference's exact epsilon conventions.
+
+Parity notes (reference = /root/reference/src/yuma_simulation/_internal/yumas.py):
+- weight rows are normalized with a `+1e-6` denominator guard (yumas.py:72,186,297,411,505);
+- stake is normalized with a bare sum, no epsilon (yumas.py:75,189,303,414,508).
+
+All functions broadcast over arbitrary leading batch dimensions, so the same
+code path serves the single-scenario kernel, `vmap` sweeps, and `shard_map`
+shards.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+WEIGHT_EPS = 1e-6
+
+
+def normalize_weight_rows(W: jnp.ndarray, eps: float = WEIGHT_EPS) -> jnp.ndarray:
+    """Normalize each validator's weight row to (approximately) sum to 1.
+
+    `W` has shape `[..., V, M]`; rows that sum to zero map to zero rows
+    (the epsilon keeps the division finite), which is what makes padded
+    validators safe in batched sweeps.
+    """
+    return W / (W.sum(axis=-1, keepdims=True) + eps)
+
+
+def normalize_stake(S: jnp.ndarray) -> jnp.ndarray:
+    """Normalize the stake vector `[..., V]` to sum to 1 (no epsilon)."""
+    return S / S.sum(axis=-1, keepdims=True)

@@ -1,0 +1,14 @@
+"""Host-side reporting: dividend tables, matplotlib charts, HTML assembly."""
+
+from yuma_simulation_tpu.reporting.charts import (  # noqa: F401
+    plot_bonds,
+    plot_dividends,
+    plot_incentives,
+    plot_validator_server_weights,
+)
+from yuma_simulation_tpu.reporting.tables import (  # noqa: F401
+    calculate_total_dividends,
+    generate_draggable_html_table,
+    generate_ipynb_table,
+    generate_total_dividends_table,
+)

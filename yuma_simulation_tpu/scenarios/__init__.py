@@ -1,0 +1,19 @@
+"""Scenarios as data: dense per-epoch weight/stake arrays + a case registry."""
+
+from yuma_simulation_tpu.scenarios.base import (  # noqa: F401
+    BaseCase,
+    Scenario,
+    class_registry,
+    create_case,
+    get_cases,
+    register_case,
+)
+from yuma_simulation_tpu.scenarios import builtin as _builtin  # noqa: F401
+from yuma_simulation_tpu.scenarios.synthetic import (  # noqa: F401
+    random_subnet_scenario,
+    weight_perturbation_batch,
+)
+
+#: Instantiated default suite, in registration order (mirrors reference
+#: cases.py:601's module-level `cases` list).
+cases = get_cases()

@@ -1,0 +1,131 @@
+"""Scenario spec + registry.
+
+The reference expresses each scenario as a dataclass whose
+`weights_epochs` / `stakes_epochs` *properties* rebuild a list of per-epoch
+tensors on every access (reference cases.py:16-48, an O(E^2) pathology in
+the epoch loop). Here a scenario is plain data: dense arrays
+`weights[E, V, M]` / `stakes[E, V]` built exactly once, which is also what
+`lax.scan` wants as its stacked inputs and what `vmap` wants for batched
+suites. The registry (`register_case` / `create_case` / `class_registry`)
+mirrors the reference's string-keyed factory API (cases.py:6-48).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+# name -> builder callable (mirrors reference cases.py:6)
+class_registry: dict[str, Callable[..., "Scenario"]] = {}
+
+
+def register_case(name: str):
+    """Register a scenario builder under a case name (cases.py:9-14)."""
+
+    def decorator(builder):
+        class_registry[name] = builder
+        return builder
+
+    return decorator
+
+
+def create_case(case_name: str, **kwargs) -> "Scenario":
+    """Instantiate a registered case by name (cases.py:44-48)."""
+    if case_name not in class_registry:
+        raise ValueError(f"Case '{case_name}' is not registered.")
+    return class_registry[case_name](**kwargs)
+
+
+def get_cases() -> list["Scenario"]:
+    """All registered cases, in registration order (cases.py:601)."""
+    return [builder() for builder in class_registry.values()]
+
+
+@dataclass
+class Scenario:
+    """A fully materialized scenario: dense arrays + display metadata."""
+
+    name: str
+    validators: list[str]
+    base_validator: str
+    weights: np.ndarray  # [E, V, M] float32
+    stakes: np.ndarray  # [E, V] float32
+    num_epochs: int = 40
+    reset_bonds: bool = False
+    reset_bonds_index: Optional[int] = None
+    reset_bonds_epoch: Optional[int] = None
+    servers: list[str] = field(default_factory=lambda: ["Server 1", "Server 2"])
+
+    def __post_init__(self):
+        if self.base_validator not in self.validators:
+            raise ValueError(
+                f"base_validator '{self.base_validator}' must be in validators list."
+            )
+        self.weights = np.asarray(self.weights, np.float32)
+        self.stakes = np.asarray(self.stakes, np.float32)
+        E, V, M = self.weights.shape
+        if E != self.num_epochs or self.stakes.shape != (E, V):
+            raise ValueError(
+                f"inconsistent scenario arrays: weights {self.weights.shape}, "
+                f"stakes {self.stakes.shape}, num_epochs {self.num_epochs}"
+            )
+
+    @property
+    def num_validators(self) -> int:
+        return self.weights.shape[1]
+
+    @property
+    def num_miners(self) -> int:
+        return self.weights.shape[2]
+
+    # --- reference-compatible list-of-tensors views (cases.py:27-35) ---
+    @property
+    def weights_epochs(self) -> list[np.ndarray]:
+        return list(self.weights)
+
+    @property
+    def stakes_epochs(self) -> list[np.ndarray]:
+        return list(self.stakes)
+
+
+#: Back-compat alias: the reference's scenario base class name.
+BaseCase = Scenario
+
+
+def assignment_weights(
+    num_epochs: int,
+    num_validators: int,
+    num_miners: int,
+    schedule: list[tuple[range, list[int]]],
+) -> np.ndarray:
+    """Build `[E, V, M]` one-hot weights from (epoch-range -> server index
+    per validator) rules; later rules win on overlap."""
+    W = np.zeros((num_epochs, num_validators, num_miners), np.float32)
+    for epochs, servers in schedule:
+        for e in epochs:
+            if 0 <= e < num_epochs:
+                W[e] = 0.0
+                for v, m in enumerate(servers):
+                    W[e, v, m] = 1.0
+    return W
+
+
+def row_weights(
+    num_epochs: int,
+    schedule: list[tuple[range, list[list[float]]]],
+) -> np.ndarray:
+    """Build `[E, V, M]` weights from explicit per-epoch row matrices."""
+    first = np.asarray(schedule[0][1], np.float32)
+    W = np.zeros((num_epochs,) + first.shape, np.float32)
+    for epochs, rows in schedule:
+        mat = np.asarray(rows, np.float32)
+        for e in epochs:
+            if 0 <= e < num_epochs:
+                W[e] = mat
+    return W
+
+
+def constant_stakes(num_epochs: int, stakes: list[float]) -> np.ndarray:
+    return np.tile(np.asarray(stakes, np.float32), (num_epochs, 1))

@@ -1,0 +1,57 @@
+"""Synthetic scenario generators: random subnets & Monte-Carlo perturbations.
+
+The reference has no synthetic scenarios (its 14 cases are hand-written);
+these generators feed the sweep/Monte-Carlo configurations in BASELINE.json
+(8192 randomized weight-perturbation scenarios sharded over a pod). Weight
+batches are generated with `jax.random` so they can be produced directly on
+device inside a sharded computation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from yuma_simulation_tpu.scenarios.base import Scenario
+
+
+def random_subnet_scenario(
+    seed: int,
+    num_validators: int = 16,
+    num_miners: int = 256,
+    num_epochs: int = 40,
+    stake_concentration: float = 1.0,
+    name: Optional[str] = None,
+) -> Scenario:
+    """A random subnet: Dirichlet-ish stakes, uniform random weight rows."""
+    rng = np.random.default_rng(seed)
+    stakes = rng.gamma(stake_concentration, size=num_validators).astype(np.float32)
+    stakes /= stakes.sum()
+    W = rng.random((num_epochs, num_validators, num_miners), dtype=np.float32)
+    validators = [f"vali {i} ({stakes[i]:.3f})" for i in range(num_validators)]
+    return Scenario(
+        name=name or f"random subnet (seed={seed})",
+        validators=validators,
+        base_validator=validators[0],
+        weights=W,
+        stakes=np.tile(stakes, (num_epochs, 1)),
+        num_epochs=num_epochs,
+        servers=[f"Server {i + 1}" for i in range(num_miners)],
+    )
+
+
+def weight_perturbation_batch(
+    key: jax.Array,
+    base_weights: jnp.ndarray,
+    num_scenarios: int,
+    sigma: float = 0.05,
+) -> jnp.ndarray:
+    """`[B, V, M]` multiplicative log-normal perturbations of one weight
+    matrix — the Monte-Carlo workload, generated on device."""
+    noise = jax.random.normal(
+        key, (num_scenarios,) + base_weights.shape, base_weights.dtype
+    )
+    return base_weights * jnp.exp(sigma * noise)

@@ -1,0 +1,13 @@
+"""Simulation engine: scan over epochs, vmap over scenarios/hyperparameters."""
+
+from yuma_simulation_tpu.simulation.engine import (  # noqa: F401
+    SimulationResult,
+    run_simulation,
+    simulate,
+    simulate_constant,
+)
+from yuma_simulation_tpu.simulation.sweep import (  # noqa: F401
+    config_grid,
+    simulate_batch,
+    sweep_hyperparams,
+)

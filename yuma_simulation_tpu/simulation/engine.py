@@ -1,0 +1,284 @@
+"""The epoch loop as `lax.scan` + the reference-compatible driver API.
+
+The reference's `run_simulation` (reference simulation_utils.py:26-112) is a
+Python `for` over epochs carrying `(B_state, W_prev, server_consensus_weight)`
+with per-epoch `.item()` host transfers. Here the whole loop — variant
+dispatch, bond-reset injection, the kernel, and the dividend-per-1000-tao
+conversion (simulation_utils.py:45-49, 95-107) — is one jitted
+`lax.scan`: carry = `(B, W_prev, C_prev)`, xs = the scenario's stacked
+`(W[E,V,M], S[E,V], epoch_index)`. A single device round-trip returns every
+per-epoch output at once.
+
+`simulate_constant` is the throughput path: weights constant across epochs
+are closed over (no `[E, V, M]` HBM blow-up at 10k+ epochs) and total
+dividends accumulate inside the carry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.epoch import BondsMode, yuma_epoch
+from yuma_simulation_tpu.models.variants import (
+    ResetMode,
+    VariantSpec,
+    variant_for_version,
+)
+from yuma_simulation_tpu.ops.normalize import normalize_weight_rows
+from yuma_simulation_tpu.scenarios.base import Scenario
+
+
+@dataclass
+class SimulationResult:
+    """Host-side view of one simulated scenario."""
+
+    dividends: np.ndarray  # [E, V] dividend per 1000 tao per epoch
+    bonds: Optional[np.ndarray]  # [E, V, M] post-epoch bond state
+    incentives: Optional[np.ndarray]  # [E, M] server incentive
+    consensus: Optional[np.ndarray]  # [E, M] quantized consensus
+
+
+def _apply_reset(B, C_prev, epoch, reset_index, reset_epoch, reset_mode, M):
+    """Zero the reset miner's bond column when the variant's rule fires
+    (reference simulation_utils.py:62-88). `reset_epoch < 0` disables.
+
+    The reference can only reset from epoch 1 onward (`B_state`/
+    `server_consensus_weight` are still None at epoch 0), hence the
+    `epoch > 0` gate.
+    """
+    do = (epoch == reset_epoch) & (epoch > 0) & (reset_index >= 0)
+    if reset_mode is ResetMode.CONDITIONAL:
+        prev_c = jnp.take(C_prev, jnp.clip(reset_index, 0, M - 1))
+        do = do & (prev_c == 0.0)
+    col = (jnp.arange(M) == reset_index) & do
+    return jnp.where(col[None, :], jnp.zeros_like(B), B)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "spec",
+        "save_bonds",
+        "save_incentives",
+        "save_consensus",
+        "consensus_impl",
+    ),
+)
+def _simulate_scan(
+    weights: jnp.ndarray,  # [E, V, M]
+    stakes: jnp.ndarray,  # [E, V]
+    reset_index: jnp.ndarray,  # int32 scalar, -1 = none
+    reset_epoch: jnp.ndarray,  # int32 scalar, -1 = none
+    config: YumaConfig,
+    spec: VariantSpec,
+    save_bonds: bool = True,
+    save_incentives: bool = True,
+    save_consensus: bool = False,
+    consensus_impl: str = "bisect",
+):
+    E, V, M = weights.shape
+    dtype = weights.dtype
+
+    def step(carry, xs):
+        B, W_prev, C_prev = carry
+        W, S, epoch = xs
+        first = epoch == 0
+
+        if spec.reset_mode is not ResetMode.NONE:
+            B = _apply_reset(
+                B, C_prev, epoch, reset_index, reset_epoch, spec.reset_mode, M
+            )
+
+        kernel_prev = None
+        if spec.bonds_mode is BondsMode.EMA_PREV:
+            # Epoch 0 falls back to this epoch's normalized weights
+            # (reference yumas.py:299-300).
+            kernel_prev = jnp.where(
+                first, normalize_weight_rows(W.astype(dtype)), W_prev
+            )
+
+        res = yuma_epoch(
+            W,
+            S,
+            B,
+            config,
+            bonds_mode=spec.bonds_mode,
+            W_prev=kernel_prev,
+            first_epoch=first,
+            consensus_impl=consensus_impl,
+        )
+
+        B_next = res[spec.bond_state_key]
+        W_prev_next = res["weight"] if spec.carries_prev_weights else W_prev
+        C_next = res["server_consensus_weight"]
+
+        # Dividend per 1000 tao (reference simulation_utils.py:45-49, 95-107);
+        # note the conversion uses the *raw* case stakes, not the normalized
+        # kernel stakes.
+        stakes_units = (
+            jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
+        )
+        emission = (
+            config.validator_emission_ratio
+            * res["validator_reward_normalized"]
+            * config.total_epoch_emission
+        )
+        dividends = jnp.where(
+            stakes_units > 1e-6, emission / stakes_units, 0.0
+        )
+
+        ys = {"dividends": dividends}
+        if save_bonds:
+            ys["bonds"] = B_next
+        if save_incentives:
+            ys["incentives"] = res["server_incentive"]
+        if save_consensus:
+            ys["consensus"] = C_next
+        return (B_next, W_prev_next, C_next), ys
+
+    carry0 = (
+        jnp.zeros((V, M), dtype),
+        jnp.zeros((V, M), dtype),
+        jnp.zeros((M,), dtype),
+    )
+    xs = (weights, stakes, jnp.arange(E, dtype=jnp.int32))
+    _, ys = lax.scan(step, carry0, xs)
+    return ys
+
+
+def simulate(
+    scenario: Scenario,
+    yuma_version: str,
+    config: Optional[YumaConfig] = None,
+    *,
+    save_bonds: bool = True,
+    save_incentives: bool = True,
+    save_consensus: bool = False,
+    consensus_impl: str = "bisect",
+    dtype=jnp.float32,
+) -> SimulationResult:
+    """Simulate one scenario under one named version; returns host arrays."""
+    config = config if config is not None else YumaConfig()
+    spec = variant_for_version(yuma_version)
+    ys = _simulate_scan(
+        jnp.asarray(scenario.weights, dtype),
+        jnp.asarray(scenario.stakes, dtype),
+        jnp.asarray(
+            -1 if scenario.reset_bonds_index is None else scenario.reset_bonds_index,
+            jnp.int32,
+        ),
+        jnp.asarray(
+            -1 if scenario.reset_bonds_epoch is None else scenario.reset_bonds_epoch,
+            jnp.int32,
+        ),
+        config,
+        spec,
+        save_bonds=save_bonds,
+        save_incentives=save_incentives,
+        save_consensus=save_consensus,
+        consensus_impl=consensus_impl,
+    )
+    ys = jax.device_get(ys)
+    return SimulationResult(
+        dividends=ys["dividends"],
+        bonds=ys.get("bonds"),
+        incentives=ys.get("incentives"),
+        consensus=ys.get("consensus"),
+    )
+
+
+def run_simulation(
+    case: Scenario,
+    yuma_version: str,
+    yuma_config: Optional[YumaConfig] = None,
+) -> tuple[dict[str, list[float]], list[np.ndarray], list[np.ndarray]]:
+    """Drop-in equivalent of the reference driver
+    (simulation_utils.py:26-112): returns `(dividends_per_validator,
+    bonds_per_epoch, server_incentives_per_epoch)` with numpy arrays in
+    place of torch tensors.
+    """
+    result = simulate(case, yuma_version, yuma_config)
+    dividends_per_validator = {
+        validator: [float(x) for x in result.dividends[:, i]]
+        for i, validator in enumerate(case.validators)
+    }
+    bonds_per_epoch = list(result.bonds)
+    server_incentives_per_epoch = list(result.incentives)
+    return dividends_per_validator, bonds_per_epoch, server_incentives_per_epoch
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_epochs", "spec", "consensus_impl"),
+)
+def simulate_constant(
+    W: jnp.ndarray,  # [V, M], constant across epochs
+    S: jnp.ndarray,  # [V]
+    num_epochs: int,
+    config: YumaConfig,
+    spec: VariantSpec,
+    consensus_impl: str = "bisect",
+):
+    """Throughput path: fixed weights, total dividends accumulated in-carry.
+
+    Returns `total_dividends[V]` (sum over epochs of dividend-per-1000-tao)
+    and the final bond state. No per-epoch outputs are materialized, so 10k+
+    epoch sweeps at 256x4096 stay well inside HBM.
+    """
+    V, M = W.shape
+    dtype = W.dtype
+    stakes_units = jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
+
+    def step(carry, epoch):
+        B, W_prev, C_prev, acc = carry
+        first = epoch == 0
+        if spec.reset_mode is not ResetMode.NONE:
+            B = _apply_reset(
+                B, C_prev, epoch, jnp.int32(-1), jnp.int32(-1), spec.reset_mode, M
+            )
+        kernel_prev = None
+        if spec.bonds_mode is BondsMode.EMA_PREV:
+            kernel_prev = jnp.where(first, normalize_weight_rows(W), W_prev)
+        res = yuma_epoch(
+            W,
+            S,
+            B,
+            config,
+            bonds_mode=spec.bonds_mode,
+            W_prev=kernel_prev,
+            first_epoch=first,
+            consensus_impl=consensus_impl,
+        )
+        emission = (
+            config.validator_emission_ratio
+            * res["validator_reward_normalized"]
+            * config.total_epoch_emission
+        )
+        dividends = jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
+        B_next = res[spec.bond_state_key]
+        W_prev_next = res["weight"] if spec.carries_prev_weights else W_prev
+        return (
+            B_next,
+            W_prev_next,
+            res["server_consensus_weight"],
+            acc + dividends,
+        ), None
+
+    carry0 = (
+        jnp.zeros((V, M), dtype),
+        jnp.zeros((V, M), dtype),
+        jnp.zeros((M,), dtype),
+        jnp.zeros((V,), dtype),
+    )
+    (B, _, _, total), _ = lax.scan(
+        step, carry0, jnp.arange(num_epochs, dtype=jnp.int32)
+    )
+    return total, B

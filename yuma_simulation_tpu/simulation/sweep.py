@@ -1,0 +1,175 @@
+"""Batched sweeps: `vmap` over scenarios and hyperparameter grids.
+
+The reference sweeps with nested Python loops (bond_penalty x case x
+version, reference scripts/*.py:14-16, v1/api.py:41-50), re-entering the
+interpreter per combination. Here a sweep is one batched XLA computation:
+scenarios stack on a leading axis, hyperparameters become batched config
+pytree leaves, and the cross product is `vmap o vmap`. The same batched
+callable is what `shard_map` partitions over the pod
+(:mod:`yuma_simulation_tpu.parallel`).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import replace
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from yuma_simulation_tpu.models.config import (
+    SimulationHyperparameters,
+    YumaConfig,
+    YumaParams,
+)
+from yuma_simulation_tpu.models.variants import VariantSpec, variant_for_version
+from yuma_simulation_tpu.scenarios.base import Scenario
+from yuma_simulation_tpu.simulation.engine import _simulate_scan, simulate_constant
+
+
+def stack_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
+    """Stack same-shaped scenarios into `[B, E, V, M]` / `[B, E, V]` arrays
+    plus reset metadata vectors. Heterogeneous suites must be padded first
+    (padded miners get zero weights; padded validators zero stake)."""
+    shapes = {s.weights.shape for s in scenarios}
+    if len(shapes) != 1:
+        raise ValueError(f"scenarios must share one [E,V,M] shape, got {shapes}")
+    W = jnp.asarray(np.stack([s.weights for s in scenarios]), dtype)
+    S = jnp.asarray(np.stack([s.stakes for s in scenarios]), dtype)
+    r_idx = jnp.asarray(
+        [-1 if s.reset_bonds_index is None else s.reset_bonds_index for s in scenarios],
+        jnp.int32,
+    )
+    r_epoch = jnp.asarray(
+        [-1 if s.reset_bonds_epoch is None else s.reset_bonds_epoch for s in scenarios],
+        jnp.int32,
+    )
+    return W, S, r_idx, r_epoch
+
+
+@partial(
+    jax.jit,
+    static_argnames=("spec", "save_bonds", "save_incentives", "consensus_impl"),
+)
+def simulate_batch(
+    weights: jnp.ndarray,  # [B, E, V, M]
+    stakes: jnp.ndarray,  # [B, E, V]
+    reset_index: jnp.ndarray,  # [B] int32
+    reset_epoch: jnp.ndarray,  # [B] int32
+    config: YumaConfig,
+    spec: VariantSpec,
+    save_bonds: bool = False,
+    save_incentives: bool = False,
+    consensus_impl: str = "bisect",
+):
+    """One `vmap` over the scenario axis; shared (unbatched) config."""
+    fn = lambda W, S, ri, re: _simulate_scan(  # noqa: E731
+        W,
+        S,
+        ri,
+        re,
+        config,
+        spec,
+        save_bonds=save_bonds,
+        save_incentives=save_incentives,
+        save_consensus=False,
+        consensus_impl=consensus_impl,
+    )
+    return jax.vmap(fn)(weights, stakes, reset_index, reset_epoch)
+
+
+def sweep_hyperparams(
+    scenario: Scenario,
+    yuma_version: str,
+    configs: YumaConfig,
+    *,
+    save_bonds: bool = False,
+    dtype=jnp.float32,
+):
+    """`vmap` one scenario over a batched config pytree (stacked float
+    leaves, shared static fields). Build `configs` with :func:`config_grid`.
+    """
+    spec = variant_for_version(yuma_version)
+    W = jnp.asarray(scenario.weights, dtype)
+    S = jnp.asarray(scenario.stakes, dtype)
+    ri = jnp.asarray(
+        -1 if scenario.reset_bonds_index is None else scenario.reset_bonds_index,
+        jnp.int32,
+    )
+    re = jnp.asarray(
+        -1 if scenario.reset_bonds_epoch is None else scenario.reset_bonds_epoch,
+        jnp.int32,
+    )
+    fn = lambda cfg: _simulate_scan(  # noqa: E731
+        W,
+        S,
+        ri,
+        re,
+        cfg,
+        spec,
+        save_bonds=save_bonds,
+        save_incentives=False,
+        save_consensus=False,
+    )
+    return jax.vmap(fn)(configs)
+
+
+def config_grid(
+    base_simulation: Optional[SimulationHyperparameters] = None,
+    base_params: Optional[YumaParams] = None,
+    **axes: Sequence[float],
+) -> tuple[YumaConfig, list[dict]]:
+    """Build a batched `YumaConfig` from a cartesian hyperparameter grid.
+
+    `axes` maps flattened field names (e.g. `kappa`, `bond_alpha`,
+    `bond_penalty`) to value lists. Returns the batched config (float
+    leaves stacked over the grid's flat order) and the list of grid-point
+    dicts in the same order. Static fields (`liquid_alpha`, overrides)
+    cannot be swept this way — they select different compiled programs.
+    """
+    base_simulation = base_simulation or SimulationHyperparameters()
+    base_params = base_params or YumaParams()
+    sim_fields = {f for f in vars(base_simulation) if f != "consensus_precision"}
+    par_fields = {
+        f
+        for f in vars(base_params)
+        if f not in ("liquid_alpha", "override_consensus_high", "override_consensus_low")
+    }
+    for name in axes:
+        if name not in sim_fields and name not in par_fields:
+            raise ValueError(f"cannot sweep non-float/static field '{name}'")
+
+    names = list(axes)
+    points = [dict(zip(names, combo)) for combo in itertools.product(*axes.values())]
+
+    def build(point: dict) -> YumaConfig:
+        sim = replace(
+            base_simulation, **{k: v for k, v in point.items() if k in sim_fields}
+        )
+        par = replace(
+            base_params, **{k: v for k, v in point.items() if k in par_fields}
+        )
+        return YumaConfig(simulation=sim, yuma_params=par)
+
+    configs = [build(p) for p in points]
+    batched = jax.tree.map(lambda *leaves: jnp.stack(jnp.asarray(leaves)), *configs)
+    return batched, points
+
+
+def total_dividends_batch(
+    scenarios: Sequence[Scenario],
+    yuma_version: str,
+    config: Optional[YumaConfig] = None,
+    *,
+    dtype=jnp.float32,
+) -> np.ndarray:
+    """`[B, V]` total dividends for a stacked scenario suite — the batched
+    equivalent of summing the reference driver's per-epoch output."""
+    config = config if config is not None else YumaConfig()
+    spec = variant_for_version(yuma_version)
+    W, S, ri, re = stack_scenarios(scenarios, dtype)
+    ys = simulate_batch(W, S, ri, re, config, spec)
+    return np.asarray(ys["dividends"].sum(axis=1))
