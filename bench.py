@@ -1,0 +1,70 @@
+"""Headline benchmark: simulated epochs/sec at 256 validators x 4096 miners.
+
+The reference's measured number for this config is ~0.54 epochs/s on CPU
+(SURVEY.md §6, BASELINE.md: the per-miner bisection Python loop dominates).
+Here the same workload — Yuma 1 epoch kernel, EMA bonds, carried state —
+is one `lax.scan` over the jitted unified kernel (`simulate_constant`), so
+the whole run is a single device computation with no host round-trips.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.variants import variant_for_version
+from yuma_simulation_tpu.simulation.engine import simulate_constant
+
+BASELINE_EPOCHS_PER_SEC = 0.54  # reference CPU, 256v x 4096m (BASELINE.md)
+V, M = 256, 4096
+
+
+def _run(n_epochs: int, W, S, config, spec):
+    total, bonds = simulate_constant(W, S, n_epochs, config, spec)
+    # np.asarray forces the device->host fetch of the [V] totals; on remote
+    # TPU runtimes block_until_ready alone can return before execution.
+    return np.asarray(total)
+
+
+def main() -> None:
+    rng = np.random.default_rng(42)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random((V,)) + 0.01, jnp.float32)
+    config = YumaConfig()
+    spec = variant_for_version("Yuma 1 (paper)")
+
+    # Warm-up at the timed epoch count (scan length is static) to exclude
+    # compile time, then calibrate the count so the timed run is >= ~2s.
+    n = 2048
+    _run(n, W, S, config, spec)
+    t0 = time.perf_counter()
+    _run(n, W, S, config, spec)
+    dt = time.perf_counter() - t0
+    if dt < 2.0:
+        n = min(100_000, int(n * max(2.0, 2.5 / dt)))
+        _run(n, W, S, config, spec)
+        t0 = time.perf_counter()
+        _run(n, W, S, config, spec)
+        dt = time.perf_counter() - t0
+
+    eps = n / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"simulated epochs/sec, {V}v x {M}m, Yuma 1 kernel",
+                "value": round(eps, 2),
+                "unit": "epochs/s",
+                "vs_baseline": round(eps / BASELINE_EPOCHS_PER_SEC, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -10,7 +10,12 @@ explicitly float32.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Forced assignment: the environment's sitecustomize pre-sets
+# JAX_PLATFORMS to the real accelerator plugin, so setdefault would lose.
+# (The config.update below is what actually takes effect — sitecustomize
+# has already imported jax by the time this file runs, so the env snapshot
+# is stale; backends themselves initialize lazily, so the update is safe.)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,6 +26,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402,F401

@@ -1,0 +1,31 @@
+"""Pod scale-out: device meshes, sharded sweeps, Monte-Carlo at scale.
+
+The reference is single-process/single-thread CPU (SURVEY.md §2: no
+distributed code exists there); this package is the TPU-native scaling
+layer it lacks. Two orthogonal axes:
+
+- **Scenario batch ("data")** — embarrassingly parallel; `shard_map` over
+  the mesh's data axis with zero collectives inside the epoch scan and one
+  gather at the end (:func:`simulate_batch_sharded`,
+  :func:`montecarlo_total_dividends`).
+- **Miner axis ("model")** — when a subnet's `[V, M]` matrices outgrow one
+  chip, shard the miner dimension with GSPMD sharding annotations and let
+  XLA insert the (few, tiny) collectives: row-sum psums for weight
+  normalization, a scalar psum for the consensus quantization divide, and
+  an `[M]`-vector gather for liquid-alpha quantiles
+  (:func:`shard_epoch_over_miners`).
+
+Multi-host (DCN) meshes put the scenario axis on DCN and the miner axis on
+ICI (:func:`make_hybrid_mesh`), so all per-epoch traffic rides ICI.
+"""
+
+from yuma_simulation_tpu.parallel.mesh import (  # noqa: F401
+    make_hybrid_mesh,
+    make_mesh,
+    initialize_distributed,
+)
+from yuma_simulation_tpu.parallel.sharded import (  # noqa: F401
+    montecarlo_total_dividends,
+    shard_epoch_over_miners,
+    simulate_batch_sharded,
+)

@@ -1,0 +1,116 @@
+"""Device-mesh construction for single-chip, pod (ICI) and multi-slice (DCN).
+
+The reference has no distributed backend at all (SURVEY.md §5); the
+TPU-native equivalent is a `jax.sharding.Mesh` whose axes the rest of the
+framework shards over:
+
+- ``"data"``  — the scenario / Monte-Carlo batch axis (no per-epoch traffic);
+- ``"model"`` — the miner axis of the `[V, M]` weight/bond matrices, for
+  subnets too large for one chip's HBM.
+
+Meshes are plain data; all collective placement is decided by the sharding
+annotations in :mod:`yuma_simulation_tpu.parallel.sharded`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    data: int = -1,
+    model: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a `(data, model)` mesh over the available devices.
+
+    ``data=-1`` absorbs whatever is left after ``model`` (the common case:
+    shard scenarios over every chip). On a real TPU slice
+    `mesh_utils.create_device_mesh` picks an ICI-contiguous layout; on CPU
+    test meshes (``--xla_force_host_platform_device_count=N``) it reduces
+    to a reshape.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data == -1:
+        if n % model:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    if data * model != n:
+        raise ValueError(f"mesh {data}x{model} != {n} devices")
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            (data, model), devices=devices
+        )
+    except Exception:  # non-TPU platforms without topology info
+        dev_array = np.asarray(devices).reshape(data, model)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def make_hybrid_mesh(
+    data_per_slice: int = -1, model: int = 1
+) -> Mesh:
+    """Multi-slice mesh: scenario batch over DCN x ICI, miner axis on ICI.
+
+    Uses `mesh_utils.create_hybrid_device_mesh` so the ``model`` axis (which
+    carries the per-epoch collectives) is always intra-slice (ICI) and only
+    the collective-free ``data`` axis spans DCN. Falls back to
+    :func:`make_mesh` in single-slice / CPU environments.
+    """
+    devices = jax.devices()
+    num_slices = max(
+        (getattr(d, "slice_index", 0) or 0 for d in devices), default=0
+    ) + 1
+    if num_slices <= 1:
+        return make_mesh(data_per_slice, model)
+    per_slice = len(devices) // num_slices
+    if data_per_slice == -1:
+        data_per_slice = per_slice // model
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(data_per_slice, model),
+        dcn_mesh_shape=(num_slices, 1),
+        devices=devices,
+    )
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-host JAX run (the NCCL/MPI-init analogue).
+
+    A no-op when already initialized or when running single-process; safe to
+    call unconditionally at program start. Arguments default to the
+    standard JAX env-var autodetection (GKE / Cloud TPU metadata).
+    """
+    if jax.process_count() > 1:  # already initialized
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        logger.info(
+            "distributed: process %d/%d, %d local / %d global devices",
+            jax.process_index(),
+            jax.process_count(),
+            jax.local_device_count(),
+            jax.device_count(),
+        )
+    except (RuntimeError, ValueError) as e:
+        logger.debug("single-process run (distributed init skipped: %s)", e)

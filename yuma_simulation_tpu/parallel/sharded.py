@@ -1,0 +1,258 @@
+"""Sharded execution: scenario-batch `shard_map` + miner-axis GSPMD.
+
+Replaces — TPU-natively — the distributed layer the reference never had
+(SURVEY.md §5: "distributed communication backend: absent"). Two paths:
+
+1. :func:`simulate_batch_sharded` / :func:`montecarlo_total_dividends` —
+   the scenario batch is sharded over the mesh's ``data`` axis with
+   `jax.shard_map`. Scenarios are independent, so the scan body runs with
+   literally zero collectives; results come back as a global array (one
+   all-gather / host fetch at the end). This is the cheapest possible
+   collective profile for a pod-scale Monte-Carlo sweep.
+
+2. :func:`shard_epoch_over_miners` — for a single subnet whose `[V, M]`
+   matrices exceed one chip, the miner axis is sharded with
+   `NamedSharding` annotations and XLA/GSPMD inserts the collectives: the
+   bisection (the hot loop) is per-miner and stays fully local; only the
+   row-normalization sums, the consensus-sum divide and the dividend
+   reduction cross shards, each a `[V]`- or scalar-sized psum per epoch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.epoch import yuma_epoch
+from yuma_simulation_tpu.models.variants import VariantSpec, variant_for_version
+from yuma_simulation_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from yuma_simulation_tpu.scenarios.base import Scenario
+from yuma_simulation_tpu.simulation.engine import _simulate_scan
+from yuma_simulation_tpu.simulation.sweep import stack_scenarios
+
+
+def _pad_batch(n: int, shards: int) -> int:
+    """Scenarios to add so the batch divides evenly over the data axis."""
+    return (-n) % shards
+
+
+@partial(
+    jax.jit,
+    static_argnames=("spec", "mesh", "save_bonds", "consensus_impl"),
+)
+def _sharded_batch_scan(
+    weights,  # [B, E, V, M] sharded over B
+    stakes,  # [B, E, V]
+    reset_index,  # [B]
+    reset_epoch,  # [B]
+    config: YumaConfig,
+    spec: VariantSpec,
+    mesh: Mesh,
+    save_bonds: bool = False,
+    consensus_impl: str = "bisect",
+):
+    def local_batch(W, S, ri, re):
+        # Per-shard slice of the scenario batch; vmap the scan inside the
+        # shard so the compiled program never references other shards.
+        fn = lambda w, s, i, e: _simulate_scan(  # noqa: E731
+            w,
+            s,
+            i,
+            e,
+            config,
+            spec,
+            save_bonds=save_bonds,
+            save_incentives=False,
+            save_consensus=False,
+            consensus_impl=consensus_impl,
+        )
+        return jax.vmap(fn)(W, S, ri, re)
+
+    # check_vma=False: the bisection fori_loop seeds its carry from
+    # literals, which the varying-manual-axes checker would force us to
+    # pcast shard-by-shard; there is no cross-shard communication here for
+    # it to validate.
+    return jax.shard_map(
+        local_batch,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )(weights, stakes, reset_index, reset_epoch)
+
+
+def simulate_batch_sharded(
+    scenarios: Sequence[Scenario],
+    yuma_version: str,
+    config: Optional[YumaConfig] = None,
+    *,
+    mesh: Mesh,
+    save_bonds: bool = False,
+    dtype=jnp.float32,
+):
+    """Run a scenario suite sharded over the mesh's data axis.
+
+    Pads the batch to a multiple of the data-axis size with copies of the
+    last scenario (dropped from the returned arrays), places the stacked
+    inputs with a `NamedSharding` so each host only materializes its
+    shard, and returns per-epoch dividends `[B, E, V]` (plus bonds if
+    requested) as numpy.
+    """
+    config = config if config is not None else YumaConfig()
+    spec = variant_for_version(yuma_version)
+    n = len(scenarios)
+    shards = mesh.shape[DATA_AXIS]
+    pad = _pad_batch(n, shards)
+    padded = list(scenarios) + [scenarios[-1]] * pad
+    W, S, ri, re = stack_scenarios(padded, dtype)
+
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    W = jax.device_put(W, sharding)
+    S = jax.device_put(S, sharding)
+    ri = jax.device_put(ri, sharding)
+    re = jax.device_put(re, sharding)
+
+    ys = _sharded_batch_scan(
+        W, S, ri, re, config, spec, mesh, save_bonds=save_bonds
+    )
+    out = {k: np.asarray(v)[:n] for k, v in ys.items()}
+    return out
+
+
+def montecarlo_total_dividends(
+    key: jax.Array,
+    num_scenarios: int,
+    num_epochs: int,
+    num_validators: int,
+    num_miners: int,
+    yuma_version: str,
+    config: Optional[YumaConfig] = None,
+    *,
+    mesh: Mesh,
+    base_weights: Optional[jnp.ndarray] = None,
+    base_stakes: Optional[jnp.ndarray] = None,
+    perturbation: float = 0.05,
+    dtype=jnp.float32,
+) -> np.ndarray:
+    """Pod-scale Monte-Carlo: `[num_scenarios, V]` total dividends.
+
+    Weight-perturbation study (BASELINE.json config 5): each scenario is
+    `softmax-normalized(base_weights + eps)`, with scenarios generated
+    *on-device inside each shard* from a split of ``key`` — no `[B, E, V, M]`
+    host array ever exists, so an 8192-scenario x 10k-epoch study is
+    bounded by per-chip HBM only. Zero collectives until the final gather.
+    """
+    config = config if config is not None else YumaConfig()
+    spec = variant_for_version(yuma_version)
+    shards = mesh.shape[DATA_AXIS]
+    if num_scenarios % shards:
+        raise ValueError(
+            f"num_scenarios={num_scenarios} must divide over data={shards}"
+        )
+    per_shard = num_scenarios // shards
+    if base_weights is None:
+        base_weights = jnp.ones((num_validators, num_miners), dtype)
+    if base_stakes is None:
+        base_stakes = jnp.ones((num_validators,), dtype)
+    base_weights = jnp.asarray(base_weights, dtype)
+    base_stakes = jnp.asarray(base_stakes, dtype)
+    keys = jax.random.split(key, shards)
+
+    @partial(jax.jit, static_argnames=())
+    def run(keys):
+        def local(shard_keys):
+            shard_key = shard_keys[0]
+
+            def one(k):
+                eps = perturbation * jax.random.normal(
+                    k, base_weights.shape, dtype
+                )
+                W = jax.nn.relu(base_weights + eps)
+                W_e = jnp.broadcast_to(
+                    W, (num_epochs,) + W.shape
+                )
+                S_e = jnp.broadcast_to(
+                    base_stakes, (num_epochs, num_validators)
+                )
+                ys = _simulate_scan(
+                    W_e,
+                    S_e,
+                    jnp.int32(-1),
+                    jnp.int32(-1),
+                    config,
+                    spec,
+                    save_bonds=False,
+                    save_incentives=False,
+                    save_consensus=False,
+                )
+                return ys["dividends"].sum(axis=0)  # [V]
+
+            return jax.vmap(one)(jax.random.split(shard_key, per_shard))
+
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=P(DATA_AXIS),
+            out_specs=P(DATA_AXIS),
+            check_vma=False,
+        )(keys)
+
+    return np.asarray(run(keys))
+
+
+def shard_epoch_over_miners(
+    W: jnp.ndarray,
+    S: jnp.ndarray,
+    B_old: Optional[jnp.ndarray],
+    config: YumaConfig,
+    *,
+    mesh: Mesh,
+    bonds_mode,
+    consensus_impl: str = "bisect",
+) -> dict:
+    """One consensus epoch with the miner axis sharded over ``model``.
+
+    The "sequence-parallel" analogue for this domain (SURVEY.md §5): when
+    `V x M` outgrows a chip, `W`, `B` and all `[M]`-vectors shard on the
+    miner axis. Sharding is expressed with `NamedSharding` constraints and
+    the collectives are left to GSPMD — the bisection support sums reduce
+    over the *validator* axis (replicated), so the hot loop is entirely
+    local; cross-shard traffic is a handful of scalar/row reductions.
+    """
+    vm = NamedSharding(mesh, P(None, MODEL_AXIS))
+    m = NamedSharding(mesh, P(MODEL_AXIS))
+    rep = NamedSharding(mesh, P())
+
+    W = jax.device_put(jnp.asarray(W), vm)
+    S = jax.device_put(jnp.asarray(S), rep)
+    if B_old is not None:
+        B_old = jax.device_put(jnp.asarray(B_old), vm)
+
+    @partial(jax.jit, static_argnames=("bonds_mode", "consensus_impl"))
+    def step(W, S, B_old, config, bonds_mode, consensus_impl):
+        out = yuma_epoch(
+            W,
+            S,
+            B_old,
+            config,
+            bonds_mode=bonds_mode,
+            consensus_impl=consensus_impl,
+        )
+        # Pin the layouts of the large outputs so downstream epochs keep
+        # the miner axis sharded instead of gathering.
+        for k in ("weight", "consensus_clipped_weight"):
+            out[k] = jax.lax.with_sharding_constraint(out[k], vm)
+        for k in ("server_consensus_weight", "server_incentive"):
+            out[k] = jax.lax.with_sharding_constraint(out[k], m)
+        for k in ("validator_bond", "validator_ema_bond", "validator_bonds"):
+            if k in out:
+                out[k] = jax.lax.with_sharding_constraint(out[k], vm)
+        return out
+
+    return step(W, S, B_old, config, bonds_mode, consensus_impl)
