@@ -1,0 +1,111 @@
+"""Multi-chip behavior on the virtual 8-device CPU mesh.
+
+The driver separately dry-runs `__graft_entry__.dryrun_multichip`; these
+tests pin the same guarantees in-suite (SURVEY.md §4: the CPU-mesh mode
+replaces the reference's absent fake-backend layer): sharded results are
+identical to unsharded, Monte-Carlo generation composes with `shard_map`,
+and the miner-axis GSPMD path reproduces the single-device kernel.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.epoch import BondsMode, yuma_epoch
+from yuma_simulation_tpu.parallel import (
+    make_hybrid_mesh,
+    make_mesh,
+    montecarlo_total_dividends,
+    shard_epoch_over_miners,
+    simulate_batch_sharded,
+)
+from yuma_simulation_tpu.scenarios import get_cases
+from yuma_simulation_tpu.simulation.sweep import total_dividends_batch
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    return make_mesh()  # data=8, model=1
+
+
+def test_sharded_batch_matches_vmap(mesh8):
+    cases = get_cases()
+    out = simulate_batch_sharded(cases, "Yuma 1 (paper)", mesh=mesh8)
+    ref = total_dividends_batch(cases, "Yuma 1 (paper)")
+    np.testing.assert_allclose(
+        out["dividends"].sum(axis=1), ref, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_sharded_batch_pads_uneven(mesh8):
+    cases = get_cases()[:5]  # 5 scenarios over 8 shards -> pad to 8, trim back
+    out = simulate_batch_sharded(cases, "Yuma 2 (Adrian-Fish)", mesh=mesh8)
+    assert out["dividends"].shape[0] == 5
+    ref = total_dividends_batch(cases, "Yuma 2 (Adrian-Fish)")
+    np.testing.assert_allclose(
+        out["dividends"].sum(axis=1), ref, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_montecarlo_sharded(mesh8):
+    got = montecarlo_total_dividends(
+        jax.random.key(0), 16, 8, 4, 8, "Yuma 1 (paper)", mesh=mesh8
+    )
+    assert got.shape == (16, 4)
+    assert np.isfinite(got).all()
+    # Same key, same result (deterministic across shardings of the batch).
+    again = montecarlo_total_dividends(
+        jax.random.key(0), 16, 8, 4, 8, "Yuma 1 (paper)", mesh=mesh8
+    )
+    np.testing.assert_array_equal(got, again)
+
+
+def test_montecarlo_batch_indivisible_raises(mesh8):
+    with pytest.raises(ValueError, match="divide"):
+        montecarlo_total_dividends(
+            jax.random.key(0), 13, 4, 4, 8, "Yuma 1 (paper)", mesh=mesh8
+        )
+
+
+@pytest.mark.parametrize(
+    "mode", [BondsMode.EMA, BondsMode.CAPACITY, BondsMode.RELATIVE]
+)
+def test_miner_axis_sharding_matches_single_device(mode):
+    mesh = make_mesh(data=1, model=8)
+    rng = np.random.default_rng(5)
+    W = rng.random((4, 16)).astype(np.float32)
+    S = np.asarray([0.4, 0.3, 0.2, 0.1], np.float32)
+    B = (rng.random((4, 16)) * (1e18 if mode is BondsMode.CAPACITY else 0.5)).astype(
+        np.float32
+    )
+    cfg = YumaConfig()
+    sharded = shard_epoch_over_miners(W, S, B, cfg, mesh=mesh, bonds_mode=mode)
+    ref = yuma_epoch(jnp.asarray(W), jnp.asarray(S), jnp.asarray(B), cfg, bonds_mode=mode)
+    for key in ("server_consensus_weight", "server_incentive", "validator_reward"):
+        np.testing.assert_allclose(
+            np.asarray(sharded[key]), np.asarray(ref[key]), rtol=1e-5, atol=1e-6,
+            err_msg=key,
+        )
+
+
+def test_mesh_shapes():
+    m = make_mesh(data=4, model=2)
+    assert dict(m.shape) == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        make_mesh(data=3, model=2)
+    # single-slice environment falls back to a flat mesh
+    h = make_hybrid_mesh(model=2)
+    assert dict(h.shape) == {"data": 4, "model": 2}
+
+
+def test_graft_entry_dryrun():
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 16)
+    __graft_entry__.dryrun_multichip(8)

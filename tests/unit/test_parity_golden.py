@@ -9,39 +9,16 @@ container); every value must match to ~1e-6 — the 6-decimal CSV surface.
 
 import csv
 import os
-from dataclasses import replace
 
 import pytest
 
 from tests.conftest import GOLDEN_DIR
-from yuma_simulation_tpu.models.config import (
-    SimulationHyperparameters,
-    YumaParams,
-    YumaSimulationNames,
-)
+from yuma_simulation_tpu.models.config import SimulationHyperparameters
+from yuma_simulation_tpu.models.variants import canonical_versions
 from yuma_simulation_tpu.reporting.tables import generate_total_dividends_table
 from yuma_simulation_tpu.scenarios import cases
 
-NAMES = YumaSimulationNames()
 TOL = 1.5e-6
-
-
-def canonical_versions():
-    base = YumaParams()
-    liquid = YumaParams(liquid_alpha=True)
-    y4 = YumaParams(bond_alpha=0.025, alpha_high=0.99, alpha_low=0.9)
-    y4l = replace(y4, liquid_alpha=True)
-    return [
-        (NAMES.YUMA_RUST, base),
-        (NAMES.YUMA, base),
-        (NAMES.YUMA_LIQUID, liquid),
-        (NAMES.YUMA2, base),
-        (NAMES.YUMA3, base),
-        (NAMES.YUMA31, base),
-        (NAMES.YUMA32, base),
-        (NAMES.YUMA4, y4),
-        (NAMES.YUMA4_LIQUID, y4l),
-    ]
 
 
 def load_golden(beta):

@@ -1,0 +1,68 @@
+"""Batched sweeps: hyperparameter grids and scenario batches via vmap."""
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.models.config import (
+    SimulationHyperparameters,
+    YumaConfig,
+    YumaParams,
+)
+from yuma_simulation_tpu.scenarios import create_case, get_cases
+from yuma_simulation_tpu.simulation.engine import simulate
+from yuma_simulation_tpu.simulation.sweep import (
+    config_grid,
+    stack_scenarios,
+    sweep_hyperparams,
+    total_dividends_batch,
+)
+
+
+def test_config_grid_order_and_shape():
+    configs, points = config_grid(kappa=[0.3, 0.5], bond_alpha=[0.1, 0.2, 0.3])
+    assert len(points) == 6
+    assert points[0] == {"kappa": 0.3, "bond_alpha": 0.1}
+    assert points[-1] == {"kappa": 0.5, "bond_alpha": 0.3}
+    assert configs.simulation.kappa.shape == (6,)
+    assert configs.yuma_params.bond_alpha.shape == (6,)
+
+
+def test_config_grid_rejects_static_fields():
+    with pytest.raises(ValueError, match="static"):
+        config_grid(liquid_alpha=[True, False])
+
+
+def test_sweep_matches_individual_runs():
+    case = create_case("Case 2")
+    version = "Yuma 1 (paper)"
+    configs, points = config_grid(bond_penalty=[0.0, 0.5, 1.0])
+    ys = sweep_hyperparams(case, version, configs)
+    swept = np.asarray(ys["dividends"]).sum(axis=1)  # [grid, V]
+
+    for i, point in enumerate(points):
+        cfg = YumaConfig(
+            simulation=SimulationHyperparameters(bond_penalty=point["bond_penalty"]),
+            yuma_params=YumaParams(),
+        )
+        res = simulate(case, version, cfg, save_bonds=False, save_incentives=False)
+        np.testing.assert_allclose(
+            swept[i], res.dividends.sum(axis=0), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_stack_scenarios_rejects_heterogeneous():
+    a = create_case("Case 1")
+    b = create_case("Case 1", num_epochs=20)
+    with pytest.raises(ValueError, match="shape"):
+        stack_scenarios([a, b])
+
+
+def test_total_dividends_batch_matches_single():
+    cases = get_cases()[:3]
+    version = "Yuma 4 (Rhef+relative bonds)"
+    batched = total_dividends_batch(cases, version)
+    for i, case in enumerate(cases):
+        res = simulate(case, version, save_bonds=False, save_incentives=False)
+        np.testing.assert_allclose(
+            batched[i], res.dividends.sum(axis=0), rtol=1e-5, atol=1e-6
+        )

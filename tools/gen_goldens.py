@@ -19,6 +19,9 @@ from yuma_simulation._internal.yumas import (
 from dataclasses import replace
 
 def versions():
+    # Matches the reference scripts' pairing exactly (reference
+    # charts_table_generator.py:38-48): Yuma 4 runs with BASE params; only
+    # the liquid variant carries the 0.025 / [0.9, 0.99] tuning.
     base = YumaParams()
     liquid = YumaParams(liquid_alpha=True)
     y4 = YumaParams(bond_alpha=0.025, alpha_high=0.99, alpha_low=0.9)
@@ -27,7 +30,7 @@ def versions():
     return [
         (n.YUMA_RUST, base), (n.YUMA, base), (n.YUMA_LIQUID, liquid),
         (n.YUMA2, base), (n.YUMA3, base), (n.YUMA31, base), (n.YUMA32, base),
-        (n.YUMA4, y4), (n.YUMA4_LIQUID, y4l),
+        (n.YUMA4, base), (n.YUMA4_LIQUID, y4l),
     ]
 
 def main():

@@ -82,6 +82,44 @@ YUMA_VERSIONS: dict[str, VariantSpec] = {
 }
 
 
+def canonical_versions(
+    yuma4_bond_alpha: float = 0.025,
+    yuma4_alpha_high: float = 0.99,
+    yuma4_alpha_low: float = 0.9,
+) -> list[tuple[str, "YumaParams"]]:
+    """The canonical 9-version sweep list with per-version params, as the
+    reference's entry-point scripts build it
+    (reference scripts/charts_table_generator.py:26-48). Note Yuma 4 runs
+    with *base* params there; the bond_alpha=0.025 / [0.9, 0.99] tuning is
+    applied only to the liquid-alpha variant
+    (charts_table_generator.py:46-47)."""
+    from dataclasses import replace
+
+    from yuma_simulation_tpu.models.config import YumaParams
+
+    base = YumaParams()
+    liquid = YumaParams(liquid_alpha=True)
+    y4_liquid = replace(
+        YumaParams(
+            bond_alpha=yuma4_bond_alpha,
+            alpha_high=yuma4_alpha_high,
+            alpha_low=yuma4_alpha_low,
+        ),
+        liquid_alpha=True,
+    )
+    return [
+        (_NAMES.YUMA_RUST, base),
+        (_NAMES.YUMA, base),
+        (_NAMES.YUMA_LIQUID, liquid),
+        (_NAMES.YUMA2, base),
+        (_NAMES.YUMA3, base),
+        (_NAMES.YUMA31, base),
+        (_NAMES.YUMA32, base),
+        (_NAMES.YUMA4, base),
+        (_NAMES.YUMA4_LIQUID, y4_liquid),
+    ]
+
+
 def variant_for_version(yuma_version: str) -> VariantSpec:
     """Resolve a display-string version name to its static spec."""
     try:

@@ -76,8 +76,16 @@ def make_hybrid_mesh(
     if num_slices <= 1:
         return make_mesh(data_per_slice, model)
     per_slice = len(devices) // num_slices
+    if per_slice % model:
+        raise ValueError(
+            f"{per_slice} devices/slice not divisible by model={model}"
+        )
     if data_per_slice == -1:
         data_per_slice = per_slice // model
+    if data_per_slice * model != per_slice:
+        raise ValueError(
+            f"per-slice mesh {data_per_slice}x{model} != {per_slice} devices"
+        )
     dev_array = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=(data_per_slice, model),
         dcn_mesh_shape=(num_slices, 1),
@@ -93,11 +101,12 @@ def initialize_distributed(
 ) -> None:
     """Join a multi-host JAX run (the NCCL/MPI-init analogue).
 
-    A no-op when already initialized or when running single-process; safe to
-    call unconditionally at program start. Arguments default to the
-    standard JAX env-var autodetection (GKE / Cloud TPU metadata).
+    A no-op when already initialized; call it *before* anything touches the
+    backend (any `jax.devices()` / array op initializes local-only XLA and
+    makes later distributed init fail). Arguments default to the standard
+    JAX env-var autodetection (GKE / Cloud TPU metadata).
     """
-    if jax.process_count() > 1:  # already initialized
+    if jax.distributed.is_initialized():
         return
     try:
         jax.distributed.initialize(

@@ -34,7 +34,7 @@ from yuma_simulation_tpu.models.variants import VariantSpec, variant_for_version
 from yuma_simulation_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from yuma_simulation_tpu.scenarios.base import Scenario
 from yuma_simulation_tpu.simulation.engine import _simulate_scan
-from yuma_simulation_tpu.simulation.sweep import stack_scenarios
+from yuma_simulation_tpu.simulation.sweep import simulate_batch, stack_scenarios
 
 
 def _pad_batch(n: int, shards: int) -> int:
@@ -58,21 +58,20 @@ def _sharded_batch_scan(
     consensus_impl: str = "bisect",
 ):
     def local_batch(W, S, ri, re):
-        # Per-shard slice of the scenario batch; vmap the scan inside the
-        # shard so the compiled program never references other shards.
-        fn = lambda w, s, i, e: _simulate_scan(  # noqa: E731
-            w,
-            s,
-            i,
-            e,
+        # Per-shard slice of the scenario batch; the vmap'd scan comes from
+        # the one shared batched entry point so sharded and unsharded paths
+        # cannot drift.
+        return simulate_batch(
+            W,
+            S,
+            ri,
+            re,
             config,
             spec,
             save_bonds=save_bonds,
             save_incentives=False,
-            save_consensus=False,
             consensus_impl=consensus_impl,
         )
-        return jax.vmap(fn)(W, S, ri, re)
 
     # check_vma=False: the bisection fori_loop seeds its carry from
     # literals, which the varying-manual-axes checker would force us to
@@ -142,8 +141,10 @@ def montecarlo_total_dividends(
 ) -> np.ndarray:
     """Pod-scale Monte-Carlo: `[num_scenarios, V]` total dividends.
 
-    Weight-perturbation study (BASELINE.json config 5): each scenario is
-    `softmax-normalized(base_weights + eps)`, with scenarios generated
+    Weight-perturbation study (BASELINE.json config 5): each scenario's
+    weights are `relu(base_weights + eps)` with `eps ~ N(0, perturbation)`
+    (the kernel's own row-normalization makes them a distribution; negative
+    perturbations truncate at zero), with scenarios generated
     *on-device inside each shard* from a split of ``key`` — no `[B, E, V, M]`
     host array ever exists, so an 8192-scenario x 10k-epoch study is
     bounded by per-chip HBM only. Zero collectives until the final gather.

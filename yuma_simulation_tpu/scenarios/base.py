@@ -57,6 +57,11 @@ class Scenario:
     reset_bonds_index: Optional[int] = None
     reset_bonds_epoch: Optional[int] = None
     servers: list[str] = field(default_factory=lambda: ["Server 1", "Server 2"])
+    #: Whether chart tables add the server-incentives row for this case.
+    #: The reference keys this off positional indices 9/10 of the full
+    #: suite (reference v1/api.py:42-45) — i.e. Cases 10 and 11; carrying
+    #: it on the scenario makes it survive case subsets/reordering.
+    plot_incentives: bool = False
 
     def __post_init__(self):
         if self.base_validator not in self.validators:

@@ -308,6 +308,7 @@ def case_10(num_epochs: int = 40, **kw) -> Scenario:
             ],
         ),
         stakes=constant_stakes(num_epochs, _DEFAULT_STAKES),
+        plot_incentives=True,
         **kw,
     )
 
@@ -335,6 +336,7 @@ def case_11(num_epochs: int = 40, **kw) -> Scenario:
             ],
         ),
         stakes=constant_stakes(num_epochs, [0.49, 0.49, 0.02]),
+        plot_incentives=True,
         **kw,
     )
 

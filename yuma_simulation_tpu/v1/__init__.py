@@ -1,0 +1,8 @@
+"""Frozen public API surface (ApiVer), version 1.
+
+Mirrors the reference's contract (reference README.md:10-18): everything
+under `v1` is stable; internals under the other subpackages may change
+freely. Import the api module explicitly:
+
+    from yuma_simulation_tpu.v1 import api
+"""
