@@ -1,0 +1,61 @@
+"""Pallas consensus kernel: bit-parity with the XLA bisection.
+
+Runs in interpreter mode on the CPU test mesh; on TPU the same kernel is
+compiled (the values are dyadic rationals, exact in f32, so parity is
+bitwise on both paths).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.epoch import BondsMode, yuma_epoch
+from yuma_simulation_tpu.ops.consensus import stake_weighted_median
+from yuma_simulation_tpu.ops.pallas_consensus import stake_weighted_median_pallas
+
+
+@pytest.mark.parametrize(
+    "shape", [(3, 2), (5, 7), (16, 130), (64, 512)]
+)
+def test_pallas_matches_bisection(shape):
+    V, M = shape
+    rng = np.random.default_rng(V * M)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    W = W / W.sum(axis=1, keepdims=True)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    S = S / S.sum()
+    ref = np.asarray(stake_weighted_median(W, S, 0.5))
+    got = np.asarray(stake_weighted_median_pallas(W, S, 0.5, interpret=True))
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_pallas_kappa_and_zero_columns():
+    W = jnp.asarray(
+        [[0.9, 0.0, 0.1], [0.2, 0.0, 0.8], [0.2, 0.0, 0.8]], jnp.float32
+    )
+    S = jnp.asarray([0.6, 0.2, 0.2], jnp.float32)
+    for kappa in (0.3, 0.5, 0.7):
+        ref = np.asarray(stake_weighted_median(W, S, kappa))
+        got = np.asarray(
+            stake_weighted_median_pallas(W, S, kappa, interpret=True)
+        )
+        np.testing.assert_array_equal(ref, got)
+    # the all-zero column converges to the grid floor 2^-17 on both paths
+    assert got[1] == np.float32(2.0**-17)
+
+
+def test_epoch_with_pallas_impl_matches_default():
+    rng = np.random.default_rng(9)
+    W = jnp.asarray(rng.random((8, 16)), jnp.float32)
+    S = jnp.asarray(rng.random(8) + 0.01, jnp.float32)
+    base = yuma_epoch(W, S, None, YumaConfig(), bonds_mode=BondsMode.EMA)
+    pall = yuma_epoch(
+        W, S, None, YumaConfig(), bonds_mode=BondsMode.EMA,
+        consensus_impl="pallas",
+    )
+    for key in ("server_consensus_weight", "server_incentive", "validator_reward"):
+        np.testing.assert_array_equal(
+            np.asarray(base[key]), np.asarray(pall[key]), err_msg=key
+        )

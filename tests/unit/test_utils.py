@@ -1,0 +1,60 @@
+"""Utils: checkpointed sweeps, timers, logging setup."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.utils import CheckpointedSweep, setup_logging, timed
+
+
+def test_checkpointed_sweep_resumes(tmp_path):
+    calls = []
+
+    def fn(i):
+        calls.append(i)
+        return np.full((2, 3), i, np.float32)
+
+    sweep = CheckpointedSweep(tmp_path, num_chunks=4, tag="t")
+    out = sweep.run(fn)
+    assert out.shape == (8, 3)
+    assert calls == [0, 1, 2, 3]
+
+    # Delete one chunk; resume recomputes only that chunk.
+    (tmp_path / "chunk_00002.npz").unlink()
+    calls.clear()
+    sweep2 = CheckpointedSweep(tmp_path, num_chunks=4, tag="t")
+    out2 = sweep2.run(fn)
+    assert calls == [2]
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_checkpointed_sweep_rejects_mismatched_manifest(tmp_path):
+    CheckpointedSweep(tmp_path, num_chunks=4, tag="a")
+    with pytest.raises(ValueError, match="different"):
+        CheckpointedSweep(tmp_path, num_chunks=8, tag="a")
+
+
+def test_timed_rate():
+    with timed("x", epochs=100) as t:
+        pass
+    assert t.seconds >= 0
+    assert t.epochs_per_sec is None or t.epochs_per_sec > 0
+
+
+def test_setup_logging_idempotent():
+    setup_logging()
+    root = logging.getLogger("yuma_simulation_tpu")
+    n = len(root.handlers)
+    setup_logging()
+    assert len(root.handlers) == n
+
+
+def test_checkpointed_sweep_survives_stale_tmp(tmp_path):
+    # A crash between write and rename leaves a partial file behind; it
+    # must be ignored and its chunk recomputed.
+    sweep = CheckpointedSweep(tmp_path, num_chunks=2)
+    (tmp_path / "partial_00001.tmp").write_bytes(b"garbage")
+    out = sweep.run(lambda i: np.full((1, 2), i, np.float32))
+    assert out.shape == (2, 2)
+    assert sweep.completed_chunks() == [0, 1]

@@ -92,7 +92,9 @@ def yuma_epoch(
       miner_mask: optional `[M]` 0/1 mask for padded miner columns in
         heterogeneous `vmap` batches.
       consensus_impl: "bisect" (default; iteration-exact with the
-        reference) or "sorted" (closed-form sort-based fast path).
+        reference), "sorted" (closed-form sort-based fast path), or
+        "pallas" (fused VMEM-resident bisection kernel, TPU; falls back
+        to the interpreter off-TPU). All three produce identical values.
       precision_config: matmul precision for the stake contractions.
     """
     config = config if config is not None else YumaConfig()
@@ -110,6 +112,18 @@ def yuma_epoch(
     if consensus_impl == "sorted":
         C_raw = stake_weighted_median_sorted(
             W_n, S_n, config.kappa, config.consensus_precision
+        )
+    elif consensus_impl == "pallas":
+        from yuma_simulation_tpu.ops.pallas_consensus import (
+            stake_weighted_median_pallas,
+        )
+
+        C_raw = stake_weighted_median_pallas(
+            W_n,
+            S_n,
+            config.kappa,
+            config.consensus_precision,
+            interpret=jax.default_backend() != "tpu",
         )
     else:
         C_raw = stake_weighted_median(

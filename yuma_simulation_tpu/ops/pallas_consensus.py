@@ -1,0 +1,102 @@
+"""Fused Pallas TPU kernel for the consensus bisection.
+
+The bisection in :mod:`yuma_simulation_tpu.ops.consensus` lowers to 17
+XLA ops over the full `[V, M]` array, each a round trip through HBM when
+the array is large. This kernel keeps one `[V, TILE_M]` weight block
+resident in VMEM and runs all 17 halvings on it before moving to the next
+block — a single HBM read of W per epoch, with the support reduction on
+the VPU (8x128 lanes, reduction over the validator sublane axis).
+
+Numerics are identical to the reference loop (reference yumas.py:83-95):
+midpoints are dyadic rationals `k/2^17` (exact in f32), comparisons are
+strict `>` on both the weight and the kappa test, and the returned value
+is the final `c_high`.
+
+The kernel is an opt-in fast path (`consensus_impl="pallas"` on
+`yuma_epoch` / the engine entry points); `interpret=True` runs it on CPU
+for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_SUBLANES = 8
+
+
+def _round_up(x: int, mult: int) -> int:
+    return (x + mult - 1) // mult * mult
+
+
+def _consensus_kernel(kappa_ref, s_ref, w_ref, c_ref, *, iters: int):
+    """One grid step: full bisection for a `[V, TILE_M]` weight block."""
+    W = w_ref[:]  # [V, TILE_M], VMEM-resident for all iterations
+    S = s_ref[:]  # [V, 1]
+    kappa = kappa_ref[0]
+
+    tile = (1, W.shape[1])
+    c_lo = jnp.zeros(tile, W.dtype)
+    c_hi = jnp.ones(tile, W.dtype)
+
+    def body(_, carry):
+        c_lo, c_hi = carry
+        c_mid = (c_hi + c_lo) * 0.5
+        mask = (W > c_mid).astype(W.dtype)  # strict, as the reference
+        support = jnp.sum(mask * S, axis=0, keepdims=True)  # [1, TILE_M]
+        above = support > kappa
+        return jnp.where(above, c_mid, c_lo), jnp.where(above, c_hi, c_mid)
+
+    _, c_hi = jax.lax.fori_loop(0, iters, body, (c_lo, c_hi), unroll=True)
+    c_ref[:] = c_hi
+
+
+@functools.partial(
+    jax.jit, static_argnames=("precision", "tile_m", "interpret")
+)
+def stake_weighted_median_pallas(
+    W: jnp.ndarray,
+    S: jnp.ndarray,
+    kappa,
+    precision: int = 100_000,
+    *,
+    tile_m: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Drop-in for :func:`ops.consensus.stake_weighted_median` on `[V, M]`.
+
+    Pads V to the f32 sublane multiple (zero stake: contributes nothing to
+    support) and M to the miner tile (zero weights: sliced off after), then
+    sweeps miner tiles on a 1-D grid.
+    """
+    if W.ndim != 2:
+        raise ValueError(f"pallas consensus expects [V, M] weights, got {W.shape}")
+    V, M = W.shape
+    dtype = W.dtype
+    iters = int(math.ceil(math.log2(precision)))
+
+    tile = min(tile_m, _round_up(M, _LANES))
+    Vp, Mp = _round_up(V, _SUBLANES), _round_up(M, tile)
+    W_p = jnp.zeros((Vp, Mp), dtype).at[:V, :M].set(W)
+    S_p = jnp.zeros((Vp, 1), dtype).at[:V, 0].set(jnp.asarray(S, dtype))
+    kappa_arr = jnp.reshape(jnp.asarray(kappa, dtype), (1,))
+
+    c = pl.pallas_call(
+        functools.partial(_consensus_kernel, iters=iters),
+        grid=(Mp // tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((Vp, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Vp, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, Mp), dtype),
+        interpret=interpret,
+    )(kappa_arr, S_p, W_p)
+    return c[0, :M]

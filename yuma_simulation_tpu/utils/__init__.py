@@ -1,0 +1,15 @@
+"""Aux subsystems: profiling, checkpointed sweeps, structured logging.
+
+The reference has none of these (SURVEY.md §5: tracing/checkpoint/
+observability all absent — bare prints only); these are the TPU-native
+equivalents sized to this framework's workloads.
+"""
+
+from yuma_simulation_tpu.utils.checkpoint import (  # noqa: F401
+    CheckpointedSweep,
+)
+from yuma_simulation_tpu.utils.profiling import (  # noqa: F401
+    profile_trace,
+    timed,
+)
+from yuma_simulation_tpu.utils.logging import setup_logging  # noqa: F401

@@ -1,0 +1,102 @@
+"""Checkpoint / resume for long Monte-Carlo sweeps.
+
+The reference holds all state in loop locals and writes outputs once at
+the end (SURVEY.md §5: checkpoint/resume absent). Sweeps here are pure
+and deterministic, so recovery = re-run the missing shards: the sweep is
+split into chunks, each chunk's reduced output is written as an `.npz`
+snapshot keyed by chunk index, and a resumed run skips chunks whose
+snapshot already exists. Orbax is unnecessary at these sizes — outputs
+are `[chunk, V]` dividend totals, not model state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import pathlib
+from typing import Callable, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class CheckpointedSweep:
+    """Chunked, resumable sweep driver.
+
+    `fn(chunk_index) -> np.ndarray` computes one chunk (typically a
+    `shard_map`'d Monte-Carlo batch). `run()` executes all chunks not yet
+    on disk, snapshots each, and returns the concatenated `[total, ...]`
+    result. Metadata (`num_chunks`, user `tag`) is pinned in
+    `manifest.json` and validated on resume so a stale directory cannot
+    silently mix configurations.
+    """
+
+    directory: str | pathlib.Path
+    num_chunks: int
+    tag: str = ""
+
+    def __post_init__(self) -> None:
+        self.directory = pathlib.Path(self.directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        manifest = self.directory / "manifest.json"
+        meta = {"num_chunks": self.num_chunks, "tag": self.tag}
+        if manifest.exists():
+            found = json.loads(manifest.read_text())
+            if found != meta:
+                raise ValueError(
+                    f"checkpoint dir {self.directory} holds a different "
+                    f"sweep: {found} != {meta}"
+                )
+        else:
+            manifest.write_text(json.dumps(meta))
+
+    def _chunk_path(self, i: int) -> pathlib.Path:
+        return self.directory / f"chunk_{i:05d}.npz"
+
+    def completed_chunks(self) -> list[int]:
+        done = []
+        for p in self.directory.glob("chunk_*.npz"):
+            # A crash can leave partial files behind; only fully published
+            # chunks (exact chunk_NNNNN.npz names) count.
+            tail = p.stem.split("_", 1)[1]
+            if tail.isdigit():
+                done.append(int(tail))
+        return sorted(done)
+
+    def run(
+        self,
+        fn: Callable[[int], np.ndarray],
+        *,
+        progress: Optional[Callable[[int, int], None]] = None,
+    ) -> np.ndarray:
+        """Execute missing chunks, snapshot each, return all results
+        concatenated along axis 0 in chunk order."""
+        done = set(self.completed_chunks())
+        if done:
+            logger.info(
+                "resuming sweep in %s: %d/%d chunks already done",
+                self.directory,
+                len(done),
+                self.num_chunks,
+            )
+        for i in range(self.num_chunks):
+            if i in done:
+                continue
+            result = np.asarray(fn(i))
+            # Write to a name the completed-chunk glob cannot match, then
+            # publish atomically. savez gets an open handle so it cannot
+            # append its own .npz suffix to the temp name.
+            tmp = self.directory / f"partial_{i:05d}.tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, result=result)
+            tmp.rename(self._chunk_path(i))
+            if progress is not None:
+                progress(i, self.num_chunks)
+        parts = [
+            np.load(self._chunk_path(i))["result"]
+            for i in range(self.num_chunks)
+        ]
+        return np.concatenate(parts, axis=0)

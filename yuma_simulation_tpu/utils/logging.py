@@ -1,0 +1,37 @@
+"""Structured logging setup (the reference uses bare prints,
+SURVEY.md §5)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def setup_logging(level: int = logging.INFO) -> None:
+    """Configure framework-wide logging once, idempotently.
+
+    Multi-host aware: non-zero JAX processes log at WARNING so a pod run
+    emits one progress stream instead of `process_count` interleaved ones.
+    The process index is only consulted when distributed mode is already
+    initialized — `jax.process_index()` would otherwise initialize the
+    local-only backend and break a later `initialize_distributed` call.
+    """
+    root = logging.getLogger("yuma_simulation_tpu")
+    if root.handlers:
+        return
+    try:
+        import jax
+
+        if jax.distributed.is_initialized() and jax.process_index() != 0:
+            level = max(level, logging.WARNING)
+    except Exception:
+        pass
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s",
+            datefmt="%H:%M:%S",
+        )
+    )
+    root.addHandler(handler)
+    root.setLevel(level)

@@ -1,0 +1,67 @@
+"""Profiling hooks: jax.profiler traces + wall-clock counters.
+
+SURVEY.md §5 calls for `jax.profiler` trace hooks and epochs-per-second
+counters around the scan — the replacement for the reference's total lack
+of instrumentation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Wrap a region in a `jax.profiler` trace (Perfetto/XPlane dump).
+
+    No-op when `log_dir` is None, so call sites can thread a CLI flag
+    straight through.
+    """
+    if log_dir is None:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+    logger.info("profiler trace written to %s", log_dir)
+
+
+@dataclass
+class timed:
+    """Context manager measuring a block; optionally derives epochs/sec.
+
+    >>> with timed("scan", epochs=10_000) as t:
+    ...     run()
+    >>> t.seconds, t.epochs_per_sec
+    """
+
+    label: str = "block"
+    epochs: Optional[int] = None
+    seconds: float = field(default=0.0, init=False)
+
+    def __enter__(self) -> "timed":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.seconds = time.perf_counter() - self._t0
+        if exc[0] is None:
+            logger.info("%s: %.3fs%s", self.label, self.seconds, self._rate())
+
+    def _rate(self) -> str:
+        if self.epochs is None or self.seconds == 0:
+            return ""
+        return f" ({self.epochs / self.seconds:,.0f} epochs/s)"
+
+    @property
+    def epochs_per_sec(self) -> Optional[float]:
+        if self.epochs is None or self.seconds == 0:
+            return None
+        return self.epochs / self.seconds
