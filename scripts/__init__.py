@@ -1,0 +1,1 @@
+"""Entry-point scripts (installable console scripts, see pyproject.toml)."""

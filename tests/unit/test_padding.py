@@ -1,0 +1,67 @@
+"""Heterogeneous-suite padding: batched == individual, padding inert.
+
+SURVEY.md §7 hard part (e): padded miner columns must contribute zero
+weight everywhere (and not perturb the u16 consensus grid of real
+miners); padded validators zero stake; padded epochs zero dividends.
+"""
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.scenarios import create_case
+from yuma_simulation_tpu.scenarios.synthetic import random_subnet_scenario
+from yuma_simulation_tpu.simulation.engine import simulate
+from yuma_simulation_tpu.simulation.sweep import pad_scenarios, total_dividends_batch
+
+
+@pytest.fixture(scope="module")
+def hetero_suite():
+    return [
+        create_case("Case 1"),  # 40e x 3v x 2m
+        random_subnet_scenario(1, num_validators=5, num_miners=7, num_epochs=30),
+        random_subnet_scenario(2, num_validators=4, num_miners=3, num_epochs=40),
+    ]
+
+
+def test_pad_scenarios_shapes(hetero_suite):
+    W, S, ri, re, mask = pad_scenarios(hetero_suite)
+    assert W.shape == (3, 40, 5, 7)
+    assert S.shape == (3, 40, 5)
+    assert mask.shape == (3, 7)
+    np.testing.assert_array_equal(np.asarray(mask[0]), [1, 1, 0, 0, 0, 0, 0])
+    # padded epochs of the 30-epoch scenario carry zero stake
+    assert float(np.abs(np.asarray(S[1, 30:])).max()) == 0.0
+
+
+@pytest.mark.parametrize(
+    "version",
+    [
+        "Yuma 0 (subtensor)",
+        "Yuma 1 (paper)",
+        "Yuma 1 (paper) - liquid alpha on",
+        "Yuma 2 (Adrian-Fish)",
+        "Yuma 3 (Rhef)",
+        "Yuma 4 (Rhef+relative bonds)",
+        "Yuma 4 (Rhef+relative bonds) - liquid alpha on",
+    ],
+)
+def test_padded_batch_matches_individual(hetero_suite, version):
+    # The liquid variants exercise the masked quantile path: padded zero
+    # columns must not shift the 0.25/0.75 consensus quantiles.
+    config = None
+    if "liquid" in version:
+        from yuma_simulation_tpu.models.config import YumaConfig, YumaParams
+
+        config = YumaConfig(yuma_params=YumaParams(liquid_alpha=True))
+    batched = total_dividends_batch(hetero_suite, version, config)
+    for i, s in enumerate(hetero_suite):
+        solo = simulate(
+            s, version, config, save_bonds=False, save_incentives=False
+        ).dividends.sum(axis=0)
+        v = len(s.validators)
+        np.testing.assert_allclose(
+            batched[i, :v], solo, rtol=2e-5, atol=2e-6,
+            err_msg=f"{version} scenario {i}",
+        )
+        if batched.shape[1] > v:
+            assert float(np.abs(batched[i, v:]).max()) == 0.0

@@ -172,6 +172,7 @@ def yuma_epoch(
             config.alpha_high,
             override_consensus_high=config.override_consensus_high,
             override_consensus_low=config.override_consensus_low,
+            miner_mask=miner_mask,
         )
 
     if bonds_mode in _EMA_MODES:

@@ -30,6 +30,23 @@ def _logit(x) -> float:
     return math.log(1.0 / x - 1.0)
 
 
+def _masked_quantile(C: jnp.ndarray, q: float, mask: jnp.ndarray) -> jnp.ndarray:
+    """`jnp.quantile(C[mask], q)` with a traced mask: linear interpolation
+    at position `q * (n - 1)` over the real entries only, so padded miner
+    columns cannot shift the liquid-alpha quantiles."""
+    dtype = C.dtype
+    vals = jnp.where(mask.astype(bool), C, jnp.asarray(jnp.inf, dtype))
+    s = jnp.sort(vals, axis=-1)
+    n = mask.astype(dtype).sum(axis=-1)
+    p = jnp.asarray(q, dtype) * (n - 1.0)
+    lo = jnp.floor(p).astype(jnp.int32)
+    hi = jnp.ceil(p).astype(jnp.int32)
+    frac = p - lo.astype(dtype)
+    v_lo = jnp.take_along_axis(s, lo[..., None], axis=-1)[..., 0]
+    v_hi = jnp.take_along_axis(s, hi[..., None], axis=-1)[..., 0]
+    return v_lo * (1.0 - frac) + v_hi * frac
+
+
 def liquid_alpha_rate(
     C: jnp.ndarray,
     alpha_low,
@@ -37,6 +54,7 @@ def liquid_alpha_rate(
     *,
     override_consensus_high: Optional[float] = None,
     override_consensus_low: Optional[float] = None,
+    miner_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Per-miner EMA rate from quantized consensus.
 
@@ -45,6 +63,8 @@ def liquid_alpha_rate(
       alpha_low / alpha_high: sigmoid clamp bounds (static floats in the
         reference; traced scalars are also supported for sweeps).
       override_consensus_high / low: optional static quantile overrides.
+      miner_mask: optional `[..., M]` 0/1 mask; quantiles are then taken
+        over real miners only (padded suites).
 
     Returns:
       `(bond_alpha[..., M], a, b)` where `a`, `b` are the fitted logistic
@@ -52,20 +72,23 @@ def liquid_alpha_rate(
     """
     dtype = C.dtype
 
+    def quant(q):
+        if miner_mask is None:
+            return jnp.quantile(C, q, axis=-1)
+        return _masked_quantile(C, q, miner_mask)
+
     if override_consensus_high is not None:
         c_high = jnp.asarray(override_consensus_high, dtype)
     else:
-        c_high = jnp.quantile(C, 0.75, axis=-1)
+        c_high = quant(0.75)
     if override_consensus_low is not None:
         c_low = jnp.asarray(override_consensus_low, dtype)
     else:
-        c_low = jnp.quantile(C, 0.25, axis=-1)
+        c_low = quant(0.25)
 
     if override_consensus_high is None:
         # Degenerate spread: fall back to the 0.99 quantile (yumas.py:132-133).
-        c_high = jnp.where(
-            c_high == c_low, jnp.quantile(C, 0.99, axis=-1), c_high
-        )
+        c_high = jnp.where(c_high == c_low, quant(0.99), c_high)
 
     if isinstance(alpha_high, (int, float)) and isinstance(alpha_low, (int, float)):
         logit_high = _logit(alpha_high)

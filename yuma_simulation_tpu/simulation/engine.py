@@ -83,6 +83,7 @@ def _simulate_scan(
     save_incentives: bool = True,
     save_consensus: bool = False,
     consensus_impl: str = "bisect",
+    miner_mask: Optional[jnp.ndarray] = None,  # [M] 1=real, 0=padding
 ):
     E, V, M = weights.shape
     dtype = weights.dtype
@@ -114,6 +115,7 @@ def _simulate_scan(
             W_prev=kernel_prev,
             first_epoch=first,
             consensus_impl=consensus_impl,
+            miner_mask=miner_mask,
         )
 
         B_next = res[spec.bond_state_key]

@@ -30,6 +30,55 @@ from yuma_simulation_tpu.scenarios.base import Scenario
 from yuma_simulation_tpu.simulation.engine import _simulate_scan, simulate_constant
 
 
+def _reset_metadata(scenarios: Sequence[Scenario]):
+    """`([B] reset_index, [B] reset_epoch)` with -1 sentinels for None."""
+    r_idx = jnp.asarray(
+        [-1 if s.reset_bonds_index is None else s.reset_bonds_index for s in scenarios],
+        jnp.int32,
+    )
+    r_epoch = jnp.asarray(
+        [-1 if s.reset_bonds_epoch is None else s.reset_bonds_epoch for s in scenarios],
+        jnp.int32,
+    )
+    return r_idx, r_epoch
+
+
+def pad_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
+    """Pad a heterogeneous suite to a shared `[B, E, V, M]` shape.
+
+    Padding is appended: extra epochs get zero weights *and* zero stakes
+    (the dividend conversion's `stake > 1e-6` guard then yields exactly
+    zero dividends for them, so totals are unchanged); extra validators
+    get zero stake; extra miner columns get zero weight and are excluded
+    from consensus quantization via the returned per-scenario miner mask
+    (SURVEY.md §7 hard part (e): a padded column must not perturb the u16
+    grid of real miners).
+
+    Returns `(W[B,E,V,M], S[B,E,V], reset_index[B], reset_epoch[B],
+    miner_mask[B,M])`.
+    """
+    E = max(s.weights.shape[0] for s in scenarios)
+    V = max(s.weights.shape[1] for s in scenarios)
+    M = max(s.weights.shape[2] for s in scenarios)
+    B = len(scenarios)
+    W = np.zeros((B, E, V, M), np.float32)
+    S = np.zeros((B, E, V), np.float32)
+    mask = np.zeros((B, M), np.float32)
+    for i, s in enumerate(scenarios):
+        e, v, m = s.weights.shape
+        W[i, :e, :v, :m] = s.weights
+        S[i, :e, :v] = s.stakes
+        mask[i, :m] = 1.0
+    r_idx, r_epoch = _reset_metadata(scenarios)
+    return (
+        jnp.asarray(W, dtype),
+        jnp.asarray(S, dtype),
+        r_idx,
+        r_epoch,
+        jnp.asarray(mask, dtype),
+    )
+
+
 def stack_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
     """Stack same-shaped scenarios into `[B, E, V, M]` / `[B, E, V]` arrays
     plus reset metadata vectors. Heterogeneous suites must be padded first
@@ -39,14 +88,7 @@ def stack_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
         raise ValueError(f"scenarios must share one [E,V,M] shape, got {shapes}")
     W = jnp.asarray(np.stack([s.weights for s in scenarios]), dtype)
     S = jnp.asarray(np.stack([s.stakes for s in scenarios]), dtype)
-    r_idx = jnp.asarray(
-        [-1 if s.reset_bonds_index is None else s.reset_bonds_index for s in scenarios],
-        jnp.int32,
-    )
-    r_epoch = jnp.asarray(
-        [-1 if s.reset_bonds_epoch is None else s.reset_bonds_epoch for s in scenarios],
-        jnp.int32,
-    )
+    r_idx, r_epoch = _reset_metadata(scenarios)
     return W, S, r_idx, r_epoch
 
 
@@ -64,9 +106,10 @@ def simulate_batch(
     save_bonds: bool = False,
     save_incentives: bool = False,
     consensus_impl: str = "bisect",
+    miner_mask: Optional[jnp.ndarray] = None,  # [B, M] for padded suites
 ):
     """One `vmap` over the scenario axis; shared (unbatched) config."""
-    fn = lambda W, S, ri, re: _simulate_scan(  # noqa: E731
+    fn = lambda W, S, ri, re, mm: _simulate_scan(  # noqa: E731
         W,
         S,
         ri,
@@ -77,8 +120,13 @@ def simulate_batch(
         save_incentives=save_incentives,
         save_consensus=False,
         consensus_impl=consensus_impl,
+        miner_mask=mm,
     )
-    return jax.vmap(fn)(weights, stakes, reset_index, reset_epoch)
+    if miner_mask is None:
+        return jax.vmap(lambda W, S, ri, re: fn(W, S, ri, re, None))(
+            weights, stakes, reset_index, reset_epoch
+        )
+    return jax.vmap(fn)(weights, stakes, reset_index, reset_epoch, miner_mask)
 
 
 def sweep_hyperparams(
@@ -167,9 +215,18 @@ def total_dividends_batch(
     dtype=jnp.float32,
 ) -> np.ndarray:
     """`[B, V]` total dividends for a stacked scenario suite — the batched
-    equivalent of summing the reference driver's per-epoch output."""
+    equivalent of summing the reference driver's per-epoch output.
+
+    Same-shaped suites run unpadded; heterogeneous suites are padded via
+    :func:`pad_scenarios` (rows then cover `max(V)` validators — entries
+    beyond a scenario's own validator count are zero).
+    """
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
-    W, S, ri, re = stack_scenarios(scenarios, dtype)
-    ys = simulate_batch(W, S, ri, re, config, spec)
+    if len({s.weights.shape for s in scenarios}) == 1:
+        W, S, ri, re = stack_scenarios(scenarios, dtype)
+        ys = simulate_batch(W, S, ri, re, config, spec)
+    else:
+        W, S, ri, re, mask = pad_scenarios(scenarios, dtype)
+        ys = simulate_batch(W, S, ri, re, config, spec, miner_mask=mask)
     return np.asarray(ys["dividends"].sum(axis=1))
