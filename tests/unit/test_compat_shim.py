@@ -1,0 +1,84 @@
+"""The drop-in `yuma_simulation` compatibility package.
+
+Code written against the reference's import paths must run unchanged:
+this test is written exactly as a reference user would write it
+(cf. reference scripts/charts_table_generator.py:1-9 and
+tests/unit/api/api_test.py:1-26).
+"""
+
+import numpy as np
+from bs4 import BeautifulSoup
+
+
+def test_reference_style_imports_and_run():
+    from yuma_simulation._internal.cases import cases
+    from yuma_simulation._internal.simulation_utils import run_simulation
+    from yuma_simulation._internal.yumas import (
+        YumaConfig,
+        YumaParams,
+        YumaSimulationNames,
+        SimulationHyperparameters,
+    )
+
+    assert len(cases) == 14
+    names = YumaSimulationNames()
+    config = YumaConfig(
+        simulation=SimulationHyperparameters(bond_penalty=0.5),
+        yuma_params=YumaParams(),
+    )
+    dividends, bonds, incentives = run_simulation(
+        case=cases[0], yuma_version=names.YUMA2, yuma_config=config
+    )
+    assert set(dividends) == set(cases[0].validators)
+    assert len(bonds) == cases[0].num_epochs
+
+
+def test_reference_style_chart_table():
+    from yuma_simulation._internal.cases import cases
+    from yuma_simulation._internal.yumas import (
+        SimulationHyperparameters,
+        YumaParams,
+    )
+    from yuma_simulation.v1.api import generate_chart_table
+
+    html = generate_chart_table(
+        cases[:1],
+        [("Yuma 1 (paper)", YumaParams())],
+        SimulationHyperparameters(bond_penalty=0.99),
+        draggable_table=True,
+    )
+    soup = BeautifulSoup(html.data, "html.parser")
+    imgs = soup.find_all("img")
+    assert len(imgs) >= 1
+    assert all(i["src"].startswith("data:image/png;base64,") for i in imgs)
+
+
+def test_reference_style_kernel_call():
+    from yuma_simulation._internal.yumas import Yuma, YumaConfig
+
+    W = np.array([[0.7, 0.3], [0.2, 0.8], [0.4, 0.6]], np.float32)
+    S = np.array([0.8, 0.1, 0.1], np.float32)
+    res = Yuma(W, S, None, YumaConfig())
+    assert "validator_ema_bond" in res and "server_incentive" in res
+    np.testing.assert_allclose(float(res["server_incentive"].sum()), 1.0, atol=1e-5)
+
+
+def test_reference_style_plotters():
+    from yuma_simulation._internal.charts_utils import (
+        _calculate_total_dividends,
+        _plot_dividends,
+    )
+
+    totals, pct = _calculate_total_dividends(
+        ["A", "B"], {"A": [1.0, 2.0], "B": [2.0, 2.0]}, "A", 2
+    )
+    assert totals == {"A": 3.0, "B": 4.0}
+    img = _plot_dividends(
+        num_epochs=2,
+        validators=["A", "B"],
+        dividends_per_validator={"A": [1.0, 2.0], "B": [2.0, 2.0]},
+        case="smoke",
+        base_validator="A",
+        to_base64=True,
+    )
+    assert img.startswith('<img src="data:image/png;base64,')

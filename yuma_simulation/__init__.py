@@ -1,0 +1,12 @@
+"""Drop-in compatibility package for the reference `yuma_simulation`.
+
+Users of the reference package can switch to the TPU framework without
+changing imports: the module paths, public names and signatures mirror the
+reference's layout (`yuma_simulation.v1.api`,
+`yuma_simulation._internal.{yumas,cases,simulation_utils,charts_utils}` —
+reference src/yuma_simulation/), every entry point backed by the
+JAX/XLA/Pallas engine in :mod:`yuma_simulation_tpu`.
+
+The reference's top-level ``__init__`` is empty (ApiVer contract,
+reference README.md:10-18); so is this one.
+"""

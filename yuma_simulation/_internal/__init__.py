@@ -1,0 +1,2 @@
+"""Internal namespace mirror (empty, as the reference's
+_internal/__init__.py)."""

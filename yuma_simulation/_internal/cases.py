@@ -1,0 +1,18 @@
+"""Reference-compatible `_internal.cases` (reference cases.py), TPU-backed.
+
+`cases` is the instantiated default suite in registration order
+(cases.py:601); `register_case` / `create_case` / `class_registry` mirror
+the factory API (cases.py:6-48). `BaseCase` aliases the dense-array
+`Scenario` spec, which still exposes the reference's `weights_epochs` /
+`stakes_epochs` list-of-arrays views (cases.py:27-35).
+"""
+
+from yuma_simulation_tpu.scenarios import (  # noqa: F401
+    BaseCase,
+    Scenario,
+    cases,
+    class_registry,
+    create_case,
+    get_cases,
+    register_case,
+)

@@ -1,0 +1,17 @@
+"""Reference-compatible `_internal.charts_utils`
+(reference charts_utils.py), TPU-backed.
+
+The four plotters keep their underscored reference names and signatures
+(charts_utils.py:48, 125, 201, 304); `_calculate_total_dividends` matches
+15-45.
+"""
+
+from yuma_simulation_tpu.reporting.charts import (
+    plot_bonds as _plot_bonds,  # noqa: F401
+    plot_dividends as _plot_dividends,  # noqa: F401
+    plot_incentives as _plot_incentives,  # noqa: F401
+    plot_validator_server_weights as _plot_validator_server_weights,  # noqa: F401
+)
+from yuma_simulation_tpu.reporting.tables import (
+    calculate_total_dividends as _calculate_total_dividends,  # noqa: F401
+)

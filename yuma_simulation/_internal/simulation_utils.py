@@ -1,0 +1,14 @@
+"""Reference-compatible `_internal.simulation_utils`
+(reference simulation_utils.py), TPU-backed.
+
+`run_simulation` keeps the reference's signature and return triple
+(simulation_utils.py:26-112); the table builders keep their underscored
+names (115-316); `generate_total_dividends_table` matches 319-381.
+"""
+
+from yuma_simulation_tpu.reporting.tables import (
+    generate_draggable_html_table as _generate_draggable_html_table,  # noqa: F401
+    generate_ipynb_table as _generate_ipynb_table,  # noqa: F401
+    generate_total_dividends_table,  # noqa: F401
+)
+from yuma_simulation_tpu.simulation.engine import run_simulation  # noqa: F401

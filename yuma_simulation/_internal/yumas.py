@@ -1,0 +1,20 @@
+"""Reference-compatible `_internal.yumas` (reference yumas.py), TPU-backed.
+
+The five kernel functions keep the reference call signatures
+(yumas.py:61, 175, 285, 399, 494) and return the same named-output dicts
+(as jax arrays rather than torch tensors).
+"""
+
+from yuma_simulation_tpu.models.config import (  # noqa: F401
+    SimulationHyperparameters,
+    YumaConfig,
+    YumaParams,
+    YumaSimulationNames,
+)
+from yuma_simulation_tpu.models.variants import (  # noqa: F401
+    Yuma,
+    Yuma2,
+    Yuma3,
+    Yuma4,
+    YumaRust,
+)

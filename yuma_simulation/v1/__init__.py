@@ -1,0 +1,2 @@
+"""ApiVer v1 namespace (empty module docstring, as the reference's
+v1/__init__.py:1-3)."""

@@ -1,0 +1,17 @@
+"""Reference-compatible `yuma_simulation.v1.api`, TPU-backed.
+
+Same public surface as the reference (reference v1/api.py:24-132):
+`generate_chart_table(cases, yuma_versions, yuma_hyperparameters,
+draggable_table) -> IPython HTML`, plus the promotions the new framework
+makes public (`generate_total_dividends_table`, `run_simulation`).
+"""
+
+from yuma_simulation_tpu.v1.api import (  # noqa: F401
+    SimulationHyperparameters,
+    YumaConfig,
+    YumaParams,
+    YumaSimulationNames,
+    generate_chart_table,
+    generate_total_dividends_table,
+    run_simulation,
+)

@@ -1,0 +1,2 @@
+"""Command-line entry points (installed as yuma-charts / yuma-dividends,
+mirrored at the repo's `scripts/` directory for reference-layout parity)."""

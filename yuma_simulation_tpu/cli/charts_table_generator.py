@@ -1,0 +1,68 @@
+"""Generate the per-beta chart-table HTML files.
+
+Equivalent of the reference's `scripts/charts_table_generator.py` (which
+hard-codes its parameters, reference charts_table_generator.py:12-48) with
+a thin CLI on top: sweep values, output dir, case subset and draggable
+mode are flags.
+
+Writes `simulation_results_b{beta}.html` per bond_penalty value.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+
+from yuma_simulation_tpu.models.config import SimulationHyperparameters
+from yuma_simulation_tpu.models.variants import canonical_versions
+from yuma_simulation_tpu.scenarios import create_case, get_cases
+from yuma_simulation_tpu.v1.api import generate_chart_table
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--bond-penalty",
+        nargs="+",
+        default=["0", "0.5", "0.99", "1.0"],
+        help="bond_penalty sweep values; kept as strings so output file "
+        "names match the reference's (b0, b0.5, b0.99, b1.0)",
+    )
+    parser.add_argument(
+        "--cases",
+        nargs="*",
+        default=None,
+        help="registry keys of cases to include, e.g. 'Case 3' "
+        "(default: all registered cases)",
+    )
+    parser.add_argument(
+        "--out-dir", type=pathlib.Path, default=pathlib.Path(".")
+    )
+    parser.add_argument(
+        "--no-draggable",
+        action="store_true",
+        help="emit the notebook-style table instead of the drag-to-scroll one",
+    )
+    args = parser.parse_args(argv)
+
+    if args.cases:
+        cases = [create_case(name) for name in args.cases]
+    else:
+        cases = get_cases()
+
+    args.out_dir.mkdir(parents=True, exist_ok=True)
+    for bond_penalty in args.bond_penalty:
+        hp = SimulationHyperparameters(bond_penalty=float(bond_penalty))
+        table = generate_chart_table(
+            cases,
+            canonical_versions(),
+            hp,
+            draggable_table=not args.no_draggable,
+        )
+        file_name = args.out_dir / f"simulation_results_b{bond_penalty}.html"
+        file_name.write_text(table.data, encoding="utf-8")
+        print(f"HTML saved to {file_name}")
+
+
+if __name__ == "__main__":
+    main()

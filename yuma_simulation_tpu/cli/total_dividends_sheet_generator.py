@@ -1,0 +1,49 @@
+"""Generate the per-beta total-dividends CSV sheets.
+
+Equivalent of the reference's `scripts/total_dividends_sheet_generator.py`
+(reference total_dividends_sheet_generator.py:12-66): same file naming
+(`total_dividends_b{beta}.csv`), same `%.6f` formatting, same NaN check —
+with a CLI for the sweep values and output dir, and each version's 14-case
+suite simulated as one batched XLA computation instead of 14 Python loops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+
+from yuma_simulation_tpu.models.config import SimulationHyperparameters
+from yuma_simulation_tpu.models.variants import canonical_versions
+from yuma_simulation_tpu.reporting.tables import generate_total_dividends_table
+from yuma_simulation_tpu.scenarios import get_cases
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--bond-penalty",
+        nargs="+",
+        default=["0", "0.5", "0.99", "1.0"],
+        help="bond_penalty sweep values; kept as strings so output file "
+        "names match the reference's (b0, b0.5, b0.99, b1.0)",
+    )
+    parser.add_argument(
+        "--out-dir", type=pathlib.Path, default=pathlib.Path(".")
+    )
+    args = parser.parse_args(argv)
+
+    cases = get_cases()
+    args.out_dir.mkdir(parents=True, exist_ok=True)
+    for bond_penalty in args.bond_penalty:
+        print(f"Generating total dividends sheet for bond_penalty={bond_penalty}")
+        hp = SimulationHyperparameters(bond_penalty=float(bond_penalty))
+        df = generate_total_dividends_table(cases, canonical_versions(), hp)
+        if df.isnull().values.any():
+            print("Warning: NaN values detected in the dividends table.")
+        file_name = args.out_dir / f"total_dividends_b{bond_penalty}.csv"
+        df.to_csv(file_name, index=False, float_format="%.6f")
+        print(f"CSV saved to {file_name}")
+
+
+if __name__ == "__main__":
+    main()
