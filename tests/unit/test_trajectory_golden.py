@@ -1,0 +1,48 @@
+"""Per-epoch trajectory parity vs the reference driver.
+
+Totals can mask compensating errors in the carry logic (bond EMA,
+W_prev threading, reset injection); these goldens pin the full `[E, V]`
+dividend time-series and the final bond state for the carry-heavy cases
+(Case 5: reset metadata; Case 9: time-varying stakes; Case 11: reset with
+non-default stakes) across all 9 versions at beta=0.99.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import GOLDEN_DIR
+from yuma_simulation_tpu.models.config import SimulationHyperparameters, YumaConfig
+from yuma_simulation_tpu.models.variants import canonical_versions
+from yuma_simulation_tpu.scenarios import create_case
+from yuma_simulation_tpu.simulation.engine import simulate
+
+_GOLDENS = np.load(os.path.join(GOLDEN_DIR, "trajectory_goldens.npz"))
+_VERSIONS = canonical_versions()
+
+
+@pytest.mark.parametrize("short", ["Case 5", "Case 9", "Case 11"])
+@pytest.mark.parametrize("version_params", _VERSIONS, ids=[v for v, _ in _VERSIONS])
+def test_dividend_trajectory_parity(short, version_params):
+    version, params = version_params
+    case = create_case(short)
+    cfg = YumaConfig(
+        simulation=SimulationHyperparameters(bond_penalty=0.99),
+        yuma_params=params,
+    )
+    res = simulate(case, version, cfg, save_incentives=False)
+
+    golden_div = _GOLDENS[f"{short}/{version}/dividends"]
+    np.testing.assert_allclose(
+        res.dividends, golden_div, rtol=5e-5, atol=2e-6,
+        err_msg=f"{short} x {version} dividends trajectory",
+    )
+    golden_bonds = _GOLDENS[f"{short}/{version}/final_bonds"]
+    np.testing.assert_allclose(
+        res.bonds[-1],
+        golden_bonds,
+        rtol=5e-4,
+        atol=1e-5 * max(1.0, float(np.abs(golden_bonds).max())),
+        err_msg=f"{short} x {version} final bonds",
+    )

@@ -1,0 +1,117 @@
+"""Extended benchmark matrix over the BASELINE.json configurations.
+
+`bench.py` prints the single driver-consumed headline line; this tool
+covers the full config list (small subnet, correctness matrix, vmap'd
+hyperparameter grid, large-subnet stress, sharded Monte-Carlo) and prints
+one JSON line per config. Run on TPU (default) or CPU
+(`jax.config jax_platforms`).
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.variants import canonical_versions, variant_for_version
+from yuma_simulation_tpu.parallel import make_mesh, montecarlo_total_dividends
+from yuma_simulation_tpu.scenarios import get_cases
+from yuma_simulation_tpu.simulation.engine import simulate_constant
+from yuma_simulation_tpu.simulation.sweep import config_grid, sweep_hyperparams, total_dividends_batch
+from yuma_simulation_tpu.scenarios import create_case
+
+
+def _fetch(x):
+    return np.asarray(x)  # forces execution on remote TPU runtimes
+
+
+def _line(name, value, unit, extra=None):
+    rec = {"config": name, "value": round(value, 2), "unit": unit}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def bench_subnet(V, M, epochs, name):
+    rng = np.random.default_rng(0)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 2 (Adrian-Fish)")
+    run = lambda: _fetch(  # noqa: E731
+        simulate_constant(W, S, epochs, cfg, spec, consensus_impl="sorted")[0]
+    )
+    run()
+    t0 = time.perf_counter()
+    run()
+    _line(name, epochs / (time.perf_counter() - t0), "epochs/s")
+
+
+def bench_correctness_matrix():
+    cases = get_cases()
+    t0 = time.perf_counter()
+    for version, params in canonical_versions():
+        cfg = YumaConfig(yuma_params=params)
+        total_dividends_batch(cases, version, cfg)
+    dt = time.perf_counter() - t0
+    _line(
+        "all 9 versions x 14 cases (correctness matrix)",
+        14 * 9 * 40 / dt,
+        "epochs/s",
+        {"wall_s": round(dt, 2)},
+    )
+
+
+def bench_hyperparam_grid():
+    configs, points = config_grid(
+        bond_alpha=[0.025, 0.05, 0.1, 0.2],
+        kappa=[0.3, 0.4, 0.5, 0.6],
+        bond_penalty=[0.0, 0.5, 0.99, 1.0],
+    )
+    case = create_case("Case 2")
+    run = lambda: _fetch(  # noqa: E731
+        sweep_hyperparams(case, "Yuma 1 (paper)", configs)["dividends"]
+    )
+    run()
+    t0 = time.perf_counter()
+    run()
+    dt = time.perf_counter() - t0
+    _line(
+        f"{len(points)}-point bond_alpha x kappa x beta grid (vmap)",
+        len(points) * case.num_epochs / dt,
+        "epochs/s",
+        {"grid_points": len(points), "wall_s": round(dt, 2)},
+    )
+
+
+def bench_montecarlo(num_scenarios=256, epochs=100, V=64, M=1024):
+    mesh = make_mesh()
+    t0 = time.perf_counter()
+    out = montecarlo_total_dividends(
+        jax.random.key(0), num_scenarios, epochs, V, M,
+        "Yuma 1 (paper)", mesh=mesh,
+    )
+    dt = time.perf_counter() - t0
+    assert np.isfinite(out).all()
+    _line(
+        f"Monte-Carlo {num_scenarios} scenarios x {epochs} epochs, "
+        f"{V}v x {M}m (shard_map, incl. compile)",
+        num_scenarios * epochs / dt,
+        "epochs/s",
+        {"devices": len(jax.devices()), "wall_s": round(dt, 2)},
+    )
+
+
+def main():
+    bench_subnet(16, 256, 2048, "small subnet 16v x 256m (Yuma 2)")
+    bench_subnet(256, 4096, 2048, "stress 256v x 4096m (Yuma 2)")
+    bench_correctness_matrix()
+    bench_hyperparam_grid()
+    bench_montecarlo()
+
+
+if __name__ == "__main__":
+    main()

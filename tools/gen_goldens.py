@@ -3,6 +3,8 @@
 Outputs (committed, used by tests/):
   tests/golden/total_dividends_b{beta}.csv  - full 14x9x3 total-dividend surface per beta
   tests/golden/kernel_goldens.npz           - single-epoch kernel outputs on hand inputs
+  tests/golden/trajectory_goldens.npz       - per-epoch dividend series + final bonds
+                                              (Cases 5/9/11 x 9 versions, beta=0.99)
 """
 import sys
 sys.path.insert(0, "/root/reference/src")
@@ -77,6 +79,28 @@ def main():
                 out[f"{tag}/y2p/{k}"] = v.detach().numpy()
     np.savez("tests/golden/kernel_goldens.npz", **out)
     print("kernel goldens:", len(out), "arrays")
+
+    # Per-epoch trajectory goldens: full dividend time-series through the
+    # reference driver, for cases exercising the carry logic (Case 5 has
+    # reset metadata, Case 9 varies stakes over time, Case 11 resets with
+    # non-default stakes) x all 9 versions.
+    from yuma_simulation._internal.simulation_utils import run_simulation
+    traj = {}
+    case_by_name = {c.name.split(" -")[0]: c for c in cases}
+    for short in ("Case 5", "Case 9", "Case 11"):
+        case = case_by_name[short]
+        for version, params in versions():
+            cfg = YumaConfig(
+                simulation=SimulationHyperparameters(bond_penalty=0.99),
+                yuma_params=params,
+            )
+            div, bonds, _ = run_simulation(case, version, cfg)
+            arr = np.asarray([[div[v][e] for v in case.validators]
+                              for e in range(case.num_epochs)])
+            traj[f"{short}/{version}/dividends"] = arr
+            traj[f"{short}/{version}/final_bonds"] = bonds[-1].numpy()
+    np.savez("tests/golden/trajectory_goldens.npz", **traj)
+    print("trajectory goldens:", len(traj), "arrays")
 
 if __name__ == "__main__":
     main()
