@@ -31,10 +31,22 @@ V, M = 256, 4096
 #: ~45,000x the reference's per-miner Python loop.
 _CONSENSUS_IMPL = "sorted"
 
+#: The benchmark workload holds weights constant across epochs (as the
+#: reference's measured baseline did), so the consensus front half is
+#: epoch-invariant; hoisting it out of the scan is bit-identical to the
+#: in-scan form (pinned by tests) and ~2x faster again.
+_HOIST = True
+
 
 def _run(n_epochs: int, W, S, config, spec):
     total, bonds = simulate_constant(
-        W, S, n_epochs, config, spec, consensus_impl=_CONSENSUS_IMPL
+        W,
+        S,
+        n_epochs,
+        config,
+        spec,
+        consensus_impl=_CONSENSUS_IMPL,
+        hoist_invariant=_HOIST,
     )
     # np.asarray forces the device->host fetch of the [V] totals; on remote
     # TPU runtimes block_until_ready alone can return before execution.

@@ -1,0 +1,51 @@
+"""The hoisted constant-weights fast path matches the in-scan form.
+
+Identical update ops on identical values; agreement is exact at most
+scan lengths and within one f32 ULP otherwise (XLA fuses very short
+scans differently, which perturbs the *baseline*, not the hoist).
+Parametrized over all nine canonical versions so the liquid-alpha
+rate derivation is exercised on every bonds family."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.variants import canonical_versions, variant_for_version
+from yuma_simulation_tpu.simulation.engine import simulate_constant
+
+_VERSIONS = canonical_versions()
+
+
+@pytest.mark.parametrize(
+    "version_params", _VERSIONS, ids=[v for v, _ in _VERSIONS]
+)
+@pytest.mark.parametrize("n", [1, 2, 17])
+def test_hoisted_matches_scan(version_params, n):
+    version, params = version_params
+    rng = np.random.default_rng(4)
+    W = jnp.asarray(rng.random((8, 16)), jnp.float32)
+    S = jnp.asarray(rng.random(8) + 0.01, jnp.float32)
+    config = YumaConfig(yuma_params=params)
+    spec = variant_for_version(version)
+    total_a, bonds_a = simulate_constant(W, S, n, config, spec)
+    total_b, bonds_b = simulate_constant(
+        W, S, n, config, spec, hoist_invariant=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(total_a), np.asarray(total_b), rtol=1e-6, atol=1e-8
+    )
+    np.testing.assert_allclose(
+        np.asarray(bonds_a), np.asarray(bonds_b), rtol=1e-6,
+        atol=1e-6 * max(1.0, float(np.abs(np.asarray(bonds_a)).max())),
+    )
+
+
+def test_hoisted_rejects_zero_epochs():
+    rng = np.random.default_rng(4)
+    W = jnp.asarray(rng.random((4, 8)), jnp.float32)
+    S = jnp.asarray(rng.random(4) + 0.01, jnp.float32)
+    spec = variant_for_version("Yuma 1 (paper)")
+    with pytest.raises(ValueError, match="num_epochs"):
+        simulate_constant(W, S, 0, YumaConfig(), spec, hoist_invariant=True)

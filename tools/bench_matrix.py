@@ -52,14 +52,16 @@ def bench_subnet(V, M, epochs, name):
 
 def bench_correctness_matrix():
     cases = get_cases()
+    versions = canonical_versions()
     t0 = time.perf_counter()
-    for version, params in canonical_versions():
+    for version, params in versions:
         cfg = YumaConfig(yuma_params=params)
         total_dividends_batch(cases, version, cfg)
     dt = time.perf_counter() - t0
+    total_epochs = sum(c.num_epochs for c in cases) * len(versions)
     _line(
-        "all 9 versions x 14 cases (correctness matrix)",
-        14 * 9 * 40 / dt,
+        f"all {len(versions)} versions x {len(cases)} cases (correctness matrix)",
+        total_epochs / dt,
         "epochs/s",
         {"wall_s": round(dt, 2)},
     )

@@ -61,6 +61,66 @@ def _rate_vm(rate, like: jnp.ndarray) -> jnp.ndarray:
     return rate  # 0-dim and [M] both broadcast correctly against [V, M]
 
 
+# --- per-epoch bonds updates, shared by the kernel and the hoisted scan ---
+#
+# Each takes the carried bond state plus epoch-invariant precomputations
+# (invariant for *constant weights*, that is — yuma_epoch recomputes them
+# every call) and returns the next bond state. Splitting these out lets
+# `simulate_constant(hoist_invariant=True)` run the consensus front half
+# once and scan only this recurrence.
+
+
+def ema_bonds_target(S_n, W_n, clip_base, W_clipped, config, bonds_mode):
+    """The per-epoch purchase target of the EMA families: column-normalized
+    stake-weighted (blended) bonds (reference yumas.py:113-116, 227-229,
+    341-343). Returns `(B_target, weight_for_bond_or_None)`."""
+    if bonds_mode is BondsMode.EMA_RUST:
+        B = S_n[:, None] * W_clipped
+        B = B / (B.sum(axis=0) + 1e-6)
+        return jnp.nan_to_num(B), None
+    beta = jnp.asarray(config.bond_penalty, W_n.dtype)
+    bond_base = W_n if bonds_mode is BondsMode.EMA else clip_base
+    W_b = (1.0 - beta) * bond_base + beta * W_clipped
+    B = S_n[:, None] * W_b
+    B = B / B.sum(axis=0)  # no epsilon here (yumas.py:228,342)
+    return jnp.nan_to_num(B), W_b
+
+
+def ema_bonds_update(B_target, B_old, rate, first_epoch, renormalize: bool):
+    """EMA toward the target; first epoch adopts the target outright
+    (yumas.py:145); Yuma 0 re-normalizes the EMA (yumas.py:147-149)."""
+    if B_old is None:
+        B_ema = B_target
+    else:
+        ema = rate * B_target + (1.0 - rate) * B_old
+        B_ema = (
+            ema if first_epoch is None else jnp.where(first_epoch, B_target, ema)
+        )
+    if renormalize:
+        B_ema = jnp.nan_to_num(B_ema / (B_ema.sum(axis=0) + 1e-6))
+    return B_ema
+
+
+def capacity_bonds_update(B_prev, W_n, S_n, config):
+    """Yuma 3.x stake-capacity bond purchase (reference yumas.py:455-472)."""
+    dtype = W_n.dtype
+    capacity = S_n * jnp.asarray(MAXINT, dtype)
+    capacity_per_bond = S_n[:, None] * jnp.asarray(MAXINT, dtype)
+    remaining = jnp.clip(capacity_per_bond - B_prev, min=0.0)
+    cap_alpha = (jnp.asarray(config.capacity_alpha, dtype) * capacity)[:, None]
+    purchase = jnp.minimum(cap_alpha, remaining) * W_n
+    B = (1.0 - jnp.asarray(config.decay_rate, dtype)) * B_prev + purchase
+    return jnp.minimum(B, capacity_per_bond)
+
+
+def relative_bonds_update(B_prev, W_n, rate):
+    """Yuma 4 relative bonds in [0, 1] (reference yumas.py:574-586)."""
+    B_decayed = B_prev * (1.0 - rate)
+    remaining = jnp.clip(1.0 - B_decayed, min=0.0)
+    purchase = jnp.minimum(rate * W_n, remaining)
+    return jnp.clip(B_decayed + purchase, max=1.0)
+
+
 def yuma_epoch(
     W: jnp.ndarray,
     S: jnp.ndarray,
@@ -176,28 +236,18 @@ def yuma_epoch(
         )
 
     if bonds_mode in _EMA_MODES:
-        if bonds_mode is BondsMode.EMA_RUST:
-            B = S_n[:, None] * W_clipped
-            B = B / (B.sum(axis=0) + 1e-6)
-            B = jnp.nan_to_num(B)
-        else:
-            beta = jnp.asarray(config.bond_penalty, dtype)
-            bond_base = W_n if bonds_mode is BondsMode.EMA else clip_base
-            W_b = (1.0 - beta) * bond_base + beta * W_clipped
-            B = S_n[:, None] * W_b
-            B = B / B.sum(axis=0)  # no epsilon here (yumas.py:228,342)
-            B = jnp.nan_to_num(B)
+        B, W_b = ema_bonds_target(
+            S_n, W_n, clip_base, W_clipped, config, bonds_mode
+        )
+        if W_b is not None:
             out["weight_for_bond"] = W_b
-
-        rate = _rate_vm(bond_alpha, B)
-        if B_old is None:
-            B_ema = B
-        else:
-            ema = rate * B + (1.0 - rate) * B_old
-            B_ema = ema if first_epoch is None else jnp.where(first_epoch, B, ema)
-        if bonds_mode is BondsMode.EMA_RUST:
-            B_ema = jnp.nan_to_num(B_ema / (B_ema.sum(axis=0) + 1e-6))
-
+        B_ema = ema_bonds_update(
+            B,
+            B_old,
+            _rate_vm(bond_alpha, B),
+            first_epoch,
+            renormalize=bonds_mode is BondsMode.EMA_RUST,
+        )
         D = (B_ema * incentive).sum(axis=-1)
         out.update(
             server_trust=T,
@@ -211,23 +261,13 @@ def yuma_epoch(
 
     elif bonds_mode is BondsMode.CAPACITY:
         B_prev = jnp.zeros_like(W_n) if B_old is None else B_old
-        capacity = S_n * jnp.asarray(MAXINT, dtype)
-        capacity_per_bond = S_n[:, None] * jnp.asarray(MAXINT, dtype)
-        remaining = jnp.clip(capacity_per_bond - B_prev, min=0.0)
-        cap_alpha = (jnp.asarray(config.capacity_alpha, dtype) * capacity)[:, None]
-        purchase = jnp.minimum(cap_alpha, remaining) * W_n
-        B = (1.0 - jnp.asarray(config.decay_rate, dtype)) * B_prev + purchase
-        B = jnp.minimum(B, capacity_per_bond)
+        B = capacity_bonds_update(B_prev, W_n, S_n, config)
         D = (B * incentive).sum(axis=-1)
         out.update(server_trust=T, validator_trust=T_v, validator_bonds=B)
 
     elif bonds_mode is BondsMode.RELATIVE:
         B_prev = jnp.zeros_like(W_n) if B_old is None else B_old
-        rate = _rate_vm(bond_alpha, W_n)
-        B_decayed = B_prev * (1.0 - rate)
-        remaining = jnp.clip(1.0 - B_decayed, min=0.0)
-        purchase = jnp.minimum(rate * W_n, remaining)
-        B = jnp.clip(B_decayed + purchase, max=1.0)
+        B = relative_bonds_update(B_prev, W_n, _rate_vm(bond_alpha, W_n))
         D = S_n * (B * incentive).sum(axis=-1)
         out["validator_bonds"] = B
 

@@ -26,7 +26,15 @@ import numpy as np
 from jax import lax
 
 from yuma_simulation_tpu.models.config import YumaConfig
-from yuma_simulation_tpu.models.epoch import BondsMode, yuma_epoch
+from yuma_simulation_tpu.models.epoch import (
+    _EMA_MODES,
+    BondsMode,
+    capacity_bonds_update,
+    ema_bonds_update,
+    relative_bonds_update,
+    yuma_epoch,
+)
+from yuma_simulation_tpu.ops.liquid import liquid_alpha_rate
 from yuma_simulation_tpu.models.variants import (
     ResetMode,
     VariantSpec,
@@ -219,7 +227,7 @@ def run_simulation(
 
 @partial(
     jax.jit,
-    static_argnames=("num_epochs", "spec", "consensus_impl"),
+    static_argnames=("num_epochs", "spec", "consensus_impl", "hoist_invariant"),
 )
 def simulate_constant(
     W: jnp.ndarray,  # [V, M], constant across epochs
@@ -228,13 +236,30 @@ def simulate_constant(
     config: YumaConfig,
     spec: VariantSpec,
     consensus_impl: str = "bisect",
+    hoist_invariant: bool = False,
 ):
     """Throughput path: fixed weights, total dividends accumulated in-carry.
 
     Returns `total_dividends[V]` (sum over epochs of dividend-per-1000-tao)
     and the final bond state. No per-epoch outputs are materialized, so 10k+
     epoch sweeps at 256x4096 stay well inside HBM.
+
+    `num_epochs` must be >= 1 on the hoisted path (the plain scan form
+    degenerates to zeros at 0 epochs; the hoisted form has no epoch to
+    seed from).
+
+    `hoist_invariant=True` exploits the constant weights: the consensus
+    front half (normalize, bisection, quantize, clip, incentive, liquid
+    alpha) depends only on `(W, S)`, so it runs once and the scan carries
+    only the bonds recurrence + dividend conversion — the same update ops
+    on the same values (agreement exact up to XLA's own fusion-dependent
+    ULP at very short scan lengths), ~2x faster at 256x4096; XLA does not
+    perform this hoist on its own.
     """
+    if hoist_invariant:
+        return _simulate_constant_hoisted(
+            W, S, num_epochs, config, spec, consensus_impl
+        )
     V, M = W.shape
     dtype = W.dtype
     stakes_units = jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
@@ -283,4 +308,92 @@ def simulate_constant(
     (B, _, _, total), _ = lax.scan(
         step, carry0, jnp.arange(num_epochs, dtype=jnp.int32)
     )
+    return total, B
+
+
+def _simulate_constant_hoisted(
+    W, S, num_epochs: int, config: YumaConfig, spec: VariantSpec,
+    consensus_impl: str,
+):
+    """Constant-weights fast path: one kernel front half + a bonds-only scan.
+
+    Epoch 0 of the full kernel supplies every epoch-invariant quantity
+    (normalized weights/stakes, consensus, clipped weights, incentive,
+    liquid-alpha rate, and — for the EMA families — the purchase target);
+    the scan then applies exactly the per-epoch update helpers the kernel
+    itself uses (:mod:`yuma_simulation_tpu.models.epoch`). Bond resets
+    don't apply (no scenario metadata in the constant path — as in
+    `simulate_constant`'s reset-free scan).
+    """
+    if num_epochs < 1:
+        raise ValueError("hoist_invariant path requires num_epochs >= 1")
+    dtype = W.dtype
+    stakes_units = jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
+
+    # Full kernel once; also the source of the final outputs' first step.
+    res0 = yuma_epoch(
+        W, S, None, config, bonds_mode=spec.bonds_mode,
+        consensus_impl=consensus_impl,
+    )
+    W_n = res0["weight"]
+    S_n = res0["stake"]
+    incentive = res0["server_incentive"]
+    # The EMA rate, exactly as the kernel derives it (epoch.py): the
+    # liquid-alpha fit on this epoch's (invariant) consensus, else the
+    # static scalar. RELATIVE mode doesn't export bond_alpha (the
+    # reference's Yuma4 output dict has no such key, yumas.py:595-606),
+    # so recompute rather than read it back.
+    if config.liquid_alpha and spec.bonds_mode is not BondsMode.CAPACITY:
+        rate, _, _ = liquid_alpha_rate(
+            res0["server_consensus_weight"],
+            config.alpha_low,
+            config.alpha_high,
+            override_consensus_high=config.override_consensus_high,
+            override_consensus_low=config.override_consensus_low,
+        )
+    else:
+        rate = jnp.asarray(config.bond_alpha, dtype)
+
+    def dividends_of(B):
+        if spec.bonds_mode is BondsMode.RELATIVE:
+            D = S_n * (B * incentive).sum(axis=-1)
+        else:
+            D = (B * incentive).sum(axis=-1)
+        D_n = D / (D.sum() + 1e-6)
+        emission = (
+            config.validator_emission_ratio * D_n * config.total_epoch_emission
+        )
+        return jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
+
+    if spec.bonds_mode in _EMA_MODES:
+        B_target = res0["validator_bond"]
+        renorm = spec.bonds_mode is BondsMode.EMA_RUST
+
+        def step(carry, _):
+            B_ema, acc = carry
+            B_next = ema_bonds_update(B_target, B_ema, rate, None, renorm)
+            return (B_next, acc + dividends_of(B_next)), None
+
+        B0 = res0["validator_ema_bond"]
+    elif spec.bonds_mode is BondsMode.CAPACITY:
+
+        def step(carry, _):
+            B_prev, acc = carry
+            B_next = capacity_bonds_update(B_prev, W_n, S_n, config)
+            return (B_next, acc + dividends_of(B_next)), None
+
+        B0 = res0["validator_bonds"]
+    else:  # RELATIVE
+
+        def step(carry, _):
+            B_prev, acc = carry
+            B_next = relative_bonds_update(B_prev, W_n, rate)
+            return (B_next, acc + dividends_of(B_next)), None
+
+        B0 = res0["validator_bonds"]
+
+    acc0 = dividends_of(B0)
+    if num_epochs == 1:
+        return acc0, B0
+    (B, total), _ = lax.scan(step, (B0, acc0), None, length=num_epochs - 1)
     return total, B
