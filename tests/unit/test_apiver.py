@@ -1,0 +1,31 @@
+"""ApiVer surface lock: the v1 contract the reference intended to test
+(reference tests/unit/api/test_setup.py asserts the v1 module exports
+nothing; its api module surface is frozen)."""
+
+
+def test_v1_namespace_exports_nothing():
+    import yuma_simulation.v1 as compat_v1
+    import yuma_simulation_tpu.v1 as tpu_v1
+
+    for mod in (compat_v1, tpu_v1):
+        assert [n for n in vars(mod) if not n.startswith("__")] in ([], ["api"])
+
+
+def test_v1_api_surface_is_frozen():
+    from yuma_simulation_tpu.v1 import api
+
+    public = sorted(
+        n for n, v in vars(api).items()
+        if not n.startswith("_") and (callable(v) or isinstance(v, type))
+    )
+    assert public == [
+        "HTML",
+        "Scenario",
+        "SimulationHyperparameters",
+        "YumaConfig",
+        "YumaParams",
+        "YumaSimulationNames",
+        "generate_chart_table",
+        "generate_total_dividends_table",
+        "run_simulation",
+    ], public

@@ -58,3 +58,16 @@ def test_checkpointed_sweep_survives_stale_tmp(tmp_path):
     out = sweep.run(lambda i: np.full((1, 2), i, np.float32))
     assert out.shape == (2, 2)
     assert sweep.completed_chunks() == [0, 1]
+
+
+def test_profile_trace(tmp_path):
+    import numpy as np
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.utils import profile_trace
+
+    with profile_trace(None):  # no-op path
+        pass
+    with profile_trace(str(tmp_path / "trace")):
+        np.asarray(jnp.arange(8).sum())
+    assert any((tmp_path / "trace").rglob("*"))

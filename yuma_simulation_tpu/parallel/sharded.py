@@ -33,7 +33,7 @@ from yuma_simulation_tpu.models.epoch import yuma_epoch
 from yuma_simulation_tpu.models.variants import VariantSpec, variant_for_version
 from yuma_simulation_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from yuma_simulation_tpu.scenarios.base import Scenario
-from yuma_simulation_tpu.simulation.engine import _simulate_scan
+from yuma_simulation_tpu.simulation.engine import simulate_constant
 from yuma_simulation_tpu.simulation.sweep import simulate_batch, stack_scenarios
 
 
@@ -175,24 +175,20 @@ def montecarlo_total_dividends(
                     k, base_weights.shape, dtype
                 )
                 W = jax.nn.relu(base_weights + eps)
-                W_e = jnp.broadcast_to(
-                    W, (num_epochs,) + W.shape
-                )
-                S_e = jnp.broadcast_to(
-                    base_stakes, (num_epochs, num_validators)
-                )
-                ys = _simulate_scan(
-                    W_e,
-                    S_e,
-                    jnp.int32(-1),
-                    jnp.int32(-1),
+                # Weights are constant across epochs within one scenario,
+                # so the hoisted path applies: consensus once, bonds
+                # recurrence scanned (same values as the full per-epoch
+                # kernel — pinned by tests/unit/test_hoisted.py).
+                total, _ = simulate_constant(
+                    W,
+                    base_stakes,
+                    num_epochs,
                     config,
                     spec,
-                    save_bonds=False,
-                    save_incentives=False,
-                    save_consensus=False,
+                    consensus_impl="sorted",
+                    hoist_invariant=True,
                 )
-                return ys["dividends"].sum(axis=0)  # [V]
+                return total  # [V]
 
             return jax.vmap(one)(jax.random.split(shard_key, per_shard))
 
