@@ -11,6 +11,19 @@ def test_v1_namespace_exports_nothing():
         assert [n for n in vars(mod) if not n.startswith("__")] in ([], ["api"])
 
 
+_FROZEN_SURFACE = [
+    "HTML",
+    "Scenario",
+    "SimulationHyperparameters",
+    "YumaConfig",
+    "YumaParams",
+    "YumaSimulationNames",
+    "generate_chart_table",
+    "generate_total_dividends_table",
+    "run_simulation",
+]
+
+
 def test_v1_api_surface_is_frozen():
     from yuma_simulation_tpu.v1 import api
 
@@ -18,14 +31,16 @@ def test_v1_api_surface_is_frozen():
         n for n, v in vars(api).items()
         if not n.startswith("_") and (callable(v) or isinstance(v, type))
     )
-    assert public == [
-        "HTML",
-        "Scenario",
-        "SimulationHyperparameters",
-        "YumaConfig",
-        "YumaParams",
-        "YumaSimulationNames",
-        "generate_chart_table",
-        "generate_total_dividends_table",
-        "run_simulation",
-    ], public
+    assert public == _FROZEN_SURFACE, public
+    assert sorted(api.__all__) == _FROZEN_SURFACE
+
+
+def test_compat_v1_api_surface_is_frozen():
+    from yuma_simulation.v1 import api
+
+    public = sorted(
+        n for n, v in vars(api).items()
+        if not n.startswith("_") and (callable(v) or isinstance(v, type))
+    )
+    assert public == _FROZEN_SURFACE, public
+    assert sorted(api.__all__) == _FROZEN_SURFACE

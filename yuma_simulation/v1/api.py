@@ -7,6 +7,8 @@ makes public (`generate_total_dividends_table`, `run_simulation`).
 """
 
 from yuma_simulation_tpu.v1.api import (  # noqa: F401
+    HTML,
+    Scenario,
     SimulationHyperparameters,
     YumaConfig,
     YumaParams,
@@ -15,3 +17,15 @@ from yuma_simulation_tpu.v1.api import (  # noqa: F401
     generate_total_dividends_table,
     run_simulation,
 )
+
+__all__ = [
+    "HTML",
+    "Scenario",
+    "SimulationHyperparameters",
+    "YumaConfig",
+    "YumaParams",
+    "YumaSimulationNames",
+    "generate_chart_table",
+    "generate_total_dividends_table",
+    "run_simulation",
+]

@@ -14,9 +14,8 @@ are reused across all chart rows.
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING
-
 import pandas as pd
+from IPython.display import HTML
 
 from yuma_simulation_tpu.models.config import (  # noqa: F401  (public re-exports)
     SimulationHyperparameters,
@@ -25,14 +24,14 @@ from yuma_simulation_tpu.models.config import (  # noqa: F401  (public re-export
     YumaSimulationNames,
 )
 from yuma_simulation_tpu.reporting.charts import (
-    plot_bonds,
-    plot_dividends,
-    plot_incentives,
-    plot_validator_server_weights,
+    plot_bonds as _plot_bonds,
+    plot_dividends as _plot_dividends,
+    plot_incentives as _plot_incentives,
+    plot_validator_server_weights as _plot_validator_server_weights,
 )
 from yuma_simulation_tpu.reporting.tables import (
-    generate_draggable_html_table,
-    generate_ipynb_table,
+    generate_draggable_html_table as _generate_draggable_html_table,
+    generate_ipynb_table as _generate_ipynb_table,
 )
 from yuma_simulation_tpu.reporting.tables import (  # noqa: F401  (promoted)
     generate_total_dividends_table,
@@ -40,8 +39,20 @@ from yuma_simulation_tpu.reporting.tables import (  # noqa: F401  (promoted)
 from yuma_simulation_tpu.scenarios.base import Scenario
 from yuma_simulation_tpu.simulation.engine import run_simulation  # noqa: F401
 
-if TYPE_CHECKING:  # pragma: no cover
-    from IPython.display import HTML
+#: The frozen ApiVer surface (reference README.md:15-18): exactly these
+#: names are public; everything else in this module is an implementation
+#: detail that may change without notice.
+__all__ = [
+    "HTML",
+    "Scenario",
+    "SimulationHyperparameters",
+    "YumaConfig",
+    "YumaParams",
+    "YumaSimulationNames",
+    "generate_chart_table",
+    "generate_total_dividends_table",
+    "run_simulation",
+]
 
 #: Chart rows rendered per case; cases with `plot_incentives` (Cases 10
 #: and 11 of the built-in suite — the reference keys this off positional
@@ -97,7 +108,7 @@ def generate_chart_table(
                 config, (dividends, bonds, incentives) = per_version[yuma_version]
                 title = _decorated_case_name(case, yuma_version, config)
                 if chart_type == "weights":
-                    img = plot_validator_server_weights(
+                    img = _plot_validator_server_weights(
                         validators=case.validators,
                         weights_epochs=case.weights_epochs,
                         servers=case.servers,
@@ -106,7 +117,7 @@ def generate_chart_table(
                         to_base64=True,
                     )
                 elif chart_type == "dividends":
-                    img = plot_dividends(
+                    img = _plot_dividends(
                         num_epochs=case.num_epochs,
                         validators=case.validators,
                         dividends_per_validator=dividends,
@@ -115,7 +126,7 @@ def generate_chart_table(
                         to_base64=True,
                     )
                 elif chart_type == "bonds":
-                    img = plot_bonds(
+                    img = _plot_bonds(
                         num_epochs=case.num_epochs,
                         validators=case.validators,
                         servers=case.servers,
@@ -124,7 +135,7 @@ def generate_chart_table(
                         to_base64=True,
                     )
                 elif chart_type == "normalized_bonds":
-                    img = plot_bonds(
+                    img = _plot_bonds(
                         num_epochs=case.num_epochs,
                         validators=case.validators,
                         servers=case.servers,
@@ -134,7 +145,7 @@ def generate_chart_table(
                         normalize=True,
                     )
                 elif chart_type == "incentives":
-                    img = plot_incentives(
+                    img = _plot_incentives(
                         servers=case.servers,
                         server_incentives_per_epoch=incentives,
                         num_epochs=case.num_epochs,
@@ -149,12 +160,10 @@ def generate_chart_table(
 
     summary_table = pd.DataFrame(table_data)
     if draggable_table:
-        full_html = generate_draggable_html_table(
+        full_html = _generate_draggable_html_table(
             table_data, summary_table, case_row_ranges
         )
     else:
-        full_html = generate_ipynb_table(table_data, summary_table, case_row_ranges)
-
-    from IPython.display import HTML
+        full_html = _generate_ipynb_table(table_data, summary_table, case_row_ranges)
 
     return HTML(full_html)
