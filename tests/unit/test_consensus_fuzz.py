@@ -1,0 +1,167 @@
+"""Bitwise identity fuzz battery: sorted consensus == bisection == Pallas.
+
+The sorted closed form (`ops/consensus.py::stake_weighted_median_sorted`)
+claims value-identity with the reference bisection semantics
+(reference yumas.py:83-97). This battery proves it bitwise
+(`assert_array_equal`, no tolerance) over >250 generated cases covering
+the edges where an off-by-one-grid-point bug would hide:
+
+- tied weight columns (duplicated validator rows),
+- weights lying exactly on the dyadic 2^-17 grid,
+- stake supports exactly equal to kappa (dyadic stakes, kappa=0.5),
+- all-zero columns / zero-stake validators / the all-zero matrix,
+- kappa in {0.3, 0.5, 0.7} at several shapes and many seeds.
+
+The Pallas kernel runs the same battery (interpret mode on CPU) on a
+per-family subset — it is exercised bitwise at every family, just not at
+every seed, because interpret mode is slow.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.ops.consensus import (
+    stake_weighted_median,
+    stake_weighted_median_sorted,
+)
+from yuma_simulation_tpu.ops.pallas_consensus import stake_weighted_median_pallas
+
+KAPPAS = (0.3, 0.5, 0.7)
+SHAPES = ((3, 2), (4, 8), (5, 7), (16, 32), (8, 130), (32, 64))
+SEEDS_PER_CASE = 12
+GRID = 2.0**-17
+
+
+def _norm_rows(W):
+    s = W.sum(axis=-1, keepdims=True)
+    return np.divide(W, s, out=np.zeros_like(W), where=s > 0)
+
+
+def _random_case(rng, V, M):
+    W = _norm_rows(rng.random((V, M), dtype=np.float32))
+    S = rng.random(V, dtype=np.float32) + 0.01
+    return W, (S / S.sum()).astype(np.float32)
+
+
+def _tied_case(rng, V, M):
+    """Duplicate validator rows so every column has cross-validator ties."""
+    W, S = _random_case(rng, V, M)
+    half = V // 2
+    W[half : 2 * half] = W[:half]
+    return W, S
+
+
+def _grid_case(rng, V, M):
+    """Weights exactly on the 2^-17 bisection grid (exact in f32)."""
+    k = rng.integers(0, 2**17 + 1, size=(V, M))
+    W = (k.astype(np.float64) * GRID).astype(np.float32)
+    S = rng.random(V, dtype=np.float32) + 0.01
+    return W, (S / S.sum()).astype(np.float32)
+
+
+def _kappa_edge_case(rng, V, M):
+    """Dyadic stakes (multiples of 1/64 summing to exactly 1) so partial
+    stake sums land exactly on kappa=0.5 — probing the strict `>` of the
+    support test (reference yumas.py:89-91)."""
+    cuts = np.sort(rng.choice(np.arange(1, 64), size=V - 1, replace=False))
+    parts = np.diff(np.concatenate([[0], cuts, [64]]))
+    S = (parts / 64.0).astype(np.float32)
+    # few distinct weight levels -> many repeated support evaluations
+    levels = rng.choice([0.0, 0.125, 0.25, 0.5, 0.75, 1.0], size=(V, M))
+    return levels.astype(np.float32), S
+
+
+def _zero_case(rng, V, M):
+    W, S = _random_case(rng, V, M)
+    W[:, rng.integers(0, M)] = 0.0  # an all-zero column
+    if M > 1:
+        W[:, rng.integers(0, M)] = 0.0
+    S[rng.integers(0, V)] = 0.0  # a zero-stake validator
+    S = S / S.sum()
+    return W, S.astype(np.float32)
+
+
+FAMILIES = {
+    "random": _random_case,
+    "ties": _tied_case,
+    "grid": _grid_case,
+    "kappa_edge": _kappa_edge_case,
+    "zeros": _zero_case,
+}
+
+
+def _battery(family):
+    """Yield (W[B,V,M], S[B,V], kappa[B]) batches, one per shape."""
+    gen = FAMILIES[family]
+    for shape_i, (V, M) in enumerate(SHAPES):
+        rng = np.random.default_rng(1000 * shape_i + hash(family) % 997)
+        Ws, Ss, ks = [], [], []
+        for seed in range(SEEDS_PER_CASE):
+            W, S = gen(rng, V, M)
+            Ws.append(W)
+            Ss.append(S)
+            ks.append(KAPPAS[seed % len(KAPPAS)])
+        yield (
+            jnp.asarray(np.stack(Ws)),
+            jnp.asarray(np.stack(Ss)),
+            jnp.asarray(np.array(ks, np.float32)),
+        )
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_sorted_matches_bisection_bitwise(family):
+    n = 0
+    for W, S, kappa in _battery(family):
+        a = np.asarray(stake_weighted_median(W, S, kappa))
+        b = np.asarray(stake_weighted_median_sorted(W, S, kappa))
+        np.testing.assert_array_equal(a, b, err_msg=f"{family} {W.shape}")
+        n += W.shape[0]
+    assert n == len(SHAPES) * SEEDS_PER_CASE
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_pallas_matches_bisection_bitwise(family):
+    # interpret mode is slow: one seed per (family, shape, kappa) instead
+    # of the full battery — still every family x edge x kappa.
+    gen = FAMILIES[family]
+    for shape_i, (V, M) in enumerate(SHAPES[:4]):
+        rng = np.random.default_rng(5000 + 1000 * shape_i + hash(family) % 997)
+        for kappa in KAPPAS:
+            W, S = gen(rng, V, M)
+            Wj, Sj = jnp.asarray(W), jnp.asarray(S)
+            a = np.asarray(stake_weighted_median(Wj, Sj, kappa))
+            b = np.asarray(
+                stake_weighted_median_pallas(Wj, Sj, kappa, interpret=True)
+            )
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"{family} {W.shape} kappa={kappa}"
+            )
+
+
+def test_all_zero_matrix_hits_grid_floor():
+    W = jnp.zeros((4, 6), jnp.float32)
+    S = jnp.full((4,), 0.25, jnp.float32)
+    for fn in (stake_weighted_median, stake_weighted_median_sorted):
+        np.testing.assert_array_equal(
+            np.asarray(fn(W, S, 0.5)), np.full(6, np.float32(GRID))
+        )
+
+
+def test_support_exactly_kappa_is_not_above():
+    # S = [0.5, 0.25, 0.25]; miner 0's support at any c in (0, 0.6) is
+    # exactly 0.5 == kappa -> strict `>` fails, bisection walks down.
+    W = jnp.asarray(
+        [[0.6, 0.4], [0.0, 1.0], [0.0, 1.0]], jnp.float32
+    )
+    S = jnp.asarray([0.5, 0.25, 0.25], jnp.float32)
+    a = np.asarray(stake_weighted_median(W, S, 0.5))
+    b = np.asarray(stake_weighted_median_sorted(W, S, 0.5))
+    p = np.asarray(stake_weighted_median_pallas(W, S, 0.5, interpret=True))
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, p)
+    # support(c) == 0.5 for c < 0.6 exactly: not above, so c_high descends
+    # to the smallest grid point above 0.6 for miner 0... support at
+    # c >= 0.6 is 0 -> also not above; the whole interval descends to 2^-17.
+    assert a[0] == np.float32(GRID)
