@@ -1,12 +1,26 @@
 """Headline benchmark: simulated epochs/sec at 256 validators x 4096 miners.
 
 The reference's measured number for this config is ~0.54 epochs/s on CPU
-(SURVEY.md §6, BASELINE.md: the per-miner bisection Python loop dominates).
-Here the same workload — Yuma 1 epoch kernel, EMA bonds, carried state —
-is one `lax.scan` over the jitted unified kernel (`simulate_constant`), so
-the whole run is a single device computation with no host round-trips.
+(SURVEY.md §6, BASELINE.md: the per-miner bisection Python loop dominates
+reference yumas.py:175-282, re-executed every epoch by the driver loop at
+simulation_utils.py:44).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The PRIMARY metric is the honest apples-to-apples comparison: the FULL
+epoch kernel executed EVERY epoch, with weights varying per epoch so that
+XLA cannot hoist any consensus work out of the scan. (With constant
+weights, XLA's loop-invariant code motion silently hoists most of the
+kernel even when our explicit `hoist_invariant` flag is off — measured
+~3x optimistic. Round-1's 132k number was the explicitly hoisted path and
+is now reported separately, not as the headline.)
+
+Secondary metrics (same JSON line, `secondary` field):
+  - full_epoch_xla:          same varying-weights workload, unfused XLA kernel
+  - constant_weights_scan:   constant weights, hoist flag off (XLA still
+                             hoists implicitly — kept for continuity with r1)
+  - constant_weights_hoisted: constant weights, consensus hoisted explicitly
+                             (the bonds-EMA recurrence is the whole scan)
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "secondary"}.
 """
 
 import json
@@ -19,38 +33,36 @@ import jax.numpy as jnp
 
 from yuma_simulation_tpu.models.config import YumaConfig
 from yuma_simulation_tpu.models.variants import variant_for_version
-from yuma_simulation_tpu.simulation.engine import simulate_constant
+from yuma_simulation_tpu.simulation.engine import simulate_constant, simulate_scaled
 
 BASELINE_EPOCHS_PER_SEC = 0.54  # reference CPU, 256v x 4096m (BASELINE.md)
 V, M = 256, 4096
+EPOCHS = 4096
+MAX_EPOCHS = 65536
+TARGET_SECONDS = 2.0
+REPS = 4
 
 
-#: The sort-based closed-form consensus (identical values to the
-#: reference's bisection — pinned by tests) is the fastest of the three
-#: implementations on TPU: ~2x the vectorized bisection, which in turn is
-#: ~45,000x the reference's per-miner Python loop.
-_CONSENSUS_IMPL = "sorted"
-
-#: The benchmark workload holds weights constant across epochs (as the
-#: reference's measured baseline did), so the consensus front half is
-#: epoch-invariant; hoisting it out of the scan is bit-identical to the
-#: in-scan form (pinned by tests) and ~2x faster again.
-_HOIST = True
-
-
-def _run(n_epochs: int, W, S, config, spec):
-    total, bonds = simulate_constant(
-        W,
-        S,
-        n_epochs,
-        config,
-        spec,
-        consensus_impl=_CONSENSUS_IMPL,
-        hoist_invariant=_HOIST,
-    )
-    # np.asarray forces the device->host fetch of the [V] totals; on remote
-    # TPU runtimes block_until_ready alone can return before execution.
-    return np.asarray(total)
+def _time_best(run, n):
+    """Best-of-REPS wall time, with the epoch count grown until one timed
+    run lasts >= TARGET_SECONDS (per-dispatch overhead through the remote
+    TPU tunnel is milliseconds — a sub-second window would skew the
+    result). np.asarray forces the device->host fetch; on the remote TPU
+    runtime block_until_ready alone can return before execution finishes.
+    """
+    np.asarray(run(n))  # compile + warm up
+    t0 = time.perf_counter()
+    np.asarray(run(n))
+    dt = time.perf_counter() - t0
+    if dt < TARGET_SECONDS:
+        n = min(MAX_EPOCHS, int(n * max(2.0, 1.25 * TARGET_SECONDS / dt)))
+        np.asarray(run(n))  # recompile at the timed length
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        np.asarray(run(n))
+        best = min(best, time.perf_counter() - t0)
+    return n / best
 
 
 def main() -> None:
@@ -59,29 +71,59 @@ def main() -> None:
     S = jnp.asarray(rng.random((V,)) + 0.01, jnp.float32)
     config = YumaConfig()
     spec = variant_for_version("Yuma 1 (paper)")
+    on_tpu = jax.default_backend() == "tpu"
 
-    # Warm-up at the timed epoch count (scan length is static) to exclude
-    # compile time, then calibrate the count so the timed run is >= ~2s.
-    n = 2048
-    _run(n, W, S, config, spec)
-    t0 = time.perf_counter()
-    _run(n, W, S, config, spec)
-    dt = time.perf_counter() - t0
-    if dt < 2.0:
-        n = min(100_000, int(n * max(2.0, 2.5 / dt)))
-        _run(n, W, S, config, spec)
-        t0 = time.perf_counter()
-        _run(n, W, S, config, spec)
-        dt = time.perf_counter() - t0
+    # Epoch-varying scales: numerically near-neutral (row normalization
+    # divides the scalar back out) but opaque to the compiler.
+    scales = jnp.asarray(
+        1.0 + 1e-7 * np.arange(MAX_EPOCHS, dtype=np.float32), jnp.float32
+    )
 
-    eps = n / dt
+    def varying(impl):
+        def run(n):
+            total, _ = simulate_scaled(
+                W, S, scales[:n], config, spec, epoch_impl=impl
+            )
+            return total
+
+        return run
+
+    def constant(hoist):
+        def run(n):
+            total, _ = simulate_constant(
+                W, S, n, config, spec,
+                consensus_impl="sorted", hoist_invariant=hoist,
+            )
+            return total
+
+        return run
+
+    primary_impl = "fused_mxu" if on_tpu else "xla"
+    primary = _time_best(varying(primary_impl), EPOCHS)
+    # Off-TPU the primary already IS the XLA path; don't time it twice.
+    xla_eps = (
+        _time_best(varying("xla"), EPOCHS) if primary_impl != "xla" else primary
+    )
+    secondary = {
+        "full_epoch_xla": round(xla_eps, 1),
+        "constant_weights_scan": round(_time_best(constant(False), EPOCHS), 1),
+        "constant_weights_hoisted": round(
+            _time_best(constant(True), 4 * EPOCHS), 1
+        ),
+    }
+
     print(
         json.dumps(
             {
-                "metric": f"simulated epochs/sec, {V}v x {M}m, Yuma 1 kernel",
-                "value": round(eps, 2),
+                "metric": (
+                    f"full-epoch simulated epochs/sec, {V}v x {M}m, weights "
+                    f"varying every epoch, Yuma 1 "
+                    f"({'fused Pallas epoch kernel' if on_tpu else 'XLA epoch kernel'})"
+                ),
+                "value": round(primary, 2),
                 "unit": "epochs/s",
-                "vs_baseline": round(eps / BASELINE_EPOCHS_PER_SEC, 1),
+                "vs_baseline": round(primary / BASELINE_EPOCHS_PER_SEC, 1),
+                "secondary": secondary,
             }
         )
     )

@@ -155,11 +155,16 @@ def stake_weighted_median_sorted(
     kap = kappa[..., None, None] if kappa.ndim else kappa
 
     # Sort each miner column by weight, descending, carrying stakes along.
+    # One stable multi-operand sort instead of argsort + two gathers: the
+    # gathers are catastrophically slow on TPU (~100x) while a co-sorted
+    # value operand is free; the permutation is identical (stable sort on
+    # the negated key == stable argsort of the negated key).
     Wt = jnp.swapaxes(W, -1, -2)  # [..., M, V]
     St = jnp.broadcast_to(S[..., None, :], Wt.shape)
-    order = jnp.argsort(-Wt, axis=-1, stable=True)
-    w_sorted = jnp.take_along_axis(Wt, order, axis=-1)
-    s_sorted = jnp.take_along_axis(St, order, axis=-1)
+    w_neg, s_sorted = lax.sort(
+        (-Wt, St), dimension=-1, num_keys=1, is_stable=True
+    )
+    w_sorted = -w_neg
     # Strict support at w_sorted[k] = total stake of entries with weight
     # strictly greater. Tied entries all share the support of the first
     # element of their run; forward-fill that value with a prefix max (the

@@ -1,0 +1,283 @@
+"""Fused Pallas TPU kernel: one whole EMA-family consensus epoch in VMEM.
+
+The unfused epoch (`models/epoch.py::yuma_epoch`) lowers to ~45 XLA
+elementwise passes over the `[V, M]` weight/bond arrays; at 256x4096 that
+is VPU-roofline-bound at ~55 us/epoch on a v5e chip. This kernel runs the
+entire epoch pipeline —
+
+    scale -> row-normalize -> 17-step bisection consensus -> u16 quantize
+    -> clip -> rank/incentive -> blended bonds -> column-normalize -> EMA
+    -> dividends
+
+— as ONE Pallas program with W, B, and every intermediate resident in
+VMEM, and (optionally) the three stake contractions (bisection support,
+rank, nothing else reduces over V) on the MXU instead of the VPU. The MXU
+variant is ~1.7x the XLA epoch (33 vs 56 us/epoch at 256x4096, weights
+varying every epoch so nothing can be hoisted).
+
+Numerics:
+- `mxu=False` (default): all reductions on the VPU in f32. Matches the
+  XLA kernel to reduction-order rounding (~1e-9 on bonds at 256x4096);
+  the bisection support sum is the same compare/select/sum sequence the
+  XLA path fuses, so consensus grid flips do not occur in practice.
+- `mxu=True` (bench fast path): support and rank ride the MXU's bf16x3
+  f32 decomposition. Support values can differ from the VPU sum by ~1 ulp,
+  which near `support == kappa` can flip one 2^-17 consensus grid point
+  (observed max bond deviation ~4e-5 at 256x4096). Opt-in, for throughput
+  sweeps where the CSV-parity contract is not in play.
+
+Reference semantics reproduced (same as `yuma_epoch`, reference
+yumas.py:61-282): `+1e-6` row-normalization epsilon, strict `>` in the
+bisection support test (yumas.py:89-91), truncating u16 quantization
+(yumas.py:97), epsilon-free column normalization for Yuma 1/2 bonds
+(yumas.py:228) vs `+1e-6` + EMA re-norm for Yuma 0 (yumas.py:113-116,
+147-149), first-epoch bond adoption (yumas.py:145), and the `1e-6`
+dividend-normalization epsilon (yumas.py:262).
+
+Liquid alpha (per-miner EMA rates from consensus quantiles) is NOT fused
+— callers with `liquid_alpha=True` must use the XLA path. Likewise the
+x64 parity mode's Yuma-0 float64 quantization divide (reference
+yumas.py:81,97): Pallas TPU kernels are f32-only, so the EMA_RUST mode
+raises under `jax_enable_x64` rather than silently diverging from the
+XLA path's f64 grid. Padded miner columns (from heterogeneous-case
+batching) are handled by passing the true miner count `m_real`; padded
+columns are excluded from the quantization sum and produce zero
+bonds/incentive.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from yuma_simulation_tpu.models.epoch import BondsMode
+
+_LANES = 128
+_SUBLANES = 8
+_VMEM_LIMIT = 110 * 1024 * 1024  # v5e has 128 MiB; leave headroom
+
+
+def _round_up(x: int, mult: int) -> int:
+    return (x + mult - 1) // mult * mult
+
+
+def _support(S_col, mask, mxu: bool):
+    """Stake contraction over validators: `[V,1] x [V,T] -> [1,T]`."""
+    if mxu:
+        return jax.lax.dot_general(
+            S_col.T, mask, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.sum(mask * S_col, axis=0, keepdims=True)
+
+
+def _fused_ema_epoch_kernel(
+    scal_ref,
+    s_ref,
+    w_ref,
+    *rest,
+    iters: int,
+    mode: BondsMode,
+    mxu: bool,
+    m_real: int,
+    has_clip_base: bool,
+):
+    """scal = [w_scale, kappa, beta, alpha, first]. `rest` is
+    `([clip_ref,] b_ref, bout_ref, d_ref, inc_ref)` — the clip-base
+    operand exists only for the EMA_PREV variant so the common case
+    doesn't pay an extra 4 MB HBM read per epoch."""
+    if has_clip_base:
+        clip_ref, b_ref, bout_ref, d_ref, inc_ref = rest
+    else:
+        b_ref, bout_ref, d_ref, inc_ref = rest
+    w_scale = scal_ref[0]
+    kappa = scal_ref[1]
+    beta = scal_ref[2]
+    alpha = scal_ref[3]
+    first = scal_ref[4]
+
+    W = w_ref[:] * w_scale  # [V, Mp]
+    S = s_ref[:]  # [V, 1] normalized stake
+    B_old = b_ref[:]  # [V, Mp]
+    Mp = W.shape[1]
+
+    W_n = W / (jnp.sum(W, axis=1, keepdims=True) + 1e-6)
+
+    # Bisection consensus on this epoch's weights (always W_n — the
+    # EMA_PREV variant clips/bonds against previous weights but computes
+    # consensus from the current ones, reference yumas.py:309-325).
+    c_lo = jnp.zeros((1, Mp), W.dtype)
+    c_hi = jnp.ones((1, Mp), W.dtype)
+
+    def body(_, carry):
+        c_lo, c_hi = carry
+        c_mid = (c_hi + c_lo) * 0.5
+        mask = (W_n > c_mid).astype(W.dtype)  # strict, as the reference
+        above = _support(S, mask, mxu) > kappa
+        return jnp.where(above, c_mid, c_lo), jnp.where(above, c_hi, c_mid)
+
+    _, c_hi = lax.fori_loop(0, iters, body, (c_lo, c_hi), unroll=True)
+
+    # Truncating u16 quantization; padded columns are excluded from the
+    # normalization sum (an all-zero real column still contributes its
+    # 2^-17 floor, exactly as the unfused quantize_u16 with miner_mask).
+    if m_real != Mp:
+        col = lax.broadcasted_iota(jnp.int32, (1, Mp), 1)
+        c_hi = jnp.where(col < m_real, c_hi, jnp.zeros_like(c_hi))
+    C = c_hi / jnp.sum(c_hi) * 65535.0
+    C = C.astype(jnp.int32).astype(W.dtype) / 65535.0
+
+    clip_base = clip_ref[:] if has_clip_base else W_n
+    W_clipped = jnp.minimum(clip_base, C)
+
+    R = _support(S, W_clipped, mxu)
+    incentive = jnp.nan_to_num(R / jnp.sum(R))
+    inc_ref[:] = incentive
+
+    # Bond purchase target.
+    if mode is BondsMode.EMA_RUST:
+        B_t = S * W_clipped
+        B_t = jnp.nan_to_num(B_t / (jnp.sum(B_t, axis=0, keepdims=True) + 1e-6))
+    else:
+        bond_base = W_n if mode is BondsMode.EMA else clip_base
+        W_b = (1.0 - beta) * bond_base + beta * W_clipped
+        B_t = S * W_b
+        # no epsilon (reference yumas.py:228, 342)
+        B_t = jnp.nan_to_num(B_t / jnp.sum(B_t, axis=0, keepdims=True))
+
+    ema = alpha * B_t + (1.0 - alpha) * B_old
+    B_ema = jnp.where(first > 0.5, B_t, ema)
+    if mode is BondsMode.EMA_RUST:
+        B_ema = jnp.nan_to_num(
+            B_ema / (jnp.sum(B_ema, axis=0, keepdims=True) + 1e-6)
+        )
+    bout_ref[:] = B_ema
+
+    D = jnp.sum(B_ema * incentive, axis=1, keepdims=True)  # [V, 1]
+    d_ref[:] = D / (jnp.sum(D) + 1e-6)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mode", "mxu", "interpret", "precision", "m_real"),
+)
+def fused_ema_epoch(
+    W: jnp.ndarray,
+    S_n: jnp.ndarray,
+    B_old: jnp.ndarray,
+    *,
+    w_scale=1.0,
+    kappa=0.5,
+    bond_penalty=1.0,
+    bond_alpha=0.1,
+    first_epoch=False,
+    clip_base: jnp.ndarray | None = None,
+    mode: BondsMode = BondsMode.EMA,
+    mxu: bool = False,
+    precision: int = 100_000,
+    m_real: int | None = None,
+    interpret: bool | None = None,
+):
+    """One fused EMA-family epoch.
+
+    Args:
+      W: raw weights `[V, M]` (scaled by `w_scale` in-kernel, so an
+        epoch-varying scalar workload costs no extra HBM pass).
+      S_n: NORMALIZED stake `[V]` (the kernel does not re-normalize).
+      B_old: carried bond state `[V, M]` (zeros + `first_epoch=True` for
+        the initial epoch).
+      first_epoch: traced bool/0-1 scalar; selects bond adoption.
+      clip_base: previous epoch's normalized weights for EMA_PREV; None
+        clips against this epoch's `W_n`.
+      mode: EMA / EMA_RUST / EMA_PREV (CAPACITY/RELATIVE: use yuma_epoch).
+      mxu: run stake contractions on the MXU (see module docstring).
+      m_real: true miner count when the caller's arrays are already
+        padded with dead columns (columns >= m_real are excluded from
+        the quantization sum, like `yuma_epoch`'s trailing miner_mask).
+
+    Returns:
+      `(B_ema [V,M], D_normalized [V], incentive [M])` — the scan-relevant
+      outputs of `yuma_epoch` (other named outputs are dead in the scan
+      and intentionally not produced).
+    """
+    if mode not in (BondsMode.EMA, BondsMode.EMA_RUST, BondsMode.EMA_PREV):
+        raise ValueError(f"fused epoch supports the EMA family only, got {mode}")
+    if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
+        raise ValueError(
+            "the fused kernel cannot reproduce Yuma-0's float64 quantization "
+            "divide (x64 parity mode); use the XLA epoch path"
+        )
+    V, M = W.shape
+    dtype = W.dtype
+    iters = int(math.ceil(math.log2(precision)))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    m_real = M if m_real is None else m_real
+    if not 0 < m_real <= M:
+        raise ValueError(f"m_real must be in (0, {M}], got {m_real}")
+    Vp, Mp = _round_up(V, _SUBLANES), _round_up(M, _LANES)
+    padded = (Vp, Mp) != (V, M)
+
+    def pad(x):
+        if not padded:
+            return x
+        return jnp.zeros((Vp, Mp), dtype).at[:V, :M].set(x)
+
+    W_p = pad(W)
+    B_p = pad(B_old)
+    S_p = jnp.zeros((Vp, 1), dtype).at[:V, 0].set(jnp.asarray(S_n, dtype))
+    has_clip = clip_base is not None
+    scal = jnp.stack(
+        [
+            jnp.asarray(w_scale, dtype),
+            jnp.asarray(kappa, dtype),
+            jnp.asarray(bond_penalty, dtype),
+            jnp.asarray(bond_alpha, dtype),
+            jnp.asarray(first_epoch, dtype),
+        ]
+    )
+
+    vm = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda: tuple(0 for _ in shape), memory_space=pltpu.VMEM
+    )
+    operands = [scal, S_p, W_p]
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        vm((Vp, 1)),
+        vm((Vp, Mp)),
+    ]
+    if has_clip:
+        operands.append(pad(clip_base))
+        in_specs.append(vm((Vp, Mp)))
+    operands.append(B_p)
+    in_specs.append(vm((Vp, Mp)))
+
+    B_ema, D, inc = pl.pallas_call(
+        functools.partial(
+            _fused_ema_epoch_kernel,
+            iters=iters,
+            mode=mode,
+            mxu=mxu,
+            m_real=m_real,
+            has_clip_base=has_clip,
+        ),
+        in_specs=in_specs,
+        out_specs=[vm((Vp, Mp)), vm((Vp, 1)), vm((1, Mp))],
+        out_shape=[
+            jax.ShapeDtypeStruct((Vp, Mp), dtype),
+            jax.ShapeDtypeStruct((Vp, 1), dtype),
+            jax.ShapeDtypeStruct((1, Mp), dtype),
+        ],
+        interpret=interpret,
+        compiler_params=None
+        if interpret
+        else pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+    )(*operands)
+    return B_ema[:V, :M], D[:V, 0], inc[0, :M]

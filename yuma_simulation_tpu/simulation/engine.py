@@ -227,6 +227,134 @@ def run_simulation(
 
 @partial(
     jax.jit,
+    static_argnames=("spec", "consensus_impl", "epoch_impl"),
+)
+def simulate_scaled(
+    W: jnp.ndarray,  # [V, M] base weights
+    S: jnp.ndarray,  # [V]
+    scales: jnp.ndarray,  # [E] per-epoch weight scale (epoch e uses W*scales[e])
+    config: YumaConfig,
+    spec: VariantSpec,
+    consensus_impl: str = "bisect",
+    epoch_impl: str = "xla",
+):
+    """Epoch-VARYING throughput workload: epoch `e` simulates `W*scales[e]`.
+
+    This is the honest full-kernel benchmark path: because the weights
+    differ every epoch, XLA cannot hoist any of the consensus front half
+    out of the scan (with constant weights XLA's loop-invariant code
+    motion silently hoists most of the kernel even when
+    `hoist_invariant=False` — measured ~3x optimistic at 256x4096). The
+    scalar scale is numerically almost-neutral (row normalization divides
+    it back out) but is opaque to the compiler, so every epoch pays the
+    full per-epoch cost exactly like a real changing-weights workload.
+
+    `epoch_impl`:
+      - "xla": the unfused `yuma_epoch` (any variant/consensus_impl).
+      - "fused": the Pallas VMEM-resident EMA-family epoch kernel
+        (:func:`yuma_simulation_tpu.ops.pallas_epoch.fused_ema_epoch`),
+        VPU reductions (matches XLA to ~1e-9).
+      - "fused_mxu": same kernel with the stake contractions on the MXU
+        (~1.7x faster; support sums can flip one 2^-17 consensus grid
+        point vs the VPU path — see pallas_epoch.py docstring).
+
+    Returns `(total_dividends[V], final_bonds[V, M])` like
+    `simulate_constant`.
+    """
+    V, M = W.shape
+    dtype = W.dtype
+    stakes_units = jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
+
+    def to_dividends(D_n):
+        emission = (
+            config.validator_emission_ratio * D_n * config.total_epoch_emission
+        )
+        return jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
+
+    if epoch_impl in ("fused", "fused_mxu"):
+        from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_epoch
+
+        if spec.bonds_mode not in _EMA_MODES:
+            raise ValueError("fused epoch_impl supports the EMA family only")
+        if config.liquid_alpha:
+            raise ValueError("fused epoch_impl does not support liquid alpha")
+        mxu = epoch_impl == "fused_mxu"
+        S_n = S / S.sum()  # stake is epoch-constant; normalize once
+
+        def epoch_body(B, W_prev, scale, first):
+            clip = None
+            if spec.bonds_mode is BondsMode.EMA_PREV:
+                W_n_now = normalize_weight_rows(W * scale)
+                clip = jnp.where(first, W_n_now, W_prev)
+            B_next, D_n, _ = fused_ema_epoch(
+                W,
+                S_n,
+                B,
+                w_scale=scale,
+                kappa=config.kappa,
+                bond_penalty=config.bond_penalty,
+                bond_alpha=config.bond_alpha,
+                first_epoch=first,
+                clip_base=clip,
+                mode=spec.bonds_mode,
+                mxu=mxu,
+                precision=config.consensus_precision,
+            )
+            return B_next, normalize_weight_rows(W * scale), D_n
+
+    else:
+
+        def epoch_body(B, W_prev, scale, first):
+            Wv = W * scale
+            kernel_prev = None
+            if spec.bonds_mode is BondsMode.EMA_PREV:
+                kernel_prev = jnp.where(
+                    first, normalize_weight_rows(Wv), W_prev
+                )
+            res = yuma_epoch(
+                Wv,
+                S,
+                B,
+                config,
+                bonds_mode=spec.bonds_mode,
+                W_prev=kernel_prev,
+                first_epoch=first,
+                consensus_impl=consensus_impl,
+            )
+            return (
+                res[spec.bond_state_key],
+                res["weight"],
+                res["validator_reward_normalized"],
+            )
+
+    carries_prev = spec.carries_prev_weights
+
+    def step(carry, xs):
+        if carries_prev:
+            B, W_prev, acc = carry
+        else:
+            (B, acc), W_prev = carry, None
+        scale, epoch = xs
+        B_next, W_n_now, D_n = epoch_body(B, W_prev, scale, epoch == 0)
+        acc = acc + to_dividends(D_n)
+        if carries_prev:
+            return (B_next, W_n_now, acc), None
+        return (B_next, acc), None
+
+    E = scales.shape[0]
+    zero_b = jnp.zeros((V, M), dtype)
+    zero_acc = jnp.zeros((V,), dtype)
+    carry0 = (
+        (zero_b, zero_b, zero_acc) if carries_prev else (zero_b, zero_acc)
+    )
+    final, _ = lax.scan(
+        step, carry0, (scales, jnp.arange(E, dtype=jnp.int32))
+    )
+    return final[-1], final[0]
+
+
+@partial(
+    jax.jit,
     static_argnames=("num_epochs", "spec", "consensus_impl", "hoist_invariant"),
 )
 def simulate_constant(
