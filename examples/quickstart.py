@@ -67,7 +67,12 @@ def main() -> None:
 
     # 4. Sharded Monte-Carlo with checkpoint/resume.
     mesh = make_mesh()
-    sweep = CheckpointedSweep(args.out_dir / "mc", num_chunks=4, tag="demo")
+    sweep = CheckpointedSweep(
+        args.out_dir / "mc",
+        num_chunks=4,
+        tag="demo",
+        config={"scenarios": 256, "V": 16, "M": 64, "seed": 0},
+    )
 
     def chunk(i):
         return montecarlo_total_dividends(

@@ -100,3 +100,42 @@ def test_charts_script(tmp_path):
     imgs = soup.find_all("img")
     assert len(imgs) == 9 * 4  # 9 canonical versions x 4 chart types
     assert all(i["src"].startswith("data:image/png;base64,") for i in imgs)
+
+
+@pytest.mark.slow
+def test_full_suite_chart_regression():
+    """The reference's own e2e surface (reference api_test.py:8-26) at
+    full width: all 14 cases x all 9 canonical versions. 14x4 chart rows
+    + 2 incentives rows (Cases 10/11) = 58 rows x 9 versions = 522
+    images, with case-parity row shading alternating per case block."""
+    from yuma_simulation_tpu.models.variants import canonical_versions
+
+    cases = get_cases()
+    assert len(cases) == 14
+    versions = canonical_versions()
+    assert len(versions) == 9
+
+    html = generate_chart_table(
+        cases,
+        versions,
+        SimulationHyperparameters(bond_penalty=0.99),
+        draggable_table=True,
+    )
+    soup = BeautifulSoup(html.data, "html.parser")
+    imgs = soup.find_all("img")
+    assert len(imgs) == (14 * 4 + 2) * 9 == 522
+    assert all(i["src"].startswith("data:image/png;base64,") for i in imgs)
+
+    # Row shading: one parity class per row, constant within each case
+    # block and alternating between consecutive cases (10 and 11 carry 5
+    # rows, the rest 4).
+    rows = soup.find_all("tr")
+    classes = [r.get("class", [""])[0] for r in rows if r.find("img")]
+    expected_rows = [4] * 9 + [5, 5] + [4] * 3
+    assert len(classes) == sum(expected_rows) == 58
+    pos = 0
+    for case_idx, n_rows in enumerate(expected_rows):
+        block = classes[pos : pos + n_rows]
+        parity = "even" if case_idx % 2 == 0 else "odd"
+        assert set(block) == {f"yuma-case-{parity}"}, (case_idx, block)
+        pos += n_rows

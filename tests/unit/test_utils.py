@@ -71,3 +71,16 @@ def test_profile_trace(tmp_path):
     with profile_trace(str(tmp_path / "trace")):
         np.asarray(jnp.arange(8).sum())
     assert any((tmp_path / "trace").rglob("*"))
+
+
+def test_checkpoint_config_fingerprint_mismatch(tmp_path):
+    CheckpointedSweep(tmp_path, num_chunks=2, config={"seed": 1, "V": 16})
+    # same config (different key order) resumes fine
+    CheckpointedSweep(tmp_path, num_chunks=2, config={"V": 16, "seed": 1})
+    with pytest.raises(ValueError, match="different"):
+        CheckpointedSweep(tmp_path, num_chunks=2, config={"seed": 2, "V": 16})
+
+
+def test_checkpoint_config_must_be_serializable(tmp_path):
+    with pytest.raises(TypeError, match="JSON-serializable"):
+        CheckpointedSweep(tmp_path, num_chunks=1, config={"fn": object()})

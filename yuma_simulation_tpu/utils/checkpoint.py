@@ -12,6 +12,7 @@ are `[chunk, V]` dividend totals, not model state.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import logging
 import pathlib
@@ -29,20 +30,42 @@ class CheckpointedSweep:
     `fn(chunk_index) -> np.ndarray` computes one chunk (typically a
     `shard_map`'d Monte-Carlo batch). `run()` executes all chunks not yet
     on disk, snapshots each, and returns the concatenated `[total, ...]`
-    result. Metadata (`num_chunks`, user `tag`) is pinned in
-    `manifest.json` and validated on resume so a stale directory cannot
-    silently mix configurations.
+    result. Metadata (`num_chunks`, user `tag`, and a `config`
+    fingerprint) is pinned in `manifest.json` and validated on resume so
+    a stale directory cannot silently mix configurations.
+
+    `config` should capture everything that determines a chunk's value —
+    version name, shapes, seed, hyperparameters. Any JSON-serializable
+    pytree works; it is canonicalized (sorted keys) and fingerprinted, so
+    resuming with a different config in the same directory fails loudly
+    instead of reusing stale `chunk_*.npz` results.
     """
 
     directory: str | pathlib.Path
     num_chunks: int
     tag: str = ""
+    config: object = None
 
     def __post_init__(self) -> None:
         self.directory = pathlib.Path(self.directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         manifest = self.directory / "manifest.json"
-        meta = {"num_chunks": self.num_chunks, "tag": self.tag}
+        try:
+            # No `default=` fallback: a non-JSON value would fingerprint
+            # as its repr (memory address) and never match on resume.
+            fingerprint = json.dumps(self.config, sort_keys=True)
+        except TypeError as e:
+            raise TypeError(
+                "CheckpointedSweep config must be JSON-serializable "
+                f"(got {type(self.config).__name__}): {e}"
+            ) from e
+        meta = {
+            "num_chunks": self.num_chunks,
+            "tag": self.tag,
+            "config_fingerprint": hashlib.sha256(
+                fingerprint.encode()
+            ).hexdigest(),
+        }
         if manifest.exists():
             found = json.loads(manifest.read_text())
             if found != meta:
