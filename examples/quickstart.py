@@ -71,7 +71,7 @@ def main() -> None:
         args.out_dir / "mc",
         num_chunks=4,
         tag="demo",
-        config={"scenarios": 256, "V": 16, "M": 64, "seed": 0},
+        config={"scenarios": 256, "epochs": 50, "V": 16, "M": 256, "seed": 0},
     )
 
     def chunk(i):

@@ -84,3 +84,19 @@ def test_checkpoint_config_fingerprint_mismatch(tmp_path):
 def test_checkpoint_config_must_be_serializable(tmp_path):
     with pytest.raises(TypeError, match="JSON-serializable"):
         CheckpointedSweep(tmp_path, num_chunks=1, config={"fn": object()})
+
+
+def test_checkpoint_legacy_manifest_resumes(tmp_path):
+    """A manifest written before `config_fingerprint` existed must stay
+    resumable (key-by-key comparison) and be upgraded in place."""
+    import json
+
+    (tmp_path / "manifest.json").write_text(
+        json.dumps({"num_chunks": 2, "tag": "t"})
+    )
+    CheckpointedSweep(tmp_path, num_chunks=2, tag="t", config={"a": 1})
+    upgraded = json.loads((tmp_path / "manifest.json").read_text())
+    assert "config_fingerprint" in upgraded
+    # The shared keys are still enforced.
+    with pytest.raises(ValueError, match="different"):
+        CheckpointedSweep(tmp_path, num_chunks=3, tag="t", config={"a": 1})

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from yuma_simulation_tpu.models.config import YumaConfig
 from yuma_simulation_tpu.models.epoch import (
@@ -54,6 +55,21 @@ class SimulationResult:
     consensus: Optional[np.ndarray]  # [E, M] quantized consensus
 
 
+def _miner_shardings(mesh: Mesh):
+    """`([V, M], [M])` NamedShardings with the miner axis over the mesh's
+    last axis (the ``model`` axis of :func:`..parallel.mesh.make_mesh`).
+
+    The miner axis is this framework's sequence-parallel analogue
+    (SURVEY.md §5): the bisection/sort consensus is per-miner and stays
+    shard-local; only the row-normalization sums, consensus-sum divide,
+    liquid-alpha quantile sort and dividend reductions cross shards.
+    """
+    axis = mesh.axis_names[-1]
+    vm = NamedSharding(mesh, PartitionSpec(None, axis))
+    m = NamedSharding(mesh, PartitionSpec(axis))
+    return vm, m
+
+
 def _apply_reset(B, C_prev, epoch, reset_index, reset_epoch, reset_mode, M):
     """Zero the reset miner's bond column when the variant's rule fires
     (reference simulation_utils.py:62-88). `reset_epoch < 0` disables.
@@ -78,6 +94,7 @@ def _apply_reset(B, C_prev, epoch, reset_index, reset_epoch, reset_mode, M):
         "save_incentives",
         "save_consensus",
         "consensus_impl",
+        "mesh",
     ),
 )
 def _simulate_scan(
@@ -92,14 +109,24 @@ def _simulate_scan(
     save_consensus: bool = False,
     consensus_impl: str = "bisect",
     miner_mask: Optional[jnp.ndarray] = None,  # [M] 1=real, 0=padding
+    mesh: Optional[Mesh] = None,  # shard the miner axis over mesh's last axis
 ):
     E, V, M = weights.shape
     dtype = weights.dtype
+    shardings = None if mesh is None else _miner_shardings(mesh)
 
     def step(carry, xs):
         B, W_prev, C_prev = carry
         W, S, epoch = xs
         first = epoch == 0
+        if shardings is not None:
+            # Re-pin the layouts every epoch so GSPMD keeps the miner axis
+            # sharded through the whole scan instead of gathering the carry.
+            vm, m = shardings
+            W = lax.with_sharding_constraint(W, vm)
+            B = lax.with_sharding_constraint(B, vm)
+            W_prev = lax.with_sharding_constraint(W_prev, vm)
+            C_prev = lax.with_sharding_constraint(C_prev, m)
 
         if spec.reset_mode is not ResetMode.NONE:
             B = _apply_reset(
@@ -129,6 +156,11 @@ def _simulate_scan(
         B_next = res[spec.bond_state_key]
         W_prev_next = res["weight"] if spec.carries_prev_weights else W_prev
         C_next = res["server_consensus_weight"]
+        if shardings is not None:
+            vm, m = shardings
+            B_next = lax.with_sharding_constraint(B_next, vm)
+            W_prev_next = lax.with_sharding_constraint(W_prev_next, vm)
+            C_next = lax.with_sharding_constraint(C_next, m)
 
         # Dividend per 1000 tao (reference simulation_utils.py:45-49, 95-107);
         # note the conversion uses the *raw* case stakes, not the normalized
@@ -174,12 +206,25 @@ def simulate(
     save_consensus: bool = False,
     consensus_impl: str = "bisect",
     dtype=jnp.float32,
+    mesh: Optional[Mesh] = None,
 ) -> SimulationResult:
-    """Simulate one scenario under one named version; returns host arrays."""
+    """Simulate one scenario under one named version; returns host arrays.
+
+    With ``mesh``, the miner axis of every `[V, M]` matrix is sharded over
+    the mesh's last axis for the whole multi-epoch scan — the path for
+    subnets whose `V x M` state outgrows one chip's HBM. Results are
+    identical to the unsharded run (pinned by tests/unit/test_multichip.py).
+    """
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
+    weights = jnp.asarray(scenario.weights, dtype)
+    if mesh is not None:
+        axis = mesh.axis_names[-1]
+        weights = jax.device_put(
+            weights, NamedSharding(mesh, PartitionSpec(None, None, axis))
+        )
     ys = _simulate_scan(
-        jnp.asarray(scenario.weights, dtype),
+        weights,
         jnp.asarray(scenario.stakes, dtype),
         jnp.asarray(
             -1 if scenario.reset_bonds_index is None else scenario.reset_bonds_index,
@@ -195,6 +240,7 @@ def simulate(
         save_incentives=save_incentives,
         save_consensus=save_consensus,
         consensus_impl=consensus_impl,
+        mesh=mesh,
     )
     ys = jax.device_get(ys)
     return SimulationResult(
@@ -355,7 +401,9 @@ def simulate_scaled(
 
 @partial(
     jax.jit,
-    static_argnames=("num_epochs", "spec", "consensus_impl", "hoist_invariant"),
+    static_argnames=(
+        "num_epochs", "spec", "consensus_impl", "hoist_invariant", "mesh"
+    ),
 )
 def simulate_constant(
     W: jnp.ndarray,  # [V, M], constant across epochs
@@ -365,6 +413,7 @@ def simulate_constant(
     spec: VariantSpec,
     consensus_impl: str = "bisect",
     hoist_invariant: bool = False,
+    mesh: Optional[Mesh] = None,
 ):
     """Throughput path: fixed weights, total dividends accumulated in-carry.
 
@@ -383,18 +432,29 @@ def simulate_constant(
     on the same values (agreement exact up to XLA's own fusion-dependent
     ULP at very short scan lengths), ~2x faster at 256x4096; XLA does not
     perform this hoist on its own.
+
+    With ``mesh``, the miner axis is sharded over the mesh's last axis
+    across the whole scan (both paths), for subnets beyond one chip's HBM.
     """
     if hoist_invariant:
         return _simulate_constant_hoisted(
-            W, S, num_epochs, config, spec, consensus_impl
+            W, S, num_epochs, config, spec, consensus_impl, mesh
         )
     V, M = W.shape
     dtype = W.dtype
+    shardings = None if mesh is None else _miner_shardings(mesh)
+    if shardings is not None:
+        W = lax.with_sharding_constraint(W, shardings[0])
     stakes_units = jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
 
     def step(carry, epoch):
         B, W_prev, C_prev, acc = carry
         first = epoch == 0
+        if shardings is not None:
+            vm, m = shardings
+            B = lax.with_sharding_constraint(B, vm)
+            W_prev = lax.with_sharding_constraint(W_prev, vm)
+            C_prev = lax.with_sharding_constraint(C_prev, m)
         if spec.reset_mode is not ResetMode.NONE:
             B = _apply_reset(
                 B, C_prev, epoch, jnp.int32(-1), jnp.int32(-1), spec.reset_mode, M
@@ -441,7 +501,7 @@ def simulate_constant(
 
 def _simulate_constant_hoisted(
     W, S, num_epochs: int, config: YumaConfig, spec: VariantSpec,
-    consensus_impl: str,
+    consensus_impl: str, mesh: Optional[Mesh] = None,
 ):
     """Constant-weights fast path: one kernel front half + a bonds-only scan.
 
@@ -456,6 +516,9 @@ def _simulate_constant_hoisted(
     if num_epochs < 1:
         raise ValueError("hoist_invariant path requires num_epochs >= 1")
     dtype = W.dtype
+    shardings = None if mesh is None else _miner_shardings(mesh)
+    if shardings is not None:
+        W = lax.with_sharding_constraint(W, shardings[0])
     stakes_units = jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
 
     # Full kernel once; also the source of the final outputs' first step.
@@ -493,13 +556,19 @@ def _simulate_constant_hoisted(
         )
         return jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
 
+    pin = (
+        (lambda B: lax.with_sharding_constraint(B, shardings[0]))
+        if shardings is not None
+        else (lambda B: B)
+    )
+
     if spec.bonds_mode in _EMA_MODES:
         B_target = res0["validator_bond"]
         renorm = spec.bonds_mode is BondsMode.EMA_RUST
 
         def step(carry, _):
             B_ema, acc = carry
-            B_next = ema_bonds_update(B_target, B_ema, rate, None, renorm)
+            B_next = pin(ema_bonds_update(B_target, pin(B_ema), rate, None, renorm))
             return (B_next, acc + dividends_of(B_next)), None
 
         B0 = res0["validator_ema_bond"]
@@ -507,7 +576,7 @@ def _simulate_constant_hoisted(
 
         def step(carry, _):
             B_prev, acc = carry
-            B_next = capacity_bonds_update(B_prev, W_n, S_n, config)
+            B_next = pin(capacity_bonds_update(pin(B_prev), W_n, S_n, config))
             return (B_next, acc + dividends_of(B_next)), None
 
         B0 = res0["validator_bonds"]
@@ -515,7 +584,7 @@ def _simulate_constant_hoisted(
 
         def step(carry, _):
             B_prev, acc = carry
-            B_next = relative_bonds_update(B_prev, W_n, rate)
+            B_next = pin(relative_bonds_update(pin(B_prev), W_n, rate))
             return (B_next, acc + dividends_of(B_next)), None
 
         B0 = res0["validator_bonds"]

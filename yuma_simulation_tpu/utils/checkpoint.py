@@ -68,11 +68,21 @@ class CheckpointedSweep:
         }
         if manifest.exists():
             found = json.loads(manifest.read_text())
-            if found != meta:
+            # Key-by-key so a manifest written before `config_fingerprint`
+            # existed (legacy layout) stays resumable; the missing key is
+            # backfilled below rather than rejected.
+            mismatched = {
+                k: (found.get(k), v)
+                for k, v in meta.items()
+                if k in found and found[k] != v
+            }
+            if mismatched:
                 raise ValueError(
                     f"checkpoint dir {self.directory} holds a different "
-                    f"sweep: {found} != {meta}"
+                    f"sweep: {mismatched}"
                 )
+            if found.keys() != meta.keys():
+                manifest.write_text(json.dumps(meta))
         else:
             manifest.write_text(json.dumps(meta))
 
