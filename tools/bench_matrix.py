@@ -8,12 +8,19 @@ one JSON line per config. Run on TPU (default) or CPU
 """
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+# Runs as `python tools/bench_matrix.py` from the repo root; PYTHONPATH
+# cannot be used instead — setting it breaks the TPU plugin registration
+# in this environment.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from yuma_simulation_tpu.models.config import YumaConfig
 from yuma_simulation_tpu.models.variants import canonical_versions, variant_for_version
@@ -91,19 +98,57 @@ def bench_hyperparam_grid():
 
 def bench_montecarlo(num_scenarios=256, epochs=100, V=64, M=1024):
     mesh = make_mesh()
+
+    def run(key):
+        out = montecarlo_total_dividends(
+            key, num_scenarios, epochs, V, M, "Yuma 1 (paper)", mesh=mesh
+        )
+        assert np.isfinite(out).all()
+
+    run(jax.random.key(0))  # compile + warm
     t0 = time.perf_counter()
-    out = montecarlo_total_dividends(
-        jax.random.key(0), num_scenarios, epochs, V, M,
-        "Yuma 1 (paper)", mesh=mesh,
-    )
+    run(jax.random.key(1))
     dt = time.perf_counter() - t0
-    assert np.isfinite(out).all()
     _line(
         f"Monte-Carlo {num_scenarios} scenarios x {epochs} epochs, "
-        f"{V}v x {M}m (shard_map, incl. compile)",
+        f"{V}v x {M}m (shard_map, warm)",
         num_scenarios * epochs / dt,
         "epochs/s",
         {"devices": len(jax.devices()), "wall_s": round(dt, 2)},
+    )
+
+
+def bench_batched_throughput(B=64, V=64, M=1024, epochs=500):
+    """The number that fills the chip: a vmap batch of B independent
+    constant-weight scenarios scanned for `epochs` epochs, scenario-epochs
+    per second (the Monte-Carlo regime, consensus hoisted — single-run
+    utilization on one small subnet is ~1-3% of peak; batching is how the
+    chip earns its keep)."""
+    rng = np.random.default_rng(1)
+    W = jnp.asarray(rng.random((B, V, M)), jnp.float32)
+    S = jnp.asarray(rng.random((B, V)) + 0.01, jnp.float32)
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 1 (paper)")
+
+    @jax.jit
+    def batch(W, S):
+        return jax.vmap(
+            lambda w, s: simulate_constant(
+                w, s, epochs, cfg, spec,
+                consensus_impl="sorted", hoist_invariant=True,
+            )[0]
+        )(W, S)
+
+    _fetch(batch(W, S))
+    t0 = time.perf_counter()
+    _fetch(batch(W, S))
+    dt = time.perf_counter() - t0
+    _line(
+        f"batched throughput: {B} scenarios x {V}v x {M}m x {epochs} epochs "
+        f"(vmap, hoisted, warm)",
+        B * epochs / dt,
+        "scenario-epochs/s",
+        {"wall_s": round(dt, 2)},
     )
 
 
@@ -112,6 +157,7 @@ def main():
     bench_subnet(256, 4096, 2048, "stress 256v x 4096m (Yuma 2)")
     bench_correctness_matrix()
     bench_hyperparam_grid()
+    bench_batched_throughput()
     bench_montecarlo()
 
 

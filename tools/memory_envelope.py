@@ -1,0 +1,124 @@
+"""Single-chip memory envelope: how big a subnet fits, and what it costs.
+
+Probes `simulate_constant` (the long-horizon throughput path) at growing
+`[V, M]` shapes on the current backend, recording wall time and the
+device's peak HBM usage, until allocation fails. With `--sharded`, runs
+the miner-sharded equivalent over a `(1, N)` mesh instead — on the CPU
+test mesh this demonstrates the >1-chip path without TPU pod hardware.
+
+Prints one JSON line per probed shape; the final summary line marks the
+largest shape that fit. Results are recorded in DESIGN.md ("Memory
+envelope").
+
+Run from the repo root: `python tools/memory_envelope.py [--sharded]`
+(PYTHONPATH cannot be used — setting it breaks TPU plugin registration
+in this environment).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def peak_hbm_gib():
+    """Peak device memory in GiB, or None when the backend doesn't report
+    it (CPU) — None serializes as valid JSON null, NaN would not."""
+    stats = jax.local_devices()[0].memory_stats() or {}
+    peak = stats.get("peak_bytes_in_use")
+    return round(peak / 2**30, 2) if peak else None
+
+
+def probe(V: int, M: int, epochs: int, mesh=None) -> dict:
+    from yuma_simulation_tpu.models.config import YumaConfig
+    from yuma_simulation_tpu.models.variants import variant_for_version
+    from yuma_simulation_tpu.simulation.engine import simulate_constant
+
+    rng = np.random.default_rng(0)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 2 (Adrian-Fish)")
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        W = jax.device_put(
+            W, NamedSharding(mesh, PartitionSpec(None, mesh.axis_names[-1]))
+        )
+
+    def run():
+        total, _ = simulate_constant(
+            W, S, epochs, cfg, spec, consensus_impl="sorted", mesh=mesh
+        )
+        return np.asarray(total)
+
+    run()  # compile + warm
+    t0 = time.perf_counter()
+    out = run()
+    dt = time.perf_counter() - t0
+    assert np.isfinite(out).all()
+    return {
+        "V": V,
+        "M": M,
+        "epochs": epochs,
+        "epochs_per_s": round(epochs / dt, 1),
+        "peak_hbm_gib": peak_hbm_gib(),
+        "state_mib_per_vm_buffer": round(V * M * 4 / 2**20, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sharded", action="store_true")
+    ap.add_argument("--epochs", type=int, default=1000)
+    args = ap.parse_args()
+
+    mesh = None
+    if args.sharded:
+        from yuma_simulation_tpu.parallel import make_mesh
+
+        n = len(jax.devices())
+        mesh = make_mesh(data=1, model=n)
+
+    # Doubling ladder of [V, M]; stop at first allocation failure.
+    shapes = [
+        (1024, 16384),
+        (2048, 32768),
+        (4096, 32768),
+        (4096, 65536),
+        (8192, 65536),
+    ]
+    epochs = args.epochs
+    if jax.default_backend() == "cpu":
+        # CPU-mesh probes demonstrate the sharded path, not throughput:
+        # a handful of epochs on two rungs of the ladder is enough.
+        epochs = min(epochs, 8)
+        shapes = shapes[:2]
+    largest = None
+    for V, M in shapes:
+        try:
+            rec = probe(V, M, epochs, mesh)
+        except Exception as e:  # XLA OOM surfaces as RuntimeError
+            print(
+                json.dumps(
+                    {"V": V, "M": M, "fits": False, "error": str(e)[:200]}
+                ),
+                flush=True,
+            )
+            break
+        rec.update(fits=True, sharded=bool(mesh), backend=jax.default_backend())
+        largest = rec
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({"largest_fitting": largest}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

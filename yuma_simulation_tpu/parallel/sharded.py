@@ -164,43 +164,63 @@ def montecarlo_total_dividends(
     base_weights = jnp.asarray(base_weights, dtype)
     base_stakes = jnp.asarray(base_stakes, dtype)
     keys = jax.random.split(key, shards)
-
-    @partial(jax.jit, static_argnames=())
-    def run(keys):
-        def local(shard_keys):
-            shard_key = shard_keys[0]
-
-            def one(k):
-                eps = perturbation * jax.random.normal(
-                    k, base_weights.shape, dtype
-                )
-                W = jax.nn.relu(base_weights + eps)
-                # Weights are constant across epochs within one scenario,
-                # so the hoisted path applies: consensus once, bonds
-                # recurrence scanned (same values as the full per-epoch
-                # kernel — pinned by tests/unit/test_hoisted.py).
-                total, _ = simulate_constant(
-                    W,
-                    base_stakes,
-                    num_epochs,
-                    config,
-                    spec,
-                    consensus_impl="sorted",
-                    hoist_invariant=True,
-                )
-                return total  # [V]
-
-            return jax.vmap(one)(jax.random.split(shard_key, per_shard))
-
-        return jax.shard_map(
-            local,
+    return np.asarray(
+        _montecarlo_run(
+            keys,
+            base_weights,
+            base_stakes,
+            jnp.asarray(perturbation, dtype),
+            config,
+            num_epochs=num_epochs,
+            per_shard=per_shard,
+            spec=spec,
             mesh=mesh,
-            in_specs=P(DATA_AXIS),
-            out_specs=P(DATA_AXIS),
-            check_vma=False,
-        )(keys)
+        )
+    )
 
-    return np.asarray(run(keys))
+
+@partial(
+    jax.jit, static_argnames=("num_epochs", "per_shard", "spec", "mesh")
+)
+def _montecarlo_run(
+    keys, base_weights, base_stakes, perturbation, config,
+    *, num_epochs: int, per_shard: int, spec: VariantSpec, mesh: Mesh,
+):
+    """Module-level jitted body so repeated Monte-Carlo calls with the same
+    shapes/config hit the jit cache instead of re-tracing a fresh closure."""
+
+    def local(shard_keys):
+        shard_key = shard_keys[0]
+
+        def one(k):
+            eps = perturbation * jax.random.normal(
+                k, base_weights.shape, base_weights.dtype
+            )
+            W = jax.nn.relu(base_weights + eps)
+            # Weights are constant across epochs within one scenario,
+            # so the hoisted path applies: consensus once, bonds
+            # recurrence scanned (same values as the full per-epoch
+            # kernel — pinned by tests/unit/test_hoisted.py).
+            total, _ = simulate_constant(
+                W,
+                base_stakes,
+                num_epochs,
+                config,
+                spec,
+                consensus_impl="sorted",
+                hoist_invariant=True,
+            )
+            return total  # [V]
+
+        return jax.vmap(one)(jax.random.split(shard_key, per_shard))
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P(DATA_AXIS),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )(keys)
 
 
 def shard_epoch_over_miners(
