@@ -24,12 +24,20 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "secondary"}.
 """
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from yuma_simulation_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()
 
 from yuma_simulation_tpu.models.config import YumaConfig
 from yuma_simulation_tpu.models.variants import variant_for_version

@@ -106,6 +106,112 @@ def test_simulate_scaled_fused_matches_xla(version):
     )
 
 
+@pytest.mark.parametrize(
+    "version",
+    ["Yuma 0 (subtensor)", "Yuma 1 (paper)", "Yuma 2 (Adrian-Fish)"],
+)
+def test_simulate_scaled_fused_scan_matches_per_epoch_fused(version):
+    """The single-Pallas-program scan (bond state in VMEM scratch across
+    grid steps) reproduces the lax.scan-over-fused-epoch path."""
+    import jax
+
+    if version == "Yuma 0 (subtensor)" and jax.config.jax_enable_x64:
+        pytest.skip("EMA_RUST fused requires f32 mode")
+    V, M, E = 8, 16, 12
+    rng = np.random.default_rng(7)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    scales = jnp.asarray(1.0 + 1e-4 * rng.random(E), jnp.float32)
+    cfg = YumaConfig()
+    spec = variant_for_version(version)
+
+    t_fused, b_fused = simulate_scaled(
+        W, S, scales, cfg, spec, epoch_impl="fused"
+    )
+    t_scan, b_scan = simulate_scaled(
+        W, S, scales, cfg, spec, epoch_impl="fused_scan"
+    )
+    # Bonds follow the identical op sequence (expect ULP-exact); the total
+    # differs only by converting the in-kernel D_n sum once vs per epoch.
+    np.testing.assert_allclose(
+        np.asarray(b_scan), np.asarray(b_fused), atol=3e-8
+    )
+    np.testing.assert_allclose(
+        np.asarray(t_scan), np.asarray(t_fused), rtol=2e-6
+    )
+
+
+def test_fused_scan_ema_rust_matches_in_f32_subprocess():
+    """The EMA_RUST branch of the fused scan can only run in f32 mode
+    (the x64 harness skips it above); pin it against the per-epoch fused
+    path in a subprocess with x64 off."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    script = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+assert not jax.config.jax_enable_x64
+import numpy as np
+import jax.numpy as jnp
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.variants import variant_for_version
+from yuma_simulation_tpu.simulation.engine import simulate_scaled
+
+V, M, E = 8, 16, 12
+rng = np.random.default_rng(7)
+W = jnp.asarray(rng.random((V, M)), jnp.float32)
+S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+scales = jnp.asarray(1.0 + 1e-4 * rng.random(E), jnp.float32)
+cfg = YumaConfig()
+spec = variant_for_version("Yuma 0 (subtensor)")
+t_f, b_f = simulate_scaled(W, S, scales, cfg, spec, epoch_impl="fused")
+t_s, b_s = simulate_scaled(W, S, scales, cfg, spec, epoch_impl="fused_scan")
+np.testing.assert_allclose(np.asarray(b_s), np.asarray(b_f), atol=3e-8)
+np.testing.assert_allclose(np.asarray(t_s), np.asarray(t_f), rtol=2e-6)
+print("EMA_RUST_SCAN_OK")
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [repo, env.get("PYTHONPATH", "")] if p
+    )
+    env.pop("JAX_ENABLE_X64", None)
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "EMA_RUST_SCAN_OK" in out.stdout
+
+
+def test_fused_scan_rejects_empty_epochs():
+    from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
+
+    W = jnp.ones((4, 8), jnp.float32)
+    S = jnp.ones((4,), jnp.float32) / 4
+    with pytest.raises(ValueError, match="at least one epoch"):
+        fused_ema_scan(W, S, jnp.zeros((0,), jnp.float32))
+
+
+def test_fused_scan_rejects_oversized_vmem():
+    from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
+
+    W = jnp.ones((4096, 16384), jnp.float32)  # 256 MiB/buffer: over budget
+    S = jnp.ones((4096,), jnp.float32) / 4096
+    with pytest.raises(ValueError, match="VMEM"):
+        fused_ema_scan(W, S, jnp.ones(3, jnp.float32))
+
+
 def test_simulate_scaled_ones_matches_simulate_constant():
     V, M, E = 8, 16, 12
     rng = np.random.default_rng(11)

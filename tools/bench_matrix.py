@@ -22,6 +22,10 @@ import jax.numpy as jnp
 # in this environment.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from yuma_simulation_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()
+
 from yuma_simulation_tpu.models.config import YumaConfig
 from yuma_simulation_tpu.models.variants import canonical_versions, variant_for_version
 from yuma_simulation_tpu.parallel import make_mesh, montecarlo_total_dividends

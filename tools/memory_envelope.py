@@ -28,6 +28,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+from yuma_simulation_tpu.utils import enable_compilation_cache  # noqa: E402
+
+# Cold compiles grow steeply with [V, M] on the remote-tunnel runtime
+# (~1 min at 256x4096, >>10 min at the top of the ladder); the persistent
+# cache makes reruns and post-failure retries sub-second.
+enable_compilation_cache()
+
 
 def peak_hbm_gib():
     """Peak device memory in GiB, or None when the backend doesn't report

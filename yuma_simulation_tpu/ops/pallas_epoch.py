@@ -77,34 +77,34 @@ def _support(S_col, mask, mxu: bool):
     return jnp.sum(mask * S_col, axis=0, keepdims=True)
 
 
-def _fused_ema_epoch_kernel(
-    scal_ref,
-    s_ref,
-    w_ref,
-    *rest,
+def _epoch_math(
+    W,
+    S,
+    B_old,
+    clip_prev,
+    first,
+    kappa,
+    beta,
+    alpha,
+    *,
     iters: int,
     mode: BondsMode,
     mxu: bool,
     m_real: int,
-    has_clip_base: bool,
+    clip_fallback=None,
 ):
-    """scal = [w_scale, kappa, beta, alpha, first]. `rest` is
-    `([clip_ref,] b_ref, bout_ref, d_ref, inc_ref)` — the clip-base
-    operand exists only for the EMA_PREV variant so the common case
-    doesn't pay an extra 4 MB HBM read per epoch."""
-    if has_clip_base:
-        clip_ref, b_ref, bout_ref, d_ref, inc_ref = rest
-    else:
-        b_ref, bout_ref, d_ref, inc_ref = rest
-    w_scale = scal_ref[0]
-    kappa = scal_ref[1]
-    beta = scal_ref[2]
-    alpha = scal_ref[3]
-    first = scal_ref[4]
+    """The one shared epoch pipeline both fused kernels trace:
+    row-normalize -> bisection -> u16 quantize -> clip -> incentive ->
+    bond purchase -> EMA -> normalized dividends.
 
-    W = w_ref[:] * w_scale  # [V, Mp]
-    S = s_ref[:]  # [V, 1] normalized stake
-    B_old = b_ref[:]  # [V, Mp]
+    `clip_prev` is the EMA_PREV clip source (ignored by the other modes;
+    None means "clip against this epoch's W_n"). `first` is the traced
+    first-epoch predicate for the EMA blend. `clip_fallback` (kwarg)
+    additionally selects W_n over `clip_prev` when true — the scan kernel
+    uses it at grid step 0 where its scratch is not yet a previous epoch;
+    the per-epoch kernel resolves that fallback caller-side and passes
+    None. Returns `(B_ema, D_n [V, 1], incentive [1, Mp], W_n)`.
+    """
     Mp = W.shape[1]
 
     W_n = W / (jnp.sum(W, axis=1, keepdims=True) + 1e-6)
@@ -133,12 +133,22 @@ def _fused_ema_epoch_kernel(
     C = c_hi / jnp.sum(c_hi) * 65535.0
     C = C.astype(jnp.int32).astype(W.dtype) / 65535.0
 
-    clip_base = clip_ref[:] if has_clip_base else W_n
+    if mode is BondsMode.EMA_PREV and clip_prev is not None:
+        # Grid step 0 of the scan falls back to this epoch's normalized
+        # weights (reference yumas.py:299-300). A select, not an
+        # arithmetic blend — a blend would do 0 * clip_prev, which
+        # poisons on uninitialized scratch.
+        clip_base = (
+            clip_prev
+            if clip_fallback is None
+            else jnp.where(clip_fallback, W_n, clip_prev)
+        )
+    else:
+        clip_base = W_n
     W_clipped = jnp.minimum(clip_base, C)
 
     R = _support(S, W_clipped, mxu)
     incentive = jnp.nan_to_num(R / jnp.sum(R))
-    inc_ref[:] = incentive
 
     # Bond purchase target.
     if mode is BondsMode.EMA_RUST:
@@ -152,15 +162,227 @@ def _fused_ema_epoch_kernel(
         B_t = jnp.nan_to_num(B_t / jnp.sum(B_t, axis=0, keepdims=True))
 
     ema = alpha * B_t + (1.0 - alpha) * B_old
-    B_ema = jnp.where(first > 0.5, B_t, ema)
+    B_ema = jnp.where(first, B_t, ema)
     if mode is BondsMode.EMA_RUST:
         B_ema = jnp.nan_to_num(
             B_ema / (jnp.sum(B_ema, axis=0, keepdims=True) + 1e-6)
         )
-    bout_ref[:] = B_ema
 
     D = jnp.sum(B_ema * incentive, axis=1, keepdims=True)  # [V, 1]
-    d_ref[:] = D / (jnp.sum(D) + 1e-6)
+    D_n = D / (jnp.sum(D) + 1e-6)
+    return B_ema, D_n, incentive, W_n
+
+
+def _fused_ema_epoch_kernel(
+    scal_ref,
+    s_ref,
+    w_ref,
+    *rest,
+    iters: int,
+    mode: BondsMode,
+    mxu: bool,
+    m_real: int,
+    has_clip_base: bool,
+):
+    """scal = [w_scale, kappa, beta, alpha, first]. `rest` is
+    `([clip_ref,] b_ref, bout_ref, d_ref, inc_ref)` — the clip-base
+    operand exists only for the EMA_PREV variant so the common case
+    doesn't pay an extra 4 MB HBM read per epoch."""
+    if has_clip_base:
+        clip_ref, b_ref, bout_ref, d_ref, inc_ref = rest
+    else:
+        b_ref, bout_ref, d_ref, inc_ref = rest
+
+    B_ema, D_n, incentive, _ = _epoch_math(
+        w_ref[:] * scal_ref[0],
+        s_ref[:],
+        b_ref[:],
+        clip_ref[:] if has_clip_base else None,
+        scal_ref[4] > 0.5,
+        scal_ref[1],
+        scal_ref[2],
+        scal_ref[3],
+        iters=iters,
+        mode=mode,
+        mxu=mxu,
+        m_real=m_real,
+    )
+    bout_ref[:] = B_ema
+    d_ref[:] = D_n
+    inc_ref[:] = incentive
+
+
+def _fused_ema_scan_kernel(
+    scal_ref,
+    scales_ref,
+    s_ref,
+    w_ref,
+    bout_ref,
+    dtot_ref,
+    b_scr,
+    dacc_scr,
+    *wprev_scr,
+    iters: int,
+    mode: BondsMode,
+    mxu: bool,
+    m_real: int,
+    num_epochs: int,
+):
+    """One grid step = one epoch; the bond state lives in VMEM scratch for
+    the WHOLE scan, so the per-epoch HBM traffic of the lax.scan carry
+    (read B, write B — ~8 MB/epoch at 256x4096) disappears entirely, and
+    W's block index never changes so Pallas fetches it once. scal =
+    [kappa, beta, alpha]; scales is the per-epoch weight scale in SMEM."""
+    e = pl.program_id(0)
+    first = e == 0
+
+    @pl.when(first)
+    def _init():
+        b_scr[:] = jnp.zeros_like(b_scr)
+        dacc_scr[:] = jnp.zeros_like(dacc_scr)
+        if mode is BondsMode.EMA_PREV:
+            wprev_scr[0][:] = jnp.zeros_like(wprev_scr[0])
+
+    B_ema, D_n, _, W_n = _epoch_math(
+        w_ref[:] * scales_ref[e],
+        s_ref[:],
+        b_scr[:],
+        wprev_scr[0][:] if mode is BondsMode.EMA_PREV else None,
+        first,
+        scal_ref[0],
+        scal_ref[1],
+        scal_ref[2],
+        iters=iters,
+        mode=mode,
+        mxu=mxu,
+        m_real=m_real,
+        clip_fallback=first,
+    )
+
+    b_scr[:] = B_ema
+    dacc_scr[:] = dacc_scr[:] + D_n
+    if mode is BondsMode.EMA_PREV:
+        wprev_scr[0][:] = W_n
+
+    @pl.when(e == num_epochs - 1)
+    def _emit():
+        bout_ref[:] = b_scr[:]
+        dtot_ref[:] = dacc_scr[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mode", "mxu", "interpret", "precision"),
+)
+def fused_ema_scan(
+    W: jnp.ndarray,
+    S_n: jnp.ndarray,
+    scales: jnp.ndarray,
+    *,
+    kappa=0.5,
+    bond_penalty=1.0,
+    bond_alpha=0.1,
+    mode: BondsMode = BondsMode.EMA,
+    mxu: bool = False,
+    precision: int = 100_000,
+    interpret: bool | None = None,
+):
+    """The WHOLE epoch scan as one Pallas program (EMA family).
+
+    Epoch `e` simulates `W * scales[e]` (the epoch-varying workload of
+    `simulate_scaled`). The grid iterates over epochs sequentially; the
+    bond state and the dividend accumulator are VMEM scratch that persists
+    across grid steps, and W's block index never changes so it is fetched
+    from HBM once. Versus `lax.scan` over `fused_ema_epoch`, this removes
+    the per-epoch kernel dispatch and the bond-carry HBM round-trip.
+
+    Returns `(B_final [V, M], D_n_total [V])` where `D_n_total` is the sum
+    over epochs of the per-epoch NORMALIZED dividends (the caller applies
+    the per-validator dividend-per-1000-tao conversion, which is linear in
+    `D_n`, to the sum).
+    """
+    if mode not in (BondsMode.EMA, BondsMode.EMA_RUST, BondsMode.EMA_PREV):
+        raise ValueError(f"fused scan supports the EMA family only, got {mode}")
+    if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
+        raise ValueError(
+            "the fused kernel cannot reproduce Yuma-0's float64 quantization "
+            "divide (x64 parity mode); use the XLA epoch path"
+        )
+    V, M = W.shape
+    E = scales.shape[0]
+    if E < 1:
+        # grid=(0,) does not compile, and the output refs would never be
+        # written; the other epoch_impl paths return zeros for E=0.
+        raise ValueError("fused scan requires at least one epoch")
+    dtype = W.dtype
+    iters = int(math.ceil(math.log2(precision)))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    Vp, Mp = _round_up(V, _SUBLANES), _round_up(M, _LANES)
+    # W + B (+ W_prev) resident plus Mosaic temporaries: stay well under
+    # the VMEM budget or refuse — there is no automatic fallback, callers
+    # must choose the per-epoch "fused"/"fused_mxu" path for such shapes.
+    resident = (3 if mode is BondsMode.EMA_PREV else 2) * Vp * Mp * 4
+    if resident * 3 > _VMEM_LIMIT:
+        raise ValueError(
+            f"[{V}, {M}] too large for the VMEM-resident fused scan "
+            f"(~{resident // 2**20} MiB resident); use the per-epoch path"
+        )
+    padded = (Vp, Mp) != (V, M)
+    W_p = (
+        jnp.zeros((Vp, Mp), dtype).at[:V, :M].set(W) if padded else W
+    )
+    S_p = jnp.zeros((Vp, 1), dtype).at[:V, 0].set(jnp.asarray(S_n, dtype))
+    scal = jnp.stack(
+        [
+            jnp.asarray(kappa, dtype),
+            jnp.asarray(bond_penalty, dtype),
+            jnp.asarray(bond_alpha, dtype),
+        ]
+    )
+
+    vm = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda e: tuple(0 for _ in shape), memory_space=pltpu.VMEM
+    )
+    scratch = [
+        pltpu.VMEM((Vp, Mp), dtype),
+        pltpu.VMEM((Vp, 1), dtype),
+    ]
+    if mode is BondsMode.EMA_PREV:
+        scratch.append(pltpu.VMEM((Vp, Mp), dtype))
+
+    B_final, D_tot = pl.pallas_call(
+        functools.partial(
+            _fused_ema_scan_kernel,
+            iters=iters,
+            mode=mode,
+            mxu=mxu,
+            m_real=M,
+            num_epochs=E,
+        ),
+        grid=(E,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            vm((Vp, 1)),
+            vm((Vp, Mp)),
+        ],
+        out_specs=[vm((Vp, Mp)), vm((Vp, 1))],
+        out_shape=[
+            jax.ShapeDtypeStruct((Vp, Mp), dtype),
+            jax.ShapeDtypeStruct((Vp, 1), dtype),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+        compiler_params=None
+        if interpret
+        else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT,
+            dimension_semantics=("arbitrary",),
+        ),
+    )(scal, scales.astype(dtype), S_p, W_p)
+    return B_final[:V, :M], D_tot[:V, 0]
 
 
 @functools.partial(

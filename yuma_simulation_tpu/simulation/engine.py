@@ -303,6 +303,11 @@ def simulate_scaled(
       - "fused_mxu": same kernel with the stake contractions on the MXU
         (~1.7x faster; support sums can flip one 2^-17 consensus grid
         point vs the VPU path — see pallas_epoch.py docstring).
+      - "fused_scan" / "fused_scan_mxu": the ENTIRE epoch scan as one
+        Pallas program — bond state resident in VMEM scratch across grid
+        steps, W fetched from HBM once, no per-epoch dispatch
+        (:func:`yuma_simulation_tpu.ops.pallas_epoch.fused_ema_scan`).
+        Same numerics as "fused"/"fused_mxu" respectively.
 
     Returns `(total_dividends[V], final_bonds[V, M])` like
     `simulate_constant`.
@@ -316,6 +321,28 @@ def simulate_scaled(
             config.validator_emission_ratio * D_n * config.total_epoch_emission
         )
         return jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
+
+    if epoch_impl in ("fused_scan", "fused_scan_mxu"):
+        from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
+
+        if spec.bonds_mode not in _EMA_MODES:
+            raise ValueError("fused epoch_impl supports the EMA family only")
+        if config.liquid_alpha:
+            raise ValueError("fused epoch_impl does not support liquid alpha")
+        B_final, D_tot = fused_ema_scan(
+            W,
+            S / S.sum(),
+            scales,
+            kappa=config.kappa,
+            bond_penalty=config.bond_penalty,
+            bond_alpha=config.bond_alpha,
+            mode=spec.bonds_mode,
+            mxu=epoch_impl == "fused_scan_mxu",
+            precision=config.consensus_precision,
+        )
+        # The per-1000-tao conversion is linear in D_n, so applying it to
+        # the in-kernel epoch sum equals summing per-epoch conversions.
+        return to_dividends(D_tot), B_final
 
     if epoch_impl in ("fused", "fused_mxu"):
         from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_epoch

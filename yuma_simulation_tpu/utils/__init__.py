@@ -9,6 +9,7 @@ from yuma_simulation_tpu.utils.checkpoint import (  # noqa: F401
     CheckpointedSweep,
 )
 from yuma_simulation_tpu.utils.profiling import (  # noqa: F401
+    enable_compilation_cache,
     profile_trace,
     timed,
 )

@@ -18,6 +18,34 @@ import jax
 logger = logging.getLogger(__name__)
 
 
+def enable_compilation_cache(
+    cache_dir: Optional[str] = None, min_compile_secs: float = 1.0
+) -> str:
+    """Turn on JAX's persistent compilation cache.
+
+    On remote-tunnel TPU runtimes a cold compile of the epoch scan runs
+    ~1 min at 256x4096 and grows steeply with shape; the persistent cache
+    turns every repeat invocation (benches, probes, CLI runs) into a
+    sub-second cache hit. Keyed on the HLO, so stale entries cannot be
+    served after code changes. Returns the cache directory used.
+    """
+    import os
+
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "YUMA_TPU_JAX_CACHE",
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "yuma_simulation_tpu_jax"
+            ),
+        )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+    )
+    return cache_dir
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: Optional[str]) -> Iterator[None]:
     """Wrap a region in a `jax.profiler` trace (Perfetto/XPlane dump).
