@@ -194,6 +194,21 @@ print("EMA_RUST_SCAN_OK")
     assert "EMA_RUST_SCAN_OK" in out.stdout
 
 
+def test_fused_epoch_rejects_clip_base_outside_ema_prev():
+    # yuma_epoch ignores W_prev for non-EMA_PREV modes; the fused kernel
+    # must refuse the combination rather than silently diverge from it.
+    V, M = 4, 8
+    rng = np.random.default_rng(2)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S_n = jnp.ones((V,), jnp.float32) / V
+    B0 = jnp.zeros((V, M), jnp.float32)
+    clip = normalize_weight_rows(jnp.asarray(rng.random((V, M)), jnp.float32))
+    with pytest.raises(ValueError, match="EMA_PREV"):
+        fused_ema_epoch(
+            W, S_n, B0, clip_base=clip, mode=BondsMode.EMA, interpret=True
+        )
+
+
 def test_fused_scan_rejects_empty_epochs():
     from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
 

@@ -62,8 +62,11 @@ def probe(V: int, M: int, epochs: int, mesh=None) -> dict:
         )
 
     def run():
+        # bisect, not sorted: the sorted path's XLA program hits
+        # pathological remote-compile times at >= 512x8192 (DESIGN.md
+        # "Memory envelope"); bisect compiles in seconds at every rung.
         total, _ = simulate_constant(
-            W, S, epochs, cfg, spec, consensus_impl="sorted", mesh=mesh
+            W, S, epochs, cfg, spec, consensus_impl="bisect", mesh=mesh
         )
         return np.asarray(total)
 
@@ -96,12 +99,14 @@ def main() -> None:
         mesh = make_mesh(data=1, model=n)
 
     # Doubling ladder of [V, M]; stop at first allocation failure.
+    # (8192x131072 — 4 GiB/buffer — is known to fail at remote compile.)
     shapes = [
         (1024, 16384),
         (2048, 32768),
         (4096, 32768),
         (4096, 65536),
         (8192, 65536),
+        (8192, 131072),
     ]
     epochs = args.epochs
     if jax.default_backend() == "cpu":

@@ -138,7 +138,7 @@ def _epoch_math(
     C = C.astype(jnp.int32).astype(W.dtype) / 65535.0
 
     if clip_prev is not None:
-        # Honored for every mode (the public fused_ema_epoch contract).
+        # Only the EMA_PREV callers pass this (both kernels guard it).
         # Grid step 0 of the scan falls back to this epoch's normalized
         # weights (reference yumas.py:299-300). A select, not an
         # arithmetic blend — a blend would do 0 * clip_prev, which
@@ -420,8 +420,9 @@ def fused_ema_epoch(
       B_old: carried bond state `[V, M]` (zeros + `first_epoch=True` for
         the initial epoch).
       first_epoch: traced bool/0-1 scalar; selects bond adoption.
-      clip_base: previous epoch's normalized weights for EMA_PREV; None
-        clips against this epoch's `W_n`.
+      clip_base: previous epoch's normalized weights (EMA_PREV only —
+        other modes raise, matching yuma_epoch which ignores W_prev for
+        them); None clips against this epoch's `W_n`.
       mode: EMA / EMA_RUST / EMA_PREV (CAPACITY/RELATIVE: use yuma_epoch).
       mxu: run stake contractions on the MXU (see module docstring).
       m_real: true miner count when the caller's arrays are already
@@ -435,6 +436,10 @@ def fused_ema_epoch(
     """
     if mode not in (BondsMode.EMA, BondsMode.EMA_RUST, BondsMode.EMA_PREV):
         raise ValueError(f"fused epoch supports the EMA family only, got {mode}")
+    if clip_base is not None and mode is not BondsMode.EMA_PREV:
+        # The XLA reference kernel (yuma_epoch) ignores W_prev for the
+        # other modes; silently honoring it here would diverge from it.
+        raise ValueError("clip_base is only meaningful for EMA_PREV")
     if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
         raise ValueError(
             "the fused kernel cannot reproduce Yuma-0's float64 quantization "
