@@ -1,0 +1,67 @@
+"""Nox sessions mirroring the reference's dev workflow
+(reference noxfile.py:130-206: format / lint / test / make_release) —
+without its broken dependency-group residue (the reference installs pdm
+groups its own pyproject never defines, SURVEY.md §2 last row; here every
+session installs real extras/tools).
+
+Run `nox -l` to list sessions. CI runs the same commands directly
+(.github/workflows/ci.yml), so nox is a convenience for local dev parity,
+not a second source of truth.
+"""
+
+from __future__ import annotations
+
+import nox
+
+nox.options.sessions = ["lint", "typecheck", "test"]
+
+PY_VERSIONS = ["3.11", "3.12"]
+LINT_TARGETS = (
+    "yuma_simulation_tpu",
+    "yuma_simulation",
+    "scripts",
+    "tests",
+)
+
+
+@nox.session(name="format")
+def format_(session: nox.Session) -> None:
+    """Auto-format with ruff (the reference uses ruff format + isort)."""
+    session.install("ruff")
+    session.run("ruff", "format", *LINT_TARGETS)
+    session.run("ruff", "check", "--fix", *LINT_TARGETS)
+
+
+@nox.session
+def lint(session: nox.Session) -> None:
+    session.install("ruff")
+    session.run("ruff", "check", *LINT_TARGETS)
+
+
+@nox.session
+def typecheck(session: nox.Session) -> None:
+    session.install("mypy", "-e", ".")
+    session.run("mypy", "yuma_simulation_tpu", "yuma_simulation")
+
+
+@nox.session(python=PY_VERSIONS)
+def test(session: nox.Session) -> None:
+    """Fast lane: the virtual 8-device CPU mesh suite (no TPU needed)."""
+    session.install("-e", ".[test]")
+    session.run("python", "-m", "pytest", "tests/", "-q", "-m", "not slow")
+
+
+@nox.session(python=PY_VERSIONS)
+def test_slow(session: nox.Session) -> None:
+    """Slow lane: full 14x9 chart suite, f32-mode goldens, quickstart."""
+    session.install("-e", ".[test]")
+    session.run("python", "-m", "pytest", "tests/", "-q", "-m", "slow")
+
+
+@nox.session
+def make_release(session: nox.Session) -> None:
+    """Build sdist+wheel. Publishing runs via the tag-triggered trusted
+    publishing workflow (.github/workflows/publish.yml), not from a dev
+    machine — push a `v*` tag to release."""
+    session.install("build")
+    session.run("python", "-m", "build")

@@ -61,6 +61,37 @@ def bench_subnet(V, M, epochs, name):
     _line(name, epochs / (time.perf_counter() - t0), "epochs/s")
 
 
+def bench_stress_varying(V=256, M=4096, epochs=16384):
+    """The honest full-kernel stress line: weights vary every epoch
+    (nothing hoistable), single-Pallas-program scan, long scan so the
+    ~0.1 s/call tunnel dispatch overhead is amortized."""
+    from yuma_simulation_tpu.simulation.engine import simulate_scaled
+
+    rng = np.random.default_rng(0)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    scales = jnp.asarray(
+        1.0 + 1e-7 * np.arange(epochs, dtype=np.float32), jnp.float32
+    )
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 2 (Adrian-Fish)")
+    impl = "fused_scan_mxu" if jax.default_backend() == "tpu" else "xla"
+    run = lambda: _fetch(  # noqa: E731
+        simulate_scaled(W, S, scales, cfg, spec, epoch_impl=impl)[0]
+    )
+    run()
+    t0 = time.perf_counter()
+    run()
+    dt = time.perf_counter() - t0
+    _line(
+        f"stress {V}v x {M}m, weights varying every epoch "
+        f"(Yuma 2, {impl})",
+        epochs / dt,
+        "epochs/s",
+        {"wall_s": round(dt, 2)},
+    )
+
+
 def bench_correctness_matrix():
     cases = get_cases()
     versions = canonical_versions()
@@ -159,6 +190,7 @@ def bench_batched_throughput(B=64, V=64, M=1024, epochs=500):
 def main():
     bench_subnet(16, 256, 2048, "small subnet 16v x 256m (Yuma 2)")
     bench_subnet(256, 4096, 2048, "stress 256v x 4096m (Yuma 2)")
+    bench_stress_varying()
     bench_correctness_matrix()
     bench_hyperparam_grid()
     bench_batched_throughput()

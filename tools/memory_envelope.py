@@ -18,6 +18,7 @@ in this environment).
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -119,10 +120,11 @@ def main() -> None:
         try:
             rec = probe(V, M, epochs, mesh)
         except Exception as e:  # XLA OOM surfaces as RuntimeError
+            # First line only, ANSI escapes stripped: keep the committed
+            # artifact stable and readable across regenerations.
+            msg = re.sub(r"\x1b\[[0-9;]*m", "", str(e)).splitlines()[0][:200]
             print(
-                json.dumps(
-                    {"V": V, "M": M, "fits": False, "error": str(e)[:200]}
-                ),
+                json.dumps({"V": V, "M": M, "fits": False, "error": msg}),
                 flush=True,
             )
             break
