@@ -100,3 +100,21 @@ def test_checkpoint_legacy_manifest_resumes(tmp_path):
     # The shared keys are still enforced.
     with pytest.raises(ValueError, match="different"):
         CheckpointedSweep(tmp_path, num_chunks=3, tag="t", config={"a": 1})
+
+
+def test_enable_compilation_cache(tmp_path, monkeypatch):
+    import jax
+
+    from yuma_simulation_tpu.utils import enable_compilation_cache
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        used = enable_compilation_cache(str(tmp_path / "cache"))
+        assert used == str(tmp_path / "cache")
+        assert jax.config.jax_compilation_cache_dir == used
+        assert (tmp_path / "cache").is_dir()
+        # env-var override path
+        monkeypatch.setenv("YUMA_TPU_JAX_CACHE", str(tmp_path / "env_cache"))
+        assert enable_compilation_cache() == str(tmp_path / "env_cache")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)

@@ -30,7 +30,7 @@ from yuma_simulation_tpu.models.config import YumaConfig
 from yuma_simulation_tpu.models.variants import canonical_versions, variant_for_version
 from yuma_simulation_tpu.parallel import make_mesh, montecarlo_total_dividends
 from yuma_simulation_tpu.scenarios import get_cases
-from yuma_simulation_tpu.simulation.engine import simulate_constant
+from yuma_simulation_tpu.simulation.engine import simulate_constant, simulate_scaled
 from yuma_simulation_tpu.simulation.sweep import config_grid, sweep_hyperparams, total_dividends_batch
 from yuma_simulation_tpu.scenarios import create_case
 
@@ -65,8 +65,6 @@ def bench_stress_varying(V=256, M=4096, epochs=16384):
     """The honest full-kernel stress line: weights vary every epoch
     (nothing hoistable), single-Pallas-program scan, long scan so the
     ~0.1 s/call tunnel dispatch overhead is amortized."""
-    from yuma_simulation_tpu.simulation.engine import simulate_scaled
-
     rng = np.random.default_rng(0)
     W = jnp.asarray(rng.random((V, M)), jnp.float32)
     S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)

@@ -122,7 +122,8 @@ def main() -> None:
         except Exception as e:  # XLA OOM surfaces as RuntimeError
             # First line only, ANSI escapes stripped: keep the committed
             # artifact stable and readable across regenerations.
-            msg = re.sub(r"\x1b\[[0-9;]*m", "", str(e)).splitlines()[0][:200]
+            stripped = re.sub(r"\x1b\[[0-9;]*m", "", str(e))
+            msg = (stripped.splitlines() or ["<no message>"])[0][:200]
             print(
                 json.dumps({"V": V, "M": M, "fits": False, "error": msg}),
                 flush=True,

@@ -147,6 +147,12 @@ def stake_weighted_median_sorted(
 
     One `sort` + two scans per column replaces the 17 support contractions.
     Produces values identical to :func:`stake_weighted_median`.
+
+    Operational note: on remote-compile TPU runtimes this program's XLA
+    compile time grows pathologically with shape (minutes-to-hours at
+    >= 512x8192, vs seconds for the bisection at every measured shape —
+    DESIGN.md "Memory envelope"). Prefer ``consensus_impl="bisect"`` or
+    the fused Pallas paths for very large subnets.
     """
     iters = _bisection_iterations(precision)
     scale = float(2**iters)
