@@ -7,6 +7,7 @@ tests/unit/api/api_test.py:1-26).
 """
 
 import numpy as np
+import pytest
 from bs4 import BeautifulSoup
 
 
@@ -82,3 +83,25 @@ def test_reference_style_plotters():
         to_base64=True,
     )
     assert img.startswith('<img src="data:image/png;base64,')
+
+
+def test_shim_kernels_accept_torch_tensors():
+    """Reference notebooks pass torch tensors; the shim must take them
+    as-is (jnp.asarray consumes torch CPU tensors via the array
+    protocol)."""
+    torch = pytest.importorskip("torch")
+
+    from yuma_simulation._internal.yumas import Yuma, YumaConfig
+
+    g = torch.Generator().manual_seed(0)
+    W = torch.rand(4, 8, generator=g)
+    S = torch.tensor([0.4, 0.3, 0.2, 0.1])
+    out = Yuma(W, S, None, YumaConfig())
+    D = np.asarray(out["validator_reward_normalized"])
+    assert D.shape == (4,)
+    np.testing.assert_allclose(D.sum(), 1.0, atol=2e-5)
+    # Same values as the numpy-input path.
+    ref = Yuma(W.numpy(), S.numpy(), None, YumaConfig())
+    np.testing.assert_array_equal(
+        D, np.asarray(ref["validator_reward_normalized"])
+    )
