@@ -304,3 +304,42 @@ def test_fused_rejects_non_ema_and_liquid():
             W, S, ones, liquid_cfg,
             variant_for_version("Yuma 1 (paper)"), epoch_impl="fused",
         )
+
+
+def test_epoch_impl_auto_selects_and_matches():
+    """"auto" must run everywhere: off-TPU it resolves to the XLA path
+    (interpret-mode Pallas would be slower, not faster) and matches it
+    exactly; eligibility gating is checked directly."""
+    import jax
+
+    from yuma_simulation_tpu.models.config import YumaParams
+    from yuma_simulation_tpu.ops.pallas_epoch import fused_scan_eligible
+
+    V, M, E = 8, 16, 6
+    rng = np.random.default_rng(9)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    scales = jnp.ones(E, jnp.float32)
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 1 (paper)")
+
+    t_auto, b_auto = simulate_scaled(W, S, scales, cfg, spec, epoch_impl="auto")
+    t_xla, b_xla = simulate_scaled(W, S, scales, cfg, spec, epoch_impl="xla")
+    if jax.default_backend() != "tpu":
+        np.testing.assert_array_equal(np.asarray(t_auto), np.asarray(t_xla))
+        np.testing.assert_array_equal(np.asarray(b_auto), np.asarray(b_xla))
+
+    # E=0 must fall back to the XLA path (zeros), never the fused scan.
+    t0, b0 = simulate_scaled(
+        W, S, jnp.zeros((0,), jnp.float32), cfg, spec, epoch_impl="auto"
+    )
+    assert np.all(np.asarray(t0) == 0) and np.all(np.asarray(b0) == 0)
+
+    on_tpu = jax.default_backend() == "tpu"
+    assert fused_scan_eligible((256, 4096), BondsMode.EMA, cfg) == on_tpu
+    # liquid alpha and non-EMA modes are never eligible
+    liquid = YumaConfig(yuma_params=YumaParams(liquid_alpha=True))
+    assert not fused_scan_eligible((256, 4096), BondsMode.EMA, liquid)
+    assert not fused_scan_eligible((256, 4096), BondsMode.CAPACITY, cfg)
+    # over the VMEM budget is never eligible
+    assert not fused_scan_eligible((8192, 65536), BondsMode.EMA, cfg)

@@ -217,6 +217,34 @@ def _fused_ema_epoch_kernel(
     inc_ref[:] = incentive
 
 
+_FUSED_MODES = (BondsMode.EMA, BondsMode.EMA_RUST, BondsMode.EMA_PREV)
+
+
+def _scan_resident_bytes(shape, mode: BondsMode) -> int:
+    """VMEM bytes the fused scan keeps resident (W + B [+ W_prev]),
+    padded to tile boundaries — the one source of truth for both the
+    kernel's guard and the `auto` eligibility predicate."""
+    V, M = shape
+    Vp, Mp = _round_up(V, _SUBLANES), _round_up(M, _LANES)
+    return (3 if mode is BondsMode.EMA_PREV else 2) * Vp * Mp * 4
+
+
+def fused_scan_eligible(shape, mode: BondsMode, config) -> bool:
+    """Whether :func:`fused_ema_scan` can run this workload — the
+    `epoch_impl="auto"` predicate: EMA-family bonds, no liquid alpha,
+    not Yuma-0-under-x64, within the VMEM budget, and on a real TPU
+    (interpret mode would be slower than XLA, not faster)."""
+    if mode not in _FUSED_MODES:
+        return False
+    if config.liquid_alpha:
+        return False
+    if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    return _scan_resident_bytes(shape, mode) * 3 <= _VMEM_LIMIT
+
+
 def _fused_ema_scan_kernel(
     scal_ref,
     scales_ref,
@@ -306,7 +334,7 @@ def fused_ema_scan(
     the per-validator dividend-per-1000-tao conversion, which is linear in
     `D_n`, to the sum).
     """
-    if mode not in (BondsMode.EMA, BondsMode.EMA_RUST, BondsMode.EMA_PREV):
+    if mode not in _FUSED_MODES:
         raise ValueError(f"fused scan supports the EMA family only, got {mode}")
     if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
         raise ValueError(
@@ -328,7 +356,7 @@ def fused_ema_scan(
     # W + B (+ W_prev) resident plus Mosaic temporaries: stay well under
     # the VMEM budget or refuse — there is no automatic fallback, callers
     # must choose the per-epoch "fused"/"fused_mxu" path for such shapes.
-    resident = (3 if mode is BondsMode.EMA_PREV else 2) * Vp * Mp * 4
+    resident = _scan_resident_bytes(W.shape, mode)
     if resident * 3 > _VMEM_LIMIT:
         raise ValueError(
             f"[{V}, {M}] too large for the VMEM-resident fused scan "

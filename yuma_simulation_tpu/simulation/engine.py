@@ -296,6 +296,13 @@ def simulate_scaled(
     full per-epoch cost exactly like a real changing-weights workload.
 
     `epoch_impl`:
+      - "auto": pick the fastest *parity-safe* path — the
+        single-Pallas-program VPU scan ("fused_scan") when the
+        variant/config/shape allow it (EMA family, no liquid alpha, f32
+        mode or non-Yuma-0, fits the VMEM budget, on TPU, >= 1 epoch),
+        otherwise the XLA path. Never selects the MXU variants (their
+        support sums can flip one 2^-17 consensus grid point); opt into
+        "fused_scan_mxu" explicitly for the last ~1.2x.
       - "xla": the unfused `yuma_epoch` (any variant/consensus_impl).
       - "fused": the Pallas VMEM-resident EMA-family epoch kernel
         (:func:`yuma_simulation_tpu.ops.pallas_epoch.fused_ema_epoch`),
@@ -321,6 +328,20 @@ def simulate_scaled(
             config.validator_emission_ratio * D_n * config.total_epoch_emission
         )
         return jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
+
+    if epoch_impl == "auto":
+        from yuma_simulation_tpu.ops.pallas_epoch import fused_scan_eligible
+
+        # The VPU scan, not the MXU variant: auto must be correct by
+        # default (the MXU support sums can flip one 2^-17 consensus
+        # grid point — opt into "fused_scan_mxu" explicitly for that
+        # last ~1.2x). E=0 falls back to XLA, which returns zeros.
+        epoch_impl = (
+            "fused_scan"
+            if scales.shape[0] >= 1
+            and fused_scan_eligible(W.shape, spec.bonds_mode, config)
+            else "xla"
+        )
 
     if epoch_impl in ("fused_scan", "fused_scan_mxu"):
         from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
