@@ -84,6 +84,28 @@ def main() -> None:
     print("MC dividend spread (std over scenarios):",
           np.round(totals.std(axis=0).mean(), 6))
 
+    # 5. Throughput path: weights varying every epoch, epoch_impl="auto"
+    # (on TPU this selects the single-Pallas-program scan — the bench.py
+    # headline; elsewhere it falls back to the XLA epoch kernel).
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.models.variants import variant_for_version
+    from yuma_simulation_tpu.simulation.engine import simulate_scaled
+
+    rng = np.random.default_rng(0)
+    V, M, E = 16, 256, 200
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    scales = jnp.asarray(1.0 + 1e-6 * np.arange(E, dtype=np.float32))
+    with timed(f"epoch-varying scan {V}x{M}", epochs=E):
+        total, _ = simulate_scaled(
+            W, S, scales, YumaConfig(), variant_for_version(names.YUMA),
+            epoch_impl="auto",
+        )
+        np.asarray(total)
+    print("varying-weights total dividends (sum):",
+          float(np.asarray(total).sum().round(4)))
+
 
 if __name__ == "__main__":
     main()

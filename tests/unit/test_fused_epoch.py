@@ -343,3 +343,7 @@ def test_epoch_impl_auto_selects_and_matches():
     assert not fused_scan_eligible((256, 4096), BondsMode.CAPACITY, cfg)
     # over the VMEM budget is never eligible
     assert not fused_scan_eligible((8192, 65536), BondsMode.EMA, cfg)
+    # f64 arrays are never eligible (the Pallas kernels are f32-only)
+    assert not fused_scan_eligible(
+        (256, 4096), BondsMode.EMA, cfg, jnp.float64
+    )

@@ -60,7 +60,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from yuma_simulation_tpu.models.epoch import BondsMode
+from yuma_simulation_tpu.models.epoch import _EMA_MODES, BondsMode
 
 _LANES = 128
 _SUBLANES = 8
@@ -217,9 +217,6 @@ def _fused_ema_epoch_kernel(
     inc_ref[:] = incentive
 
 
-_FUSED_MODES = (BondsMode.EMA, BondsMode.EMA_RUST, BondsMode.EMA_PREV)
-
-
 def _scan_resident_bytes(shape, mode: BondsMode) -> int:
     """VMEM bytes the fused scan keeps resident (W + B [+ W_prev]),
     padded to tile boundaries — the one source of truth for both the
@@ -229,12 +226,16 @@ def _scan_resident_bytes(shape, mode: BondsMode) -> int:
     return (3 if mode is BondsMode.EMA_PREV else 2) * Vp * Mp * 4
 
 
-def fused_scan_eligible(shape, mode: BondsMode, config) -> bool:
+def fused_scan_eligible(shape, mode: BondsMode, config, dtype=None) -> bool:
     """Whether :func:`fused_ema_scan` can run this workload — the
-    `epoch_impl="auto"` predicate: EMA-family bonds, no liquid alpha,
-    not Yuma-0-under-x64, within the VMEM budget, and on a real TPU
-    (interpret mode would be slower than XLA, not faster)."""
-    if mode not in _FUSED_MODES:
+    `epoch_impl="auto"` predicate: EMA-family bonds, float32 arrays, no
+    liquid alpha, not Yuma-0-under-x64, within the VMEM budget, and on a
+    real TPU (interpret mode would be slower than XLA, not faster)."""
+    if mode not in _EMA_MODES:
+        return False
+    if dtype is not None and jnp.dtype(dtype) != jnp.float32:
+        # Pallas TPU kernels here are f32-only (module docstring); an
+        # f64 input must fall back to XLA, not crash in Mosaic.
         return False
     if config.liquid_alpha:
         return False
@@ -334,7 +335,7 @@ def fused_ema_scan(
     the per-validator dividend-per-1000-tao conversion, which is linear in
     `D_n`, to the sum).
     """
-    if mode not in _FUSED_MODES:
+    if mode not in _EMA_MODES:
         raise ValueError(f"fused scan supports the EMA family only, got {mode}")
     if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
         raise ValueError(
@@ -462,7 +463,7 @@ def fused_ema_epoch(
       outputs of `yuma_epoch` (other named outputs are dead in the scan
       and intentionally not produced).
     """
-    if mode not in (BondsMode.EMA, BondsMode.EMA_RUST, BondsMode.EMA_PREV):
+    if mode not in _EMA_MODES:
         raise ValueError(f"fused epoch supports the EMA family only, got {mode}")
     if clip_base is not None and mode is not BondsMode.EMA_PREV:
         # The XLA reference kernel (yuma_epoch) ignores W_prev for the

@@ -339,7 +339,7 @@ def simulate_scaled(
         epoch_impl = (
             "fused_scan"
             if scales.shape[0] >= 1
-            and fused_scan_eligible(W.shape, spec.bonds_mode, config)
+            and fused_scan_eligible(W.shape, spec.bonds_mode, config, W.dtype)
             else "xla"
         )
 
