@@ -337,13 +337,72 @@ def test_epoch_impl_auto_selects_and_matches():
 
     on_tpu = jax.default_backend() == "tpu"
     assert fused_scan_eligible((256, 4096), BondsMode.EMA, cfg) == on_tpu
-    # liquid alpha and non-EMA modes are never eligible
+    # liquid alpha is never eligible — except CAPACITY, where the XLA
+    # oracle ignores it too (models/epoch.py), so the scan is parity-safe
     liquid = YumaConfig(yuma_params=YumaParams(liquid_alpha=True))
     assert not fused_scan_eligible((256, 4096), BondsMode.EMA, liquid)
-    assert not fused_scan_eligible((256, 4096), BondsMode.CAPACITY, cfg)
+    assert not fused_scan_eligible((256, 4096), BondsMode.RELATIVE, liquid)
+    assert fused_scan_eligible((256, 4096), BondsMode.CAPACITY, liquid) == on_tpu
+    # capacity/relative are eligible on TPU (all five models covered)
+    assert fused_scan_eligible((256, 4096), BondsMode.CAPACITY, cfg) == on_tpu
     # over the VMEM budget is never eligible
     assert not fused_scan_eligible((8192, 65536), BondsMode.EMA, cfg)
     # f64 arrays are never eligible (the Pallas kernels are f32-only)
     assert not fused_scan_eligible(
         (256, 4096), BondsMode.EMA, cfg, jnp.float64
     )
+
+
+@pytest.mark.parametrize(
+    "version",
+    ["Yuma 3 (Rhef)", "Yuma 4 (Rhef+relative bonds)"],
+    ids=["capacity", "relative"],
+)
+def test_fused_scan_capacity_relative_match_xla(version):
+    """The capacity and relative bond models in the single-Pallas-program
+    scan reproduce the XLA engine (the per-epoch fused kernels do not
+    cover these modes, so the XLA path is the oracle)."""
+    V, M, E = 8, 16, 12
+    rng = np.random.default_rng(17)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    scales = jnp.asarray(1.0 + 1e-4 * rng.random(E), jnp.float32)
+    cfg = YumaConfig()
+    spec = variant_for_version(version)
+
+    t_xla, b_xla = simulate_scaled(W, S, scales, cfg, spec, epoch_impl="xla")
+    t_scan, b_scan = simulate_scaled(
+        W, S, scales, cfg, spec, epoch_impl="fused_scan"
+    )
+    # Yuma 3 bonds sit on the ~1e19 capacity scale -> relative bound.
+    np.testing.assert_allclose(
+        np.asarray(b_scan), np.asarray(b_xla), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(t_scan), np.asarray(t_xla), rtol=2e-5
+    )
+
+
+def test_fused_scan_capacity_ignores_liquid_like_xla():
+    """CAPACITY + liquid_alpha is accepted by the fused scan (the XLA
+    kernel ignores liquid alpha for that mode, so results are identical
+    to the liquid-off run)."""
+    from yuma_simulation_tpu.models.config import YumaParams
+
+    V, M, E = 6, 12, 8
+    rng = np.random.default_rng(23)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    scales = jnp.ones(E, jnp.float32)
+    spec = variant_for_version("Yuma 3 (Rhef)")
+    liquid = YumaConfig(yuma_params=YumaParams(liquid_alpha=True))
+    plain = YumaConfig()
+
+    t_liquid, b_liquid = simulate_scaled(
+        W, S, scales, liquid, spec, epoch_impl="fused_scan"
+    )
+    t_plain, b_plain = simulate_scaled(
+        W, S, scales, plain, spec, epoch_impl="fused_scan"
+    )
+    np.testing.assert_array_equal(np.asarray(t_liquid), np.asarray(t_plain))
+    np.testing.assert_array_equal(np.asarray(b_liquid), np.asarray(b_plain))

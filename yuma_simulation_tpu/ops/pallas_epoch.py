@@ -1,4 +1,5 @@
-"""Fused Pallas TPU kernel: one whole EMA-family consensus epoch in VMEM.
+"""Fused Pallas TPU kernels: whole consensus epochs (and whole epoch
+scans) resident in VMEM.
 
 The unfused epoch (`models/epoch.py::yuma_epoch`) lowers to ~45 XLA
 elementwise passes over the `[V, M]` weight/bond arrays; at 256x4096 that
@@ -6,8 +7,12 @@ is VPU-roofline-bound at ~55 us/epoch on a v5e chip. This kernel runs the
 entire epoch pipeline —
 
     scale -> row-normalize -> 17-step bisection consensus -> u16 quantize
-    -> clip -> rank/incentive -> blended bonds -> column-normalize -> EMA
-    -> dividends
+    -> clip -> rank/incentive -> bond update -> dividends
+
+(bond update = blended/column-normalized EMA for the Yuma 0/1/2 family;
+:func:`fused_ema_scan` additionally covers the Yuma 3 capacity-purchase
+and Yuma 4 relative-bond models, so every named version except the
+liquid-alpha variants has a fused path)
 
 — as ONE Pallas program with W, B, and every intermediate resident in
 VMEM, and (optionally) the three stake contractions (bisection support,
@@ -60,7 +65,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from yuma_simulation_tpu.models.epoch import _EMA_MODES, BondsMode
+from yuma_simulation_tpu.models.epoch import _EMA_MODES, MAXINT, BondsMode
 
 _LANES = 128
 _SUBLANES = 8
@@ -96,10 +101,13 @@ def _epoch_math(
     mxu: bool,
     m_real: int,
     clip_fallback=None,
+    cap_alpha=None,
+    decay=None,
 ):
     """The one shared epoch pipeline both fused kernels trace:
     row-normalize -> bisection -> u16 quantize -> clip -> incentive ->
-    bond purchase -> EMA -> normalized dividends.
+    bond update (EMA / capacity purchase / relative) -> normalized
+    dividends.
 
     `clip_prev` is the EMA_PREV clip source (ignored by the other modes;
     None means "clip against this epoch's W_n"). `first` is the traced
@@ -155,27 +163,49 @@ def _epoch_math(
     R = _support(S, W_clipped, mxu)
     incentive = jnp.nan_to_num(R / jnp.sum(R))
 
-    # Bond purchase target.
-    if mode is BondsMode.EMA_RUST:
-        B_t = S * W_clipped
-        B_t = jnp.nan_to_num(B_t / (jnp.sum(B_t, axis=0, keepdims=True) + 1e-6))
-    else:
-        bond_base = W_n if mode is BondsMode.EMA else clip_base
-        W_b = (1.0 - beta) * bond_base + beta * W_clipped
-        B_t = S * W_b
-        # no epsilon (reference yumas.py:228, 342)
-        B_t = jnp.nan_to_num(B_t / jnp.sum(B_t, axis=0, keepdims=True))
+    # Bond update, by model family.
+    if mode in _EMA_MODES:
+        if mode is BondsMode.EMA_RUST:
+            B_t = S * W_clipped
+            B_t = jnp.nan_to_num(
+                B_t / (jnp.sum(B_t, axis=0, keepdims=True) + 1e-6)
+            )
+        else:
+            bond_base = W_n if mode is BondsMode.EMA else clip_base
+            W_b = (1.0 - beta) * bond_base + beta * W_clipped
+            B_t = S * W_b
+            # no epsilon (reference yumas.py:228, 342)
+            B_t = jnp.nan_to_num(B_t / jnp.sum(B_t, axis=0, keepdims=True))
 
-    ema = alpha * B_t + (1.0 - alpha) * B_old
-    B_ema = jnp.where(first, B_t, ema)
-    if mode is BondsMode.EMA_RUST:
-        B_ema = jnp.nan_to_num(
-            B_ema / (jnp.sum(B_ema, axis=0, keepdims=True) + 1e-6)
-        )
+        ema = alpha * B_t + (1.0 - alpha) * B_old
+        B_next = jnp.where(first, B_t, ema)
+        if mode is BondsMode.EMA_RUST:
+            B_next = jnp.nan_to_num(
+                B_next / (jnp.sum(B_next, axis=0, keepdims=True) + 1e-6)
+            )
+        D = jnp.sum(B_next * incentive, axis=1, keepdims=True)  # [V, 1]
+    elif mode is BondsMode.CAPACITY:
+        # Stake-capacity purchase, mirroring
+        # models.epoch.capacity_bonds_update (reference yumas.py:455-472):
+        # the 2^64-1 constant enters f32 arithmetic deliberately.
+        cap_vec = S * jnp.asarray(MAXINT, W.dtype)  # [V, 1]
+        remaining = jnp.clip(cap_vec - B_old, min=0.0)
+        purchase = jnp.minimum(cap_alpha * cap_vec, remaining) * W_n
+        B_next = (1.0 - decay) * B_old + purchase
+        B_next = jnp.minimum(B_next, cap_vec)
+        D = jnp.sum(B_next * incentive, axis=1, keepdims=True)
+    else:  # RELATIVE
+        # Per-(validator, miner) bonds in [0, 1], mirroring
+        # models.epoch.relative_bonds_update (reference yumas.py:574-590);
+        # dividends are stake-scaled.
+        B_dec = B_old * (1.0 - alpha)
+        remaining = jnp.clip(1.0 - B_dec, min=0.0)
+        purchase = jnp.minimum(alpha * W_n, remaining)
+        B_next = jnp.clip(B_dec + purchase, max=1.0)
+        D = S * jnp.sum(B_next * incentive, axis=1, keepdims=True)
 
-    D = jnp.sum(B_ema * incentive, axis=1, keepdims=True)  # [V, 1]
     D_n = D / (jnp.sum(D) + 1e-6)
-    return B_ema, D_n, incentive, W_n
+    return B_next, D_n, incentive, W_n
 
 
 def _fused_ema_epoch_kernel(
@@ -217,6 +247,12 @@ def _fused_ema_epoch_kernel(
     inc_ref[:] = incentive
 
 
+#: Every bond model the scan kernel implements; a future BondsMode member
+#: must be added here (and to _epoch_math) before the fused scan or the
+#: `auto` predicate may accept it.
+_SCAN_MODES = _EMA_MODES + (BondsMode.CAPACITY, BondsMode.RELATIVE)
+
+
 def _scan_resident_bytes(shape, mode: BondsMode) -> int:
     """VMEM bytes the fused scan keeps resident (W + B [+ W_prev]),
     padded to tile boundaries — the one source of truth for both the
@@ -228,16 +264,20 @@ def _scan_resident_bytes(shape, mode: BondsMode) -> int:
 
 def fused_scan_eligible(shape, mode: BondsMode, config, dtype=None) -> bool:
     """Whether :func:`fused_ema_scan` can run this workload — the
-    `epoch_impl="auto"` predicate: EMA-family bonds, float32 arrays, no
-    liquid alpha, not Yuma-0-under-x64, within the VMEM budget, and on a
-    real TPU (interpret mode would be slower than XLA, not faster)."""
-    if mode not in _EMA_MODES:
+    `epoch_impl="auto"` predicate: float32 arrays, no liquid alpha, not
+    Yuma-0-under-x64, within the VMEM budget, and on a real TPU
+    (interpret mode would be slower than XLA, not faster). All five bond
+    models are supported."""
+    if mode not in _SCAN_MODES:
         return False
     if dtype is not None and jnp.dtype(dtype) != jnp.float32:
         # Pallas TPU kernels here are f32-only (module docstring); an
         # f64 input must fall back to XLA, not crash in Mosaic.
         return False
-    if config.liquid_alpha:
+    if config.liquid_alpha and mode is not BondsMode.CAPACITY:
+        # The XLA oracle ignores liquid alpha for CAPACITY
+        # (models/epoch.py: the rate is fit only for the other modes),
+        # so the scan stays parity-safe there.
         return False
     if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
         return False
@@ -266,7 +306,8 @@ def _fused_ema_scan_kernel(
     the WHOLE scan, so the per-epoch HBM traffic of the lax.scan carry
     (read B, write B — ~8 MB/epoch at 256x4096) disappears entirely, and
     W's block index never changes so Pallas fetches it once. scal =
-    [kappa, beta, alpha]; scales is the per-epoch weight scale in SMEM."""
+    [kappa, beta, alpha, cap_alpha, decay]; scales is the per-epoch
+    weight scale in SMEM."""
     e = pl.program_id(0)
     first = e == 0
 
@@ -291,6 +332,8 @@ def _fused_ema_scan_kernel(
         mxu=mxu,
         m_real=m_real,
         clip_fallback=first,
+        cap_alpha=scal_ref[3],
+        decay=scal_ref[4],
     )
 
     b_scr[:] = B_ema
@@ -316,12 +359,15 @@ def fused_ema_scan(
     kappa=0.5,
     bond_penalty=1.0,
     bond_alpha=0.1,
+    capacity_alpha=0.1,
+    decay_rate=0.1,
     mode: BondsMode = BondsMode.EMA,
     mxu: bool = False,
     precision: int = 100_000,
     interpret: bool | None = None,
 ):
-    """The WHOLE epoch scan as one Pallas program (EMA family).
+    """The WHOLE epoch scan as one Pallas program (all five bond models;
+    liquid alpha stays on the XLA path).
 
     Epoch `e` simulates `W * scales[e]` (the epoch-varying workload of
     `simulate_scaled`). The grid iterates over epochs sequentially; the
@@ -335,8 +381,8 @@ def fused_ema_scan(
     the per-validator dividend-per-1000-tao conversion, which is linear in
     `D_n`, to the sum).
     """
-    if mode not in _EMA_MODES:
-        raise ValueError(f"fused scan supports the EMA family only, got {mode}")
+    if mode not in _SCAN_MODES:
+        raise ValueError(f"fused scan does not implement bonds mode {mode}")
     if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
         raise ValueError(
             "the fused kernel cannot reproduce Yuma-0's float64 quantization "
@@ -373,6 +419,8 @@ def fused_ema_scan(
             jnp.asarray(kappa, dtype),
             jnp.asarray(bond_penalty, dtype),
             jnp.asarray(bond_alpha, dtype),
+            jnp.asarray(capacity_alpha, dtype),
+            jnp.asarray(decay_rate, dtype),
         ]
     )
 

@@ -298,11 +298,11 @@ def simulate_scaled(
     `epoch_impl`:
       - "auto": pick the fastest *parity-safe* path — the
         single-Pallas-program VPU scan ("fused_scan") when the
-        variant/config/shape allow it (EMA family, no liquid alpha, f32
-        mode or non-Yuma-0, fits the VMEM budget, on TPU, >= 1 epoch),
-        otherwise the XLA path. Never selects the MXU variants (their
-        support sums can flip one 2^-17 consensus grid point); opt into
-        "fused_scan_mxu" explicitly for the last ~1.2x.
+        variant/config/shape allow it (any bonds model, no liquid alpha,
+        f32 arrays, non-Yuma-0 under x64, fits the VMEM budget, on TPU,
+        >= 1 epoch), otherwise the XLA path. Never selects the MXU
+        variants (their support sums can flip one 2^-17 consensus grid
+        point); opt into "fused_scan_mxu" explicitly for the last ~1.2x.
       - "xla": the unfused `yuma_epoch` (any variant/consensus_impl).
       - "fused": the Pallas VMEM-resident EMA-family epoch kernel
         (:func:`yuma_simulation_tpu.ops.pallas_epoch.fused_ema_epoch`),
@@ -314,7 +314,9 @@ def simulate_scaled(
         Pallas program — bond state resident in VMEM scratch across grid
         steps, W fetched from HBM once, no per-epoch dispatch
         (:func:`yuma_simulation_tpu.ops.pallas_epoch.fused_ema_scan`).
-        Same numerics as "fused"/"fused_mxu" respectively.
+        Covers all five bond models (capacity/relative included, unlike
+        the per-epoch "fused" paths); same numerics as "fused"/
+        "fused_mxu" for the EMA family.
 
     Returns `(total_dividends[V], final_bonds[V, M])` like
     `simulate_constant`.
@@ -346,9 +348,9 @@ def simulate_scaled(
     if epoch_impl in ("fused_scan", "fused_scan_mxu"):
         from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
 
-        if spec.bonds_mode not in _EMA_MODES:
-            raise ValueError("fused epoch_impl supports the EMA family only")
-        if config.liquid_alpha:
+        if config.liquid_alpha and spec.bonds_mode is not BondsMode.CAPACITY:
+            # CAPACITY ignores liquid alpha in the XLA oracle too
+            # (models/epoch.py), so the scan stays parity-safe there.
             raise ValueError("fused epoch_impl does not support liquid alpha")
         B_final, D_tot = fused_ema_scan(
             W,
@@ -357,6 +359,8 @@ def simulate_scaled(
             kappa=config.kappa,
             bond_penalty=config.bond_penalty,
             bond_alpha=config.bond_alpha,
+            capacity_alpha=config.capacity_alpha,
+            decay_rate=config.decay_rate,
             mode=spec.bonds_mode,
             mxu=epoch_impl == "fused_scan_mxu",
             precision=config.consensus_precision,
