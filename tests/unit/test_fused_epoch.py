@@ -175,6 +175,15 @@ t_f, b_f = simulate_scaled(W, S, scales, cfg, spec, epoch_impl="fused")
 t_s, b_s = simulate_scaled(W, S, scales, cfg, spec, epoch_impl="fused_scan")
 np.testing.assert_allclose(np.asarray(b_s), np.asarray(b_f), atol=3e-8)
 np.testing.assert_allclose(np.asarray(t_s), np.asarray(t_f), rtol=2e-6)
+
+# EMA_RUST + liquid alpha (no named version, but "auto" accepts it):
+# pin the fused scan against the XLA oracle.
+from yuma_simulation_tpu.models.config import YumaParams
+liquid_cfg = YumaConfig(yuma_params=YumaParams(liquid_alpha=True))
+t_x, b_x = simulate_scaled(W, S, scales, liquid_cfg, spec, epoch_impl="xla")
+t_l, b_l = simulate_scaled(W, S, scales, liquid_cfg, spec, epoch_impl="fused_scan")
+np.testing.assert_allclose(np.asarray(b_l), np.asarray(b_x), atol=2e-6)
+np.testing.assert_allclose(np.asarray(t_l), np.asarray(t_x), rtol=2e-5)
 print("EMA_RUST_SCAN_OK")
 """
     env = dict(os.environ)
@@ -406,3 +415,47 @@ def test_fused_scan_capacity_ignores_liquid_like_xla():
     )
     np.testing.assert_array_equal(np.asarray(t_liquid), np.asarray(t_plain))
     np.testing.assert_array_equal(np.asarray(b_liquid), np.asarray(b_plain))
+
+
+@pytest.mark.parametrize(
+    "version,params",
+    [
+        ("Yuma 1 (paper) - liquid alpha on", dict(liquid_alpha=True)),
+        (
+            "Yuma 4 (Rhef+relative bonds) - liquid alpha on",
+            dict(
+                liquid_alpha=True,
+                bond_alpha=0.025,
+                alpha_high=0.99,
+                alpha_low=0.9,
+            ),
+        ),
+        # No named version pairs Yuma 2 with liquid alpha, but "auto"
+        # accepts the combination, so pin it too (custom config).
+        ("Yuma 2 (Adrian-Fish)", dict(liquid_alpha=True)),
+    ],
+    ids=["yuma1-liquid", "yuma4-liquid", "yuma2-liquid"],
+)
+def test_fused_scan_liquid_matches_xla(version, params):
+    """Liquid alpha in the fused scan: in-kernel u16-grid order-statistic
+    quantiles + the same traced-logit fit as the XLA oracle."""
+    from yuma_simulation_tpu.models.config import YumaParams
+
+    V, M, E = 8, 24, 10
+    rng = np.random.default_rng(31)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    scales = jnp.asarray(1.0 + 1e-4 * rng.random(E), jnp.float32)
+    cfg = YumaConfig(yuma_params=YumaParams(**params))
+    spec = variant_for_version(version)
+
+    t_xla, b_xla = simulate_scaled(W, S, scales, cfg, spec, epoch_impl="xla")
+    t_scan, b_scan = simulate_scaled(
+        W, S, scales, cfg, spec, epoch_impl="fused_scan"
+    )
+    np.testing.assert_allclose(
+        np.asarray(b_scan), np.asarray(b_xla), atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(t_scan), np.asarray(t_xla), rtol=2e-5
+    )

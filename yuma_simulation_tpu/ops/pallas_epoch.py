@@ -11,8 +11,8 @@ entire epoch pipeline —
 
 (bond update = blended/column-normalized EMA for the Yuma 0/1/2 family;
 :func:`fused_ema_scan` additionally covers the Yuma 3 capacity-purchase
-and Yuma 4 relative-bond models, so every named version except the
-liquid-alpha variants has a fused path)
+and Yuma 4 relative-bond models plus liquid alpha, so every named
+version has a fused scan path — Yuma 0 only outside x64 parity mode)
 
 — as ONE Pallas program with W, B, and every intermediate resident in
 VMEM, and (optionally) the three stake contractions (bisection support,
@@ -43,9 +43,12 @@ bisection support test (yumas.py:89-91), truncating u16 quantization
 147-149), first-epoch bond adoption (yumas.py:145), and the `1e-6`
 dividend-normalization epsilon (yumas.py:262).
 
-Liquid alpha (per-miner EMA rates from consensus quantiles) is NOT fused
-— callers with `liquid_alpha=True` must use the XLA path. Likewise the
-x64 parity mode's Yuma-0 float64 quantization divide (reference
+Liquid alpha (per-miner EMA rates from consensus quantiles) is fused in
+the scan kernel: the quantiles are order statistics on the u16 grid,
+selected by an integer counting-bisection (no sort needed — see
+`_liquid_rate_on_grid`); only the static quantile *overrides* stay
+XLA-only. The per-epoch `fused_ema_epoch` remains liquid-free. Likewise
+the x64 parity mode's Yuma-0 float64 quantization divide (reference
 yumas.py:81,97): Pallas TPU kernels are f32-only, so the EMA_RUST mode
 raises under `jax_enable_x64` rather than silently diverging from the
 XLA path's f64 grid. Padded miner columns (from heterogeneous-case
@@ -86,6 +89,63 @@ def _support(S_col, mask, mxu: bool):
     return jnp.sum(mask * S_col, axis=0, keepdims=True)
 
 
+def _liquid_rate_on_grid(
+    C, logit_low, logit_num, alpha_low, alpha_high, *, n: int
+):
+    """Per-miner liquid-alpha EMA rate from the quantized consensus row
+    `[1, Mp]`, computed WITHOUT a sort (Mosaic has none): every C value
+    lies on the u16 grid, so each quantile's order statistics are found
+    by a 16-halving integer counting-bisection — `[Mp]`-wide counts, a
+    rounding-free exact selection. Linear interpolation between the two
+    adjacent order statistics then matches `jnp.quantile`'s "linear"
+    method; the logistic fit mirrors
+    :func:`yuma_simulation_tpu.ops.liquid.liquid_alpha_rate`'s
+    traced-scalar branch (the one the jitted XLA oracle takes), with
+    `logit_num = logit_high - logit_low` precomputed by the caller.
+    `n` is the (static) real miner count; padded columns are excluded
+    from the counts but still receive a rate (their bonds are zero).
+    """
+    dtype = C.dtype
+    Mp = C.shape[-1]
+    col = lax.broadcasted_iota(jnp.int32, (1, Mp), 1)
+    real = col < n
+    C_int = jnp.round(C * 65535.0).astype(jnp.int32)
+
+    def kth(k: int):
+        # Smallest grid integer v with #{real C_int <= v} >= k+1 — the
+        # k-th smallest (0-indexed). 16 halvings cover [0, 65535].
+        def body(_, carry):
+            lo, hi = carry
+            mid = (lo + hi) // 2
+            cnt = jnp.sum(jnp.where(real & (C_int <= mid), 1, 0))
+            ok = cnt >= k + 1
+            return jnp.where(ok, lo, mid + 1), jnp.where(ok, mid, hi)
+
+        _, hi = lax.fori_loop(
+            0, 16, body, (jnp.int32(0), jnp.int32(65535)), unroll=True
+        )
+        # Same division that built C, so the value is bitwise C's.
+        return hi.astype(dtype) / 65535.0
+
+    def quant(q: float):
+        p = q * (n - 1)
+        lo_i, hi_i = int(math.floor(p)), int(math.ceil(p))
+        v_lo = kth(lo_i)
+        if hi_i == lo_i:
+            return v_lo
+        frac = p - lo_i
+        return v_lo * (1.0 - frac) + kth(hi_i) * frac
+
+    c_high0 = quant(0.75)
+    c_low = quant(0.25)
+    # Degenerate spread: fall back to the 0.99 quantile (yumas.py:132-133).
+    c_high = jnp.where(c_high0 == c_low, quant(0.99), c_high0)
+    a = logit_num / (c_low - c_high)
+    b = logit_low + a * c_low
+    sig = 1.0 / (1.0 + jnp.asarray(math.e, dtype) ** (-a * C + b))
+    return (1.0 - jnp.clip(sig, alpha_low, alpha_high)).astype(dtype)
+
+
 def _epoch_math(
     W,
     S,
@@ -103,6 +163,8 @@ def _epoch_math(
     clip_fallback=None,
     cap_alpha=None,
     decay=None,
+    liquid: bool = False,
+    liquid_scal=None,  # (logit_low, logit_num, alpha_low, alpha_high)
 ):
     """The one shared epoch pipeline both fused kernels trace:
     row-normalize -> bisection -> u16 quantize -> clip -> incentive ->
@@ -163,6 +225,12 @@ def _epoch_math(
     R = _support(S, W_clipped, mxu)
     incentive = jnp.nan_to_num(R / jnp.sum(R))
 
+    # Consensus-dependent per-miner EMA rate (liquid alpha); the CAPACITY
+    # model never uses a rate (models/epoch.py: the fit is skipped there).
+    rate = alpha
+    if liquid and mode is not BondsMode.CAPACITY:
+        rate = _liquid_rate_on_grid(C, *liquid_scal, n=m_real)
+
     # Bond update, by model family.
     if mode in _EMA_MODES:
         if mode is BondsMode.EMA_RUST:
@@ -177,7 +245,7 @@ def _epoch_math(
             # no epsilon (reference yumas.py:228, 342)
             B_t = jnp.nan_to_num(B_t / jnp.sum(B_t, axis=0, keepdims=True))
 
-        ema = alpha * B_t + (1.0 - alpha) * B_old
+        ema = rate * B_t + (1.0 - rate) * B_old
         B_next = jnp.where(first, B_t, ema)
         if mode is BondsMode.EMA_RUST:
             B_next = jnp.nan_to_num(
@@ -198,9 +266,9 @@ def _epoch_math(
         # Per-(validator, miner) bonds in [0, 1], mirroring
         # models.epoch.relative_bonds_update (reference yumas.py:574-590);
         # dividends are stake-scaled.
-        B_dec = B_old * (1.0 - alpha)
+        B_dec = B_old * (1.0 - rate)
         remaining = jnp.clip(1.0 - B_dec, min=0.0)
-        purchase = jnp.minimum(alpha * W_n, remaining)
+        purchase = jnp.minimum(rate * W_n, remaining)
         B_next = jnp.clip(B_dec + purchase, max=1.0)
         D = S * jnp.sum(B_next * incentive, axis=1, keepdims=True)
 
@@ -264,20 +332,25 @@ def _scan_resident_bytes(shape, mode: BondsMode) -> int:
 
 def fused_scan_eligible(shape, mode: BondsMode, config, dtype=None) -> bool:
     """Whether :func:`fused_ema_scan` can run this workload — the
-    `epoch_impl="auto"` predicate: float32 arrays, no liquid alpha, not
-    Yuma-0-under-x64, within the VMEM budget, and on a real TPU
-    (interpret mode would be slower than XLA, not faster). All five bond
-    models are supported."""
+    `epoch_impl="auto"` predicate: float32 arrays, no consensus-quantile
+    overrides, not Yuma-0-under-x64, within the VMEM budget, and on a
+    real TPU (interpret mode would be slower than XLA, not faster). All
+    five bond models and liquid alpha are supported."""
     if mode not in _SCAN_MODES:
         return False
     if dtype is not None and jnp.dtype(dtype) != jnp.float32:
         # Pallas TPU kernels here are f32-only (module docstring); an
         # f64 input must fall back to XLA, not crash in Mosaic.
         return False
-    if config.liquid_alpha and mode is not BondsMode.CAPACITY:
-        # The XLA oracle ignores liquid alpha for CAPACITY
-        # (models/epoch.py: the rate is fit only for the other modes),
-        # so the scan stays parity-safe there.
+    if (
+        config.liquid_alpha
+        and mode is not BondsMode.CAPACITY  # CAPACITY skips the fit
+        and (
+            config.override_consensus_high is not None
+            or config.override_consensus_low is not None
+        )
+    ):
+        # The in-kernel quantile selection has no override path.
         return False
     if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
         return False
@@ -301,13 +374,15 @@ def _fused_ema_scan_kernel(
     mxu: bool,
     m_real: int,
     num_epochs: int,
+    liquid: bool,
 ):
     """One grid step = one epoch; the bond state lives in VMEM scratch for
     the WHOLE scan, so the per-epoch HBM traffic of the lax.scan carry
     (read B, write B — ~8 MB/epoch at 256x4096) disappears entirely, and
     W's block index never changes so Pallas fetches it once. scal =
-    [kappa, beta, alpha, cap_alpha, decay]; scales is the per-epoch
-    weight scale in SMEM."""
+    [kappa, beta, alpha, cap_alpha, decay, logit_low, logit_num,
+    alpha_low, alpha_high]; scales is the per-epoch weight scale in
+    SMEM."""
     e = pl.program_id(0)
     first = e == 0
 
@@ -334,6 +409,8 @@ def _fused_ema_scan_kernel(
         clip_fallback=first,
         cap_alpha=scal_ref[3],
         decay=scal_ref[4],
+        liquid=liquid,
+        liquid_scal=(scal_ref[5], scal_ref[6], scal_ref[7], scal_ref[8]),
     )
 
     b_scr[:] = B_ema
@@ -349,7 +426,7 @@ def _fused_ema_scan_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mode", "mxu", "interpret", "precision"),
+    static_argnames=("mode", "mxu", "interpret", "precision", "liquid_alpha"),
 )
 def fused_ema_scan(
     W: jnp.ndarray,
@@ -361,13 +438,16 @@ def fused_ema_scan(
     bond_alpha=0.1,
     capacity_alpha=0.1,
     decay_rate=0.1,
+    liquid_alpha: bool = False,
+    alpha_low=0.7,
+    alpha_high=0.9,
     mode: BondsMode = BondsMode.EMA,
     mxu: bool = False,
     precision: int = 100_000,
     interpret: bool | None = None,
 ):
-    """The WHOLE epoch scan as one Pallas program (all five bond models;
-    liquid alpha stays on the XLA path).
+    """The WHOLE epoch scan as one Pallas program (all five bond models,
+    liquid alpha included — quantile overrides stay on the XLA path).
 
     Epoch `e` simulates `W * scales[e]` (the epoch-varying workload of
     `simulate_scaled`). The grid iterates over epochs sequentially; the
@@ -414,6 +494,16 @@ def fused_ema_scan(
         jnp.zeros((Vp, Mp), dtype).at[:V, :M].set(W) if padded else W
     )
     S_p = jnp.zeros((Vp, 1), dtype).at[:V, 0].set(jnp.asarray(S_n, dtype))
+    if liquid_alpha:
+        # The traced-scalar logit branch of liquid_alpha_rate — the one
+        # the jitted XLA oracle takes (alpha bounds are traced pytree
+        # leaves), so the fused path mirrors its rounding.
+        al = jnp.asarray(alpha_low, dtype)
+        ah = jnp.asarray(alpha_high, dtype)
+        logit_low = jnp.log(1.0 / al - 1.0)
+        logit_num = jnp.log(1.0 / ah - 1.0) - logit_low
+    else:
+        al = ah = logit_low = logit_num = jnp.zeros((), dtype)
     scal = jnp.stack(
         [
             jnp.asarray(kappa, dtype),
@@ -421,6 +511,10 @@ def fused_ema_scan(
             jnp.asarray(bond_alpha, dtype),
             jnp.asarray(capacity_alpha, dtype),
             jnp.asarray(decay_rate, dtype),
+            logit_low,
+            logit_num,
+            al,
+            ah,
         ]
     )
 
@@ -442,6 +536,7 @@ def fused_ema_scan(
             mxu=mxu,
             m_real=M,
             num_epochs=E,
+            liquid=liquid_alpha,
         ),
         grid=(E,),
         in_specs=[

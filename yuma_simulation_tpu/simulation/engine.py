@@ -298,9 +298,10 @@ def simulate_scaled(
     `epoch_impl`:
       - "auto": pick the fastest *parity-safe* path — the
         single-Pallas-program VPU scan ("fused_scan") when the
-        variant/config/shape allow it (any bonds model, no liquid alpha,
-        f32 arrays, non-Yuma-0 under x64, fits the VMEM budget, on TPU,
-        >= 1 epoch), otherwise the XLA path. Never selects the MXU
+        variant/config/shape allow it (any bonds model incl. liquid
+        alpha, no quantile overrides, f32 arrays, non-Yuma-0 under x64,
+        fits the VMEM budget, on TPU, >= 1 epoch), otherwise the XLA
+        path. Never selects the MXU
         variants (their support sums can flip one 2^-17 consensus grid
         point); opt into "fused_scan_mxu" explicitly for the last ~1.2x.
       - "xla": the unfused `yuma_epoch` (any variant/consensus_impl).
@@ -348,10 +349,20 @@ def simulate_scaled(
     if epoch_impl in ("fused_scan", "fused_scan_mxu"):
         from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
 
-        if config.liquid_alpha and spec.bonds_mode is not BondsMode.CAPACITY:
-            # CAPACITY ignores liquid alpha in the XLA oracle too
-            # (models/epoch.py), so the scan stays parity-safe there.
-            raise ValueError("fused epoch_impl does not support liquid alpha")
+        if (
+            config.liquid_alpha
+            and spec.bonds_mode is not BondsMode.CAPACITY
+            and (
+                config.override_consensus_high is not None
+                or config.override_consensus_low is not None
+            )
+        ):
+            # CAPACITY skips the liquid fit entirely (models/epoch.py),
+            # so overrides are moot there.
+            raise ValueError(
+                "fused epoch_impl does not support consensus-quantile "
+                "overrides; use the XLA path"
+            )
         B_final, D_tot = fused_ema_scan(
             W,
             S / S.sum(),
@@ -361,6 +372,9 @@ def simulate_scaled(
             bond_alpha=config.bond_alpha,
             capacity_alpha=config.capacity_alpha,
             decay_rate=config.decay_rate,
+            liquid_alpha=config.liquid_alpha,
+            alpha_low=config.alpha_low,
+            alpha_high=config.alpha_high,
             mode=spec.bonds_mode,
             mxu=epoch_impl == "fused_scan_mxu",
             precision=config.consensus_precision,
