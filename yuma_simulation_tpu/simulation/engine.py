@@ -70,6 +70,19 @@ def _miner_shardings(mesh: Mesh):
     return vm, m
 
 
+def _dividends_per_1k(D_n, S, config, dtype):
+    """Dividend per 1000 tao (reference simulation_utils.py:45-49,
+    95-107), from NORMALIZED dividends and the *raw* stakes. One shared
+    definition: this arithmetic is parity-critical and every engine path
+    (XLA scan, fused case scan, scaled/constant throughput paths) must
+    apply bit-identical ops."""
+    stakes_units = jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
+    emission = (
+        config.validator_emission_ratio * D_n * config.total_epoch_emission
+    )
+    return jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
+
+
 def _apply_reset(B, C_prev, epoch, reset_index, reset_epoch, reset_mode, M):
     """Zero the reset miner's bond column when the variant's rule fires
     (reference simulation_utils.py:62-88). `reset_epoch < 0` disables.
@@ -162,19 +175,10 @@ def _simulate_scan(
             W_prev_next = lax.with_sharding_constraint(W_prev_next, vm)
             C_next = lax.with_sharding_constraint(C_next, m)
 
-        # Dividend per 1000 tao (reference simulation_utils.py:45-49, 95-107);
-        # note the conversion uses the *raw* case stakes, not the normalized
-        # kernel stakes.
-        stakes_units = (
-            jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
-        )
-        emission = (
-            config.validator_emission_ratio
-            * res["validator_reward_normalized"]
-            * config.total_epoch_emission
-        )
-        dividends = jnp.where(
-            stakes_units > 1e-6, emission / stakes_units, 0.0
+        # Note the conversion uses the *raw* case stakes, not the
+        # normalized kernel stakes.
+        dividends = _dividends_per_1k(
+            res["validator_reward_normalized"], S, config, dtype
         )
 
         ys = {"dividends": dividends}
@@ -196,6 +200,75 @@ def _simulate_scan(
     return ys
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "spec",
+        "save_bonds",
+        "save_incentives",
+        "save_consensus",
+    ),
+)
+def _simulate_case_fused(
+    weights: jnp.ndarray,  # [E, V, M]
+    stakes: jnp.ndarray,  # [E, V]
+    reset_index: jnp.ndarray,
+    reset_epoch: jnp.ndarray,
+    config: YumaConfig,
+    spec: VariantSpec,
+    save_bonds: bool = True,
+    save_incentives: bool = True,
+    save_consensus: bool = False,
+):
+    """The fused-Pallas twin of :func:`_simulate_scan`: the whole epoch
+    loop — per-epoch weights/stakes streamed from HBM, reset injection,
+    liquid alpha — runs as ONE Pallas program
+    (:func:`yuma_simulation_tpu.ops.pallas_epoch.fused_case_scan`); only
+    the dividend-per-1000-tao conversion (linear, needs the raw per-epoch
+    stakes) happens out here. Returns the same ys dict as
+    `_simulate_scan`."""
+    from yuma_simulation_tpu.ops.pallas_epoch import (
+        fused_case_scan,
+        liquid_overrides_block_fused,
+    )
+
+    if liquid_overrides_block_fused(config, spec.bonds_mode):
+        raise ValueError(
+            "the fused case scan does not support consensus-quantile "
+            "overrides; use epoch_impl='xla'"
+        )
+    dtype = weights.dtype
+    res = fused_case_scan(
+        weights,
+        stakes,
+        reset_index=reset_index,
+        reset_epoch=reset_epoch,
+        reset_mode=spec.reset_mode,
+        kappa=config.kappa,
+        bond_penalty=config.bond_penalty,
+        bond_alpha=config.bond_alpha,
+        capacity_alpha=config.capacity_alpha,
+        decay_rate=config.decay_rate,
+        liquid_alpha=config.liquid_alpha,
+        alpha_low=config.alpha_low,
+        alpha_high=config.alpha_high,
+        mode=spec.bonds_mode,
+        precision=config.consensus_precision,
+        save_bonds=save_bonds,
+        save_incentives=save_incentives,
+        save_consensus=save_consensus,
+    )
+    ys = {
+        "dividends": _dividends_per_1k(
+            res["dividends_normalized"], stakes, config, dtype
+        )
+    }
+    for key in ("bonds", "incentives", "consensus"):
+        if key in res:
+            ys[key] = res[key]
+    return ys
+
+
 def simulate(
     scenario: Scenario,
     yuma_version: str,
@@ -205,43 +278,103 @@ def simulate(
     save_incentives: bool = True,
     save_consensus: bool = False,
     consensus_impl: str = "bisect",
+    epoch_impl: str = "auto",
     dtype=jnp.float32,
     mesh: Optional[Mesh] = None,
 ) -> SimulationResult:
     """Simulate one scenario under one named version; returns host arrays.
 
+    `epoch_impl`:
+      - "auto" (default): run the whole epoch loop as a single Pallas
+        program (`fused_case_scan` — per-epoch weights/stakes streamed
+        through VMEM, the flagship kernel) when the variant/config/shape
+        allow it on a real TPU, else the XLA `lax.scan`. The fused path
+        matches the XLA path to reduction-order rounding (pinned against
+        the golden CSV surface by tests/unit/test_fused_case_scan.py).
+      - "xla": always the `lax.scan` over the unfused epoch kernel.
+      - "fused_scan": require the fused path (raises if ineligible;
+        off-TPU it runs in interpret mode — correct but slow, for tests).
+
     With ``mesh``, the miner axis of every `[V, M]` matrix is sharded over
     the mesh's last axis for the whole multi-epoch scan — the path for
-    subnets whose `V x M` state outgrows one chip's HBM. Results are
-    identical to the unsharded run (pinned by tests/unit/test_multichip.py).
+    subnets whose `V x M` state outgrows one chip's HBM (XLA path only).
+    Sharded results match the unsharded run to within one u16 consensus
+    grid step — cross-shard psum ordering can flip the truncating
+    quantizer by one 2^-17 step on knife-edge values — with bounds pinned
+    by tests/unit/test_multichip.py.
     """
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
     weights = jnp.asarray(scenario.weights, dtype)
-    if mesh is not None:
-        axis = mesh.axis_names[-1]
-        weights = jax.device_put(
-            weights, NamedSharding(mesh, PartitionSpec(None, None, axis))
-        )
-    ys = _simulate_scan(
-        weights,
-        jnp.asarray(scenario.stakes, dtype),
-        jnp.asarray(
-            -1 if scenario.reset_bonds_index is None else scenario.reset_bonds_index,
-            jnp.int32,
-        ),
-        jnp.asarray(
-            -1 if scenario.reset_bonds_epoch is None else scenario.reset_bonds_epoch,
-            jnp.int32,
-        ),
-        config,
-        spec,
-        save_bonds=save_bonds,
-        save_incentives=save_incentives,
-        save_consensus=save_consensus,
-        consensus_impl=consensus_impl,
-        mesh=mesh,
+    stakes = jnp.asarray(scenario.stakes, dtype)
+    reset_index = jnp.asarray(
+        -1 if scenario.reset_bonds_index is None else scenario.reset_bonds_index,
+        jnp.int32,
     )
+    reset_epoch = jnp.asarray(
+        -1 if scenario.reset_bonds_epoch is None else scenario.reset_bonds_epoch,
+        jnp.int32,
+    )
+
+    if epoch_impl == "auto":
+        from yuma_simulation_tpu.ops.pallas_epoch import fused_case_scan_eligible
+
+        epoch_impl = (
+            "fused_scan"
+            if mesh is None
+            and consensus_impl == "bisect"
+            and weights.shape[0] >= 1
+            and fused_case_scan_eligible(
+                weights.shape, spec.bonds_mode, config, dtype, save_bonds
+            )
+            else "xla"
+        )
+    if epoch_impl == "fused_scan":
+        if mesh is not None:
+            raise ValueError(
+                "the fused case scan is a single-core Pallas program; "
+                "miner-axis sharding requires epoch_impl='xla'"
+            )
+        if consensus_impl != "bisect":
+            raise ValueError(
+                "the fused case scan computes consensus by bisection; "
+                f"consensus_impl={consensus_impl!r} requires epoch_impl='xla'"
+            )
+        ys = _simulate_case_fused(
+            weights,
+            stakes,
+            reset_index,
+            reset_epoch,
+            config,
+            spec,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=save_consensus,
+        )
+    elif epoch_impl == "xla":
+        if mesh is not None:
+            axis = mesh.axis_names[-1]
+            weights = jax.device_put(
+                weights, NamedSharding(mesh, PartitionSpec(None, None, axis))
+            )
+        ys = _simulate_scan(
+            weights,
+            stakes,
+            reset_index,
+            reset_epoch,
+            config,
+            spec,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=save_consensus,
+            consensus_impl=consensus_impl,
+            mesh=mesh,
+        )
+    else:
+        raise ValueError(
+            f"unknown epoch_impl {epoch_impl!r}; "
+            "expected 'auto', 'xla' or 'fused_scan'"
+        )
     ys = jax.device_get(ys)
     return SimulationResult(
         dividends=ys["dividends"],
@@ -324,13 +457,9 @@ def simulate_scaled(
     """
     V, M = W.shape
     dtype = W.dtype
-    stakes_units = jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
 
     def to_dividends(D_n):
-        emission = (
-            config.validator_emission_ratio * D_n * config.total_epoch_emission
-        )
-        return jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
+        return _dividends_per_1k(D_n, S, config, dtype)
 
     if epoch_impl == "auto":
         from yuma_simulation_tpu.ops.pallas_epoch import fused_scan_eligible
@@ -347,18 +476,12 @@ def simulate_scaled(
         )
 
     if epoch_impl in ("fused_scan", "fused_scan_mxu"):
-        from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
+        from yuma_simulation_tpu.ops.pallas_epoch import (
+            fused_ema_scan,
+            liquid_overrides_block_fused,
+        )
 
-        if (
-            config.liquid_alpha
-            and spec.bonds_mode is not BondsMode.CAPACITY
-            and (
-                config.override_consensus_high is not None
-                or config.override_consensus_low is not None
-            )
-        ):
-            # CAPACITY skips the liquid fit entirely (models/epoch.py),
-            # so overrides are moot there.
+        if liquid_overrides_block_fused(config, spec.bonds_mode):
             raise ValueError(
                 "fused epoch_impl does not support consensus-quantile "
                 "overrides; use the XLA path"
@@ -467,6 +590,88 @@ def simulate_scaled(
 
 @partial(
     jax.jit,
+    static_argnames=("spec", "consensus_impl", "epoch_impl"),
+)
+def simulate_scaled_batch(
+    W: jnp.ndarray,  # [B, V, M] per-scenario base weights
+    S: jnp.ndarray,  # [B, V]
+    scales: jnp.ndarray,  # [E] shared per-epoch weight scale
+    config: YumaConfig,
+    spec: VariantSpec,
+    consensus_impl: str = "bisect",
+    epoch_impl: str = "xla",
+):
+    """A scenario batch of the epoch-varying throughput workload
+    (:func:`simulate_scaled`), sharing one compiled program.
+
+    A single 256x4096 run keeps the chip a few percent utilized
+    (DESIGN.md "Utilization"): each of the ~45 VPU passes per epoch is
+    latency- not bandwidth-bound at that size, and they are sequentially
+    dependent. Batching advances all `B` scenarios together so every
+    pass works on `B`-fold data — the chip-filling configuration for
+    varying-weights work.
+
+    `epoch_impl`: "xla" (`vmap` over the per-scenario scan) or
+    "fused_scan" (the batched single-Pallas-program scan — parity-safe
+    VPU reductions; the MXU variant is single-scenario only). "auto"
+    picks "fused_scan" when eligible on this backend.
+
+    Returns `(total_dividends [B, V], final_bonds [B, V, M])`.
+    """
+    if epoch_impl == "auto":
+        from yuma_simulation_tpu.ops.pallas_epoch import fused_scan_eligible
+
+        epoch_impl = (
+            "fused_scan"
+            if scales.shape[0] >= 1
+            and fused_scan_eligible(W.shape, spec.bonds_mode, config, W.dtype)
+            else "xla"
+        )
+    if epoch_impl == "fused_scan":
+        from yuma_simulation_tpu.ops.pallas_epoch import (
+            fused_ema_scan,
+            liquid_overrides_block_fused,
+        )
+
+        if liquid_overrides_block_fused(config, spec.bonds_mode):
+            raise ValueError(
+                "fused epoch_impl does not support consensus-quantile "
+                "overrides; use the XLA path"
+            )
+        B_final, D_tot = fused_ema_scan(
+            W,
+            S / S.sum(axis=-1, keepdims=True),
+            scales,
+            kappa=config.kappa,
+            bond_penalty=config.bond_penalty,
+            bond_alpha=config.bond_alpha,
+            capacity_alpha=config.capacity_alpha,
+            decay_rate=config.decay_rate,
+            liquid_alpha=config.liquid_alpha,
+            alpha_low=config.alpha_low,
+            alpha_high=config.alpha_high,
+            mode=spec.bonds_mode,
+            precision=config.consensus_precision,
+        )
+        return _dividends_per_1k(D_tot, S, config, W.dtype), B_final
+    if epoch_impl != "xla":
+        # "fused_scan_mxu" included: the MXU contraction is 2-D only, so
+        # the batched API has no MXU variant — silently measuring the
+        # XLA fallback would corrupt benchmarks.
+        raise ValueError(
+            f"unknown epoch_impl {epoch_impl!r} for simulate_scaled_batch; "
+            "expected 'auto', 'xla' or 'fused_scan'"
+        )
+    return jax.vmap(
+        lambda w, s: simulate_scaled(
+            w, s, scales, config, spec,
+            consensus_impl=consensus_impl, epoch_impl="xla",
+        )
+    )(W, S)
+
+
+@partial(
+    jax.jit,
     static_argnames=(
         "num_epochs", "spec", "consensus_impl", "hoist_invariant", "mesh"
     ),
@@ -511,7 +716,6 @@ def simulate_constant(
     shardings = None if mesh is None else _miner_shardings(mesh)
     if shardings is not None:
         W = lax.with_sharding_constraint(W, shardings[0])
-    stakes_units = jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
 
     def step(carry, epoch):
         B, W_prev, C_prev, acc = carry
@@ -538,12 +742,9 @@ def simulate_constant(
             first_epoch=first,
             consensus_impl=consensus_impl,
         )
-        emission = (
-            config.validator_emission_ratio
-            * res["validator_reward_normalized"]
-            * config.total_epoch_emission
+        dividends = _dividends_per_1k(
+            res["validator_reward_normalized"], S, config, dtype
         )
-        dividends = jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
         B_next = res[spec.bond_state_key]
         W_prev_next = res["weight"] if spec.carries_prev_weights else W_prev
         return (
@@ -585,7 +786,6 @@ def _simulate_constant_hoisted(
     shardings = None if mesh is None else _miner_shardings(mesh)
     if shardings is not None:
         W = lax.with_sharding_constraint(W, shardings[0])
-    stakes_units = jnp.asarray(S, dtype) * config.total_subnet_stake / 1000.0
 
     # Full kernel once; also the source of the final outputs' first step.
     res0 = yuma_epoch(
@@ -617,10 +817,7 @@ def _simulate_constant_hoisted(
         else:
             D = (B * incentive).sum(axis=-1)
         D_n = D / (D.sum() + 1e-6)
-        emission = (
-            config.validator_emission_ratio * D_n * config.total_epoch_emission
-        )
-        return jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
+        return _dividends_per_1k(D_n, S, config, dtype)
 
     pin = (
         (lambda B: lax.with_sharding_constraint(B, shardings[0]))
