@@ -399,6 +399,7 @@ def run_simulation(
         validator: [float(x) for x in result.dividends[:, i]]
         for i, validator in enumerate(case.validators)
     }
+    assert result.bonds is not None and result.incentives is not None
     bonds_per_epoch = list(result.bonds)
     server_incentives_per_epoch = list(result.incentives)
     return dividends_per_validator, bonds_per_epoch, server_incentives_per_epoch

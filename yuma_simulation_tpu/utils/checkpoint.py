@@ -81,8 +81,23 @@ class CheckpointedSweep:
                     f"checkpoint dir {self.directory} holds a different "
                     f"sweep: {mismatched}"
                 )
-            if found.keys() != meta.keys():
-                manifest.write_text(json.dumps(meta))
+            missing = set(meta) - set(found)
+            if missing:
+                if "config_fingerprint" in missing and self.completed_chunks():
+                    # The legacy manifest never recorded what produced the
+                    # existing chunks; stamping the current fingerprint is
+                    # an assumption, not a verification.
+                    logger.warning(
+                        "legacy manifest in %s has no config_fingerprint; "
+                        "existing chunks are assumed (not verified) to match "
+                        "the current config",
+                        self.directory,
+                    )
+                # Backfill only what's absent; keys written by a newer
+                # version (present only in the old manifest) survive.
+                manifest.write_text(
+                    json.dumps(found | {k: meta[k] for k in missing})
+                )
         else:
             manifest.write_text(json.dumps(meta))
 
@@ -129,7 +144,7 @@ class CheckpointedSweep:
             if progress is not None:
                 progress(i, self.num_chunks)
         parts = [
-            np.load(self._chunk_path(i))["result"]
+            np.load(self._chunk_path(i), allow_pickle=False)["result"]
             for i in range(self.num_chunks)
         ]
         return np.concatenate(parts, axis=0)

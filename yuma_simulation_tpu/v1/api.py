@@ -154,6 +154,7 @@ def generate_chart_table(
                     )
                 else:  # pragma: no cover
                     raise ValueError("Invalid chart type.")
+                assert img is not None  # to_base64=True always returns html
                 table_data[yuma_version].append(img)
             row += 1
         case_row_ranges.append((case_start, row - 1, idx))
