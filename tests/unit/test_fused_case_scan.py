@@ -262,6 +262,13 @@ def test_simulate_epoch_impl_routing():
     np.testing.assert_allclose(
         r_fused.dividends, r_xla.dividends, atol=2e-6, rtol=1e-5
     )
+    # The explicit MXU opt-in routes too (in interpret mode its dot is
+    # plain f32, so it stays within rounding of the XLA path; the bf16x3
+    # on-chip bound is pinned by MXU_PARITY.json via tools/tpu_parity.py).
+    r_mxu = simulate(case, "Yuma 1 (paper)", cfg, epoch_impl="fused_scan_mxu")
+    np.testing.assert_allclose(
+        r_mxu.dividends, r_xla.dividends, atol=1e-4, rtol=1e-3
+    )
     with pytest.raises(ValueError, match="epoch_impl"):
         simulate(case, "Yuma 1 (paper)", cfg, epoch_impl="nope")
 
@@ -304,6 +311,24 @@ def test_simulate_scaled_batch_rejects_unknown_impl():
     # XLA would corrupt benchmarks, so the batched API raises.
     with pytest.raises(ValueError, match="epoch_impl"):
         simulate_scaled_batch(W, S, ones, cfg, spec, epoch_impl="fused_scan_mxu")
+
+
+def test_simulate_scaled_rejects_unknown_impl():
+    # A typo'd impl must not silently benchmark the XLA path under the
+    # wrong label.
+    from yuma_simulation_tpu.simulation.engine import simulate_scaled
+
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 1 (paper)")
+    with pytest.raises(ValueError, match="unknown epoch_impl"):
+        simulate_scaled(
+            jnp.ones((4, 8), jnp.float32),
+            jnp.ones((4,), jnp.float32),
+            jnp.ones(2, jnp.float32),
+            cfg,
+            spec,
+            epoch_impl="fused_scan_vpu",
+        )
 
 
 def test_simulate_fused_rejects_sorted_consensus():

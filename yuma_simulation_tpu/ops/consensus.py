@@ -31,6 +31,22 @@ def _bisection_iterations(precision: int) -> int:
     return int(math.ceil(math.log2(precision)))
 
 
+#: Above this many `V x M` cells the sorted closed form's XLA program hits
+#: pathological remote-compile times (minutes to hours at >= 512x8192 on
+#: the remote-tunnel TPU runtime, vs seconds for bisection at every rung —
+#: DESIGN.md "Operational caveats"). Both implementations produce bitwise
+#: identical values (tests/unit/test_consensus_fuzz.py), so the gate only
+#: trades compile time against a slightly cheaper runtime at small shapes.
+SORTED_COMPILE_PATHOLOGY_CELLS = 512 * 8192
+
+
+def default_consensus_impl(num_validators: int, num_miners: int) -> str:
+    """Shape-gated consensus default: "sorted" below the documented
+    compile-pathology threshold, "bisect" at or above it."""
+    cells = num_validators * num_miners
+    return "sorted" if cells < SORTED_COMPILE_PATHOLOGY_CELLS else "bisect"
+
+
 def stake_weighted_median(
     W: jnp.ndarray,
     S: jnp.ndarray,

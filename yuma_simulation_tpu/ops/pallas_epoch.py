@@ -15,14 +15,19 @@ and Yuma 4 relative-bond models plus liquid alpha, so every named
 version has a fused scan path — Yuma 0 only outside x64 parity mode)
 
 — as ONE Pallas program with W, B, and every intermediate resident in
-VMEM, and (optionally) the three stake contractions (bisection support,
+VMEM, and (optionally) the two stake contractions (bisection support,
 rank, nothing else reduces over V) on the MXU instead of the VPU. At
 256x4096 with weights varying every epoch (nothing hoistable) and long
-scans (per-dispatch tunnel latency amortized), the per-epoch MXU variant
-runs ~47k epochs/s (~21 us/epoch) vs ~17k for the unfused XLA epoch
-(~59 us/epoch) on one v5e chip; :func:`fused_ema_scan` — the whole scan
-as a single Pallas program with the bond state never leaving VMEM —
-reaches ~62k (~16 us/epoch), the bench.py headline.
+scans (per-dispatch tunnel latency amortized), :func:`fused_ema_scan` —
+the whole scan as a single Pallas program with the bond state never
+leaving VMEM — runs ~38k epochs/s (~26 us/epoch) on the parity-safe VPU
+path (the bench.py headline) and ~75k (~13 us/epoch) on the
+parity-relaxed MXU variant, vs ~17k for the unfused XLA epoch
+(~59 us/epoch) on one v5e chip. The scan is VMEM-bandwidth-bound: the
+17 bisection halvings each traverse the [V, M] weights, so the select
+is fused straight into the stake reduce (`_epoch_math`), and batching
+scenarios only pays at small shapes where a single run is latency-bound
+(DESIGN.md "Utilization", measured bandwidth ceiling ~4.3 TB/s).
 
 Numerics:
 - `mxu=False` (default): all reductions on the VPU in f32. Matches the
@@ -32,8 +37,10 @@ Numerics:
 - `mxu=True` (bench fast path): support and rank ride the MXU's bf16x3
   f32 decomposition. Support values can differ from the VPU sum by ~1 ulp,
   which near `support == kappa` can flip one 2^-17 consensus grid point
-  (observed max bond deviation ~4e-5 at 256x4096). Opt-in, for throughput
-  sweeps where the CSV-parity contract is not in play.
+  (observed max bond deviation ~4e-5 at 256x4096; worst total-dividend
+  deviation over the full 14x9x4 golden suite measured ON CHIP at 2.1e-4
+  — pinned in MXU_PARITY.json by tools/tpu_parity.py). Opt-in, for
+  throughput sweeps where the CSV-parity contract is not in play.
 
 Reference semantics reproduced (same as `yuma_epoch`, reference
 yumas.py:61-282): `+1e-6` row-normalization epsilon, strict `>` in the
@@ -82,8 +89,12 @@ def _round_up(x: int, mult: int) -> int:
 
 def _support(S_col, mask, mxu: bool):
     """Stake contraction over validators: `[..., V, 1] x [..., V, T] ->
-    [..., 1, T]`. The MXU variant is 2-D only (batched callers force the
-    VPU sum, which is also the parity-safe side)."""
+    [..., 1, T]`. The MXU variant (bf16x3, default dot precision) is 2-D
+    only (batched callers force the VPU sum, which is also the
+    parity-safe side). A HIGHEST-precision (bf16x6) MXU variant — the
+    XLA engine's own einsum setting, ops/consensus.py:56 — was measured
+    SLOWER than the fused VPU select-into-reduce and rejected (DESIGN.md
+    "Utilization")."""
     if mxu:
         return jax.lax.dot_general(
             S_col.T, mask, (((1,), (0,)), ((), ())),
@@ -246,8 +257,21 @@ def _epoch_math(
     def body(_, carry):
         c_lo, c_hi = carry
         c_mid = (c_hi + c_lo) * 0.5
-        mask = (W_n > c_mid).astype(W.dtype)  # strict, as the reference
-        above = _support(S, mask, mxu) > kappa
+        if mxu:
+            mask = (W_n > c_mid).astype(W.dtype)  # strict, as the reference
+            support = _support(S, mask, mxu)
+        else:
+            # One fused traversal (select straight into the reduce): the
+            # compare->astype->multiply->reduce chain costs ~3 VMEM passes
+            # over [V, M] per halving and dominates the whole VPU epoch;
+            # summing the same addends (S_i or 0.0, strict >) in the same
+            # sublane order this way measures ~2.4x faster end-to-end.
+            support = jnp.sum(
+                jnp.where(W_n > c_mid, S, jnp.zeros((), W.dtype)),
+                axis=-2,
+                keepdims=True,
+            )
+        above = support > kappa
         return jnp.where(above, c_mid, c_lo), jnp.where(above, c_hi, c_mid)
 
     _, c_hi = lax.fori_loop(0, iters, body, (c_lo, c_hi), unroll=True)
@@ -326,7 +350,13 @@ def _epoch_math(
         B_next = jnp.clip(B_dec + purchase, max=1.0)
         D = S * jnp.sum(B_next * incentive, axis=-1, keepdims=True)
 
-    D_n = D / (jnp.sum(D, axis=(-2, -1), keepdims=True) + 1e-6)
+    # Two single-axis sums, NOT jnp.sum(D, axis=(-2, -1)): the multi-axis
+    # reduce of a leading-batch [B, V, 1] array to [B, 1, 1] hits a Mosaic
+    # layout abort (layout.h "arr.size() >= layout_rank" check) on real
+    # TPU; the sequential form lowers cleanly and sums the same values in
+    # the same (V-then-singleton) order.
+    D_tot = jnp.sum(jnp.sum(D, axis=-1, keepdims=True), axis=-2, keepdims=True)
+    D_n = D / (D_tot + 1e-6)
     return B_next, D_n, incentive, W_n, C
 
 

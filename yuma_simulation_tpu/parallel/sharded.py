@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from yuma_simulation_tpu.models.config import YumaConfig
 from yuma_simulation_tpu.models.epoch import yuma_epoch
 from yuma_simulation_tpu.models.variants import VariantSpec, variant_for_version
+from yuma_simulation_tpu.ops.consensus import default_consensus_impl
 from yuma_simulation_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from yuma_simulation_tpu.scenarios.base import Scenario
 from yuma_simulation_tpu.simulation.engine import simulate_constant
@@ -137,6 +138,8 @@ def montecarlo_total_dividends(
     base_weights: Optional[jnp.ndarray] = None,
     base_stakes: Optional[jnp.ndarray] = None,
     perturbation: float = 0.05,
+    consensus_impl: str = "auto",
+    epoch_impl: str = "auto",
     dtype=jnp.float32,
 ) -> np.ndarray:
     """Pod-scale Monte-Carlo: `[num_scenarios, V]` total dividends.
@@ -148,9 +151,34 @@ def montecarlo_total_dividends(
     *on-device inside each shard* from a split of ``key`` — no `[B, E, V, M]`
     host array ever exists, so an 8192-scenario x 10k-epoch study is
     bounded by per-chip HBM only. Zero collectives until the final gather.
+
+    `consensus_impl`: "auto" (default) picks "sorted" below the documented
+    sorted-compile-pathology threshold and "bisect" at or above it
+    (:func:`yuma_simulation_tpu.ops.consensus.default_consensus_impl`), so
+    a large-subnet study never hits the minutes-to-hours XLA compile of
+    the sorted closed form (DESIGN.md); "sorted"/"bisect" force one.
+
+    `epoch_impl`: "hoisted" (the "auto" default) exploits the
+    epoch-constant weights — consensus runs once, the scan carries only
+    the bonds recurrence (same values as the full kernel, pinned by
+    tests/unit/test_hoisted.py); "xla" forces the full per-epoch kernel.
     """
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
+    if consensus_impl == "auto":
+        consensus_impl = default_consensus_impl(num_validators, num_miners)
+    elif consensus_impl not in ("sorted", "bisect"):
+        raise ValueError(
+            f"unknown consensus_impl {consensus_impl!r}; "
+            "expected 'auto', 'sorted' or 'bisect'"
+        )
+    if epoch_impl == "auto":
+        epoch_impl = "hoisted"
+    if epoch_impl not in ("hoisted", "xla"):
+        raise ValueError(
+            f"unknown epoch_impl {epoch_impl!r}; "
+            "expected 'auto', 'hoisted' or 'xla'"
+        )
     shards = mesh.shape[DATA_AXIS]
     if num_scenarios % shards:
         raise ValueError(
@@ -175,16 +203,27 @@ def montecarlo_total_dividends(
             per_shard=per_shard,
             spec=spec,
             mesh=mesh,
+            consensus_impl=consensus_impl,
+            hoist_invariant=epoch_impl == "hoisted",
         )
     )
 
 
 @partial(
-    jax.jit, static_argnames=("num_epochs", "per_shard", "spec", "mesh")
+    jax.jit,
+    static_argnames=(
+        "num_epochs",
+        "per_shard",
+        "spec",
+        "mesh",
+        "consensus_impl",
+        "hoist_invariant",
+    ),
 )
 def _montecarlo_run(
     keys, base_weights, base_stakes, perturbation, config,
     *, num_epochs: int, per_shard: int, spec: VariantSpec, mesh: Mesh,
+    consensus_impl: str = "sorted", hoist_invariant: bool = True,
 ):
     """Module-level jitted body so repeated Monte-Carlo calls with the same
     shapes/config hit the jit cache instead of re-tracing a fresh closure."""
@@ -197,8 +236,8 @@ def _montecarlo_run(
                 k, base_weights.shape, base_weights.dtype
             )
             W = jax.nn.relu(base_weights + eps)
-            # Weights are constant across epochs within one scenario,
-            # so the hoisted path applies: consensus once, bonds
+            # Weights are constant across epochs within one scenario, so
+            # the hoisted path is the default: consensus once, bonds
             # recurrence scanned (same values as the full per-epoch
             # kernel — pinned by tests/unit/test_hoisted.py).
             total, _ = simulate_constant(
@@ -207,8 +246,8 @@ def _montecarlo_run(
                 num_epochs,
                 config,
                 spec,
-                consensus_impl="sorted",
-                hoist_invariant=True,
+                consensus_impl=consensus_impl,
+                hoist_invariant=hoist_invariant,
             )
             return total  # [V]
 

@@ -207,6 +207,7 @@ def _simulate_scan(
         "save_bonds",
         "save_incentives",
         "save_consensus",
+        "mxu",
     ),
 )
 def _simulate_case_fused(
@@ -219,6 +220,7 @@ def _simulate_case_fused(
     save_bonds: bool = True,
     save_incentives: bool = True,
     save_consensus: bool = False,
+    mxu: bool = False,
 ):
     """The fused-Pallas twin of :func:`_simulate_scan`: the whole epoch
     loop — per-epoch weights/stakes streamed from HBM, reset injection,
@@ -253,6 +255,7 @@ def _simulate_case_fused(
         alpha_low=config.alpha_low,
         alpha_high=config.alpha_high,
         mode=spec.bonds_mode,
+        mxu=mxu,
         precision=config.consensus_precision,
         save_bonds=save_bonds,
         save_incentives=save_incentives,
@@ -294,6 +297,12 @@ def simulate(
       - "xla": always the `lax.scan` over the unfused epoch kernel.
       - "fused_scan": require the fused path (raises if ineligible;
         off-TPU it runs in interpret mode — correct but slow, for tests).
+      - "fused_scan_mxu": the fused path with the two stake contractions
+        on the MXU. ~2x faster, but the bf16x3 support sums can flip
+        one 2^-17 consensus grid point vs the VPU/XLA paths — never
+        selected by "auto"; opt-in for throughput sweeps where the
+        CSV-parity contract is not in play (bound pinned on chip in
+        MXU_PARITY.json via tools/tpu_parity.py).
 
     With ``mesh``, the miner axis of every `[V, M]` matrix is sharded over
     the mesh's last axis for the whole multi-epoch scan — the path for
@@ -329,7 +338,7 @@ def simulate(
             )
             else "xla"
         )
-    if epoch_impl == "fused_scan":
+    if epoch_impl in ("fused_scan", "fused_scan_mxu"):
         if mesh is not None:
             raise ValueError(
                 "the fused case scan is a single-core Pallas program; "
@@ -350,6 +359,7 @@ def simulate(
             save_bonds=save_bonds,
             save_incentives=save_incentives,
             save_consensus=save_consensus,
+            mxu=epoch_impl == "fused_scan_mxu",
         )
     elif epoch_impl == "xla":
         if mesh is not None:
@@ -373,7 +383,7 @@ def simulate(
     else:
         raise ValueError(
             f"unknown epoch_impl {epoch_impl!r}; "
-            "expected 'auto', 'xla' or 'fused_scan'"
+            "expected 'auto', 'xla', 'fused_scan' or 'fused_scan_mxu'"
         )
     ys = jax.device_get(ys)
     return SimulationResult(
@@ -437,7 +447,7 @@ def simulate_scaled(
         fits the VMEM budget, on TPU, >= 1 epoch), otherwise the XLA
         path. Never selects the MXU
         variants (their support sums can flip one 2^-17 consensus grid
-        point); opt into "fused_scan_mxu" explicitly for the last ~1.2x.
+        point); opt into "fused_scan_mxu" explicitly for the last ~2x.
       - "xla": the unfused `yuma_epoch` (any variant/consensus_impl).
       - "fused": the Pallas VMEM-resident EMA-family epoch kernel
         (:func:`yuma_simulation_tpu.ops.pallas_epoch.fused_ema_epoch`),
@@ -468,7 +478,7 @@ def simulate_scaled(
         # The VPU scan, not the MXU variant: auto must be correct by
         # default (the MXU support sums can flip one 2^-17 consensus
         # grid point — opt into "fused_scan_mxu" explicitly for that
-        # last ~1.2x). E=0 falls back to XLA, which returns zeros.
+        # last ~2x). E=0 falls back to XLA, which returns zeros.
         epoch_impl = (
             "fused_scan"
             if scales.shape[0] >= 1
@@ -539,6 +549,15 @@ def simulate_scaled(
             return B_next, normalize_weight_rows(W * scale), D_n
 
     else:
+        if epoch_impl != "xla":
+            # A typo'd/unknown impl must not silently benchmark the XLA
+            # path under the wrong label (simulate() validates the same
+            # way).
+            raise ValueError(
+                f"unknown epoch_impl {epoch_impl!r}; expected 'auto', "
+                "'xla', 'fused', 'fused_mxu', 'fused_scan' or "
+                "'fused_scan_mxu'"
+            )
 
         def epoch_body(B, W_prev, scale, first):
             Wv = W * scale

@@ -1,0 +1,57 @@
+"""The one benchmark-timing discipline, shared by bench.py and
+tools/bench_matrix.py (they previously carried hand-synced near-copies).
+
+Remote-TPU runtimes add ~0.1 s of per-dispatch tunnel latency, so any
+timed run shorter than a couple of seconds measures mostly dispatch.
+`time_best` therefore: warms (compiles) once, grows the work count `n`
+ITERATIVELY until a single timed run lasts >= `target_seconds` (one
+extrapolation is not enough — per-epoch cost drops as n grows), then
+takes the best of `reps` timed runs. `np.asarray` forces the
+device->host fetch; on remote runtimes `block_until_ready` alone can
+return before execution finishes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import numpy as np
+
+DEFAULT_TARGET_SECONDS = 2.0
+DEFAULT_REPS = 4
+
+
+def time_best(
+    run: Callable[[int], object],
+    n: int,
+    *,
+    max_n: int = 1 << 20,
+    granularity: int = 1,
+    target_seconds: float = DEFAULT_TARGET_SECONDS,
+    reps: int = DEFAULT_REPS,
+) -> tuple[float, int, list[float]]:
+    """Time `run(n)` (which returns a device value; the fetch is forced
+    here) and return `(rate, n_timed, times_s)` where `rate = n / best`.
+
+    `granularity` rounds grown counts down to a multiple the runner can
+    actually execute (e.g. whole passes of a fixed-length inner scan, or
+    a Monte-Carlo shard count), so `n / best` never over-counts.
+    """
+    np.asarray(run(n))  # compile + warm up
+    t0 = time.perf_counter()
+    np.asarray(run(n))
+    dt = time.perf_counter() - t0
+    while dt < target_seconds and n < max_n:
+        n = min(max_n, int(n * max(2.0, 1.25 * target_seconds / dt)))
+        n = max(granularity, n // granularity * granularity)
+        np.asarray(run(n))  # recompile at the timed length
+        t0 = time.perf_counter()
+        np.asarray(run(n))
+        dt = time.perf_counter() - t0
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(run(n))
+        times.append(time.perf_counter() - t0)
+    return n / min(times), n, [round(t, 3) for t in times]
