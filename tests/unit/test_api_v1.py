@@ -22,6 +22,47 @@ def two_version_list():
     ]
 
 
+def test_simulate_suite_matches_per_case(two_version_list):
+    """The batched chart-suite simulation (one dispatch per version)
+    un-pads back to exactly what per-case `run_simulation` produces —
+    including a heterogeneous suite where padding is NOT a no-op."""
+    import numpy as np
+
+    from yuma_simulation_tpu.models.config import YumaConfig
+    from yuma_simulation_tpu.scenarios.synthetic import random_subnet_scenario
+    from yuma_simulation_tpu.v1.api import _simulate_suite
+
+    suite = [
+        create_case("Case 1"),  # 40e x 3v x 2m
+        random_subnet_scenario(7, num_validators=5, num_miners=4, num_epochs=30),
+    ]
+    hp = SimulationHyperparameters(bond_penalty=0.99)
+    out = _simulate_suite(suite, two_version_list, hp)
+    assert set(out) == {
+        (i, v) for i in range(len(suite)) for v, _ in two_version_list
+    }
+    for (i, version), (config, (div, bonds, inc)) in out.items():
+        case = suite[i]
+        E, V, M = case.weights.shape
+        ref_div, ref_bonds, ref_inc = run_simulation(
+            case, version, YumaConfig(simulation=hp, yuma_params=config.yuma_params)
+        )
+        assert list(div) == list(ref_div)
+        for val in div:
+            np.testing.assert_allclose(
+                div[val], ref_div[val], rtol=2e-5, atol=2e-6,
+                err_msg=f"{version} case {i} {val}",
+            )
+        assert len(bonds) == E == len(ref_bonds) and bonds[0].shape == (V, M)
+        assert len(inc) == E == len(ref_inc) and inc[0].shape == (M,)
+        np.testing.assert_allclose(
+            np.asarray(bonds), np.asarray(ref_bonds), rtol=2e-5, atol=2e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(inc), np.asarray(ref_inc), rtol=2e-5, atol=2e-6
+        )
+
+
 def test_generate_chart_table_with_charts(two_version_list):
     cases = get_cases()[:2]
     html = generate_chart_table(

@@ -1,6 +1,7 @@
 """Utils: checkpointed sweeps, timers, logging setup."""
 
 import logging
+import time
 
 import numpy as np
 import pytest
@@ -100,6 +101,76 @@ def test_checkpoint_legacy_manifest_resumes(tmp_path):
     # The shared keys are still enforced.
     with pytest.raises(ValueError, match="different"):
         CheckpointedSweep(tmp_path, num_chunks=3, tag="t", config={"a": 1})
+
+
+def test_checkpoint_legacy_manifest_merge_and_warning(tmp_path, caplog):
+    """ADVICE r2: the backfill must only add keys ABSENT from the old
+    manifest (keys written by a newer version survive), and stamping an
+    unverifiable fingerprint over pre-existing chunks warns."""
+    import json
+
+    (tmp_path / "manifest.json").write_text(
+        json.dumps({"num_chunks": 2, "tag": "t", "from_future": 42})
+    )
+    with open(tmp_path / "chunk_00000.npz", "wb") as f:
+        np.savez(f, result=np.ones((2, 3)))
+    with caplog.at_level(logging.WARNING, "yuma_simulation_tpu.utils.checkpoint"):
+        sweep = CheckpointedSweep(tmp_path, num_chunks=2, tag="t", config={"a": 1})
+    assert any("not verified" in r.getMessage() for r in caplog.records)
+    merged = json.loads((tmp_path / "manifest.json").read_text())
+    assert merged["from_future"] == 42  # newer-version key survived
+    assert "config_fingerprint" in merged
+    calls = []
+    out = sweep.run(lambda i: (calls.append(i), np.full((2, 3), i))[1])
+    assert calls == [1]  # chunk 0 was resumed, not recomputed
+    assert out.shape == (4, 3)
+
+
+def test_time_best_counts_and_granularity():
+    """The shared bench-timing helper: grows the work count past the
+    target window on a multiple of `granularity`, and reports the grown
+    count it actually timed."""
+    from yuma_simulation_tpu.utils.timing import time_best
+
+    executed = []
+
+    def run(n):
+        executed.append(n)
+        time.sleep(n * 1e-4)  # 10k "epochs" ~= 1 s
+        return n
+
+    rate, n_timed, times = time_best(
+        run, 7, max_n=100_000, granularity=7, target_seconds=0.05, reps=2
+    )
+    assert n_timed % 7 == 0 and n_timed > 7  # grew, on the granularity grid
+    assert len(times) == 2 and rate > 0
+    assert all(n % 7 == 0 for n in executed)
+    # A run already past the window is not grown.
+    rate2, n2, _ = time_best(
+        run, 1_000, max_n=100_000, target_seconds=0.05, reps=2
+    )
+    assert n2 == 1_000
+
+
+def test_time_best_terminates_and_rounds_edge_cases():
+    from yuma_simulation_tpu.utils.timing import time_best
+
+    executed = []
+
+    def instant(n):  # never reaches the window: growth must still stop
+        executed.append(n)
+        return n
+
+    # max_n=20 is NOT a multiple of granularity=6: the floored cap (18)
+    # must terminate the loop, not re-time 18 forever.
+    _, n_timed, _ = time_best(
+        instant, 6, max_n=20, granularity=6, target_seconds=10.0, reps=1
+    )
+    assert n_timed == 18
+    # The caller-supplied initial n is rounded onto the grid too.
+    executed.clear()
+    time_best(instant, 7, max_n=18, granularity=6, target_seconds=10.0, reps=1)
+    assert all(n % 6 == 0 for n in executed)
 
 
 def test_enable_compilation_cache(tmp_path, monkeypatch):

@@ -42,6 +42,7 @@ enable_compilation_cache()
 
 from yuma_simulation_tpu.models.config import YumaConfig
 from yuma_simulation_tpu.models.variants import canonical_versions, variant_for_version
+from yuma_simulation_tpu.ops.consensus import default_consensus_impl
 from yuma_simulation_tpu.parallel import make_mesh, montecarlo_total_dividends
 from yuma_simulation_tpu.scenarios import create_case, get_cases
 from yuma_simulation_tpu.simulation.engine import (
@@ -85,12 +86,17 @@ def bench_subnet(V, M, epochs, name):
     S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
     cfg = YumaConfig()
     spec = variant_for_version("Yuma 2 (Adrian-Fish)")
+    # The documented shape-gated default (sorted below the compile-
+    # pathology threshold — what Monte-Carlo's "auto" picks, and what r2
+    # measured here), stated in the line label so the choice is visible.
+    ci = default_consensus_impl(V, M)
 
     def run(n):
-        _fetch(simulate_constant(W, S, n, cfg, spec)[0])
+        _fetch(simulate_constant(W, S, n, cfg, spec, consensus_impl=ci)[0])
 
     rate, meta = _bench(run, epochs, "epochs_timed")
-    _line(name, rate, "epochs/s", meta)
+    meta["consensus_impl"] = ci
+    _line(f"{name}, consensus={ci}", rate, "epochs/s", meta)
 
 
 def bench_stress_varying(V=256, M=4096, epochs=16384):

@@ -38,13 +38,21 @@ def time_best(
     actually execute (e.g. whole passes of a fixed-length inner scan, or
     a Monte-Carlo shard count), so `n / best` never over-counts.
     """
+    def on_grid(x: int) -> int:
+        return max(granularity, x // granularity * granularity)
+
+    n = on_grid(n)  # the caller's n must honor the divisibility contract too
     np.asarray(run(n))  # compile + warm up
     t0 = time.perf_counter()
     np.asarray(run(n))
     dt = time.perf_counter() - t0
-    while dt < target_seconds and n < max_n:
-        n = min(max_n, int(n * max(2.0, 1.25 * target_seconds / dt)))
-        n = max(granularity, n // granularity * granularity)
+    while dt < target_seconds:
+        grown = on_grid(min(max_n, int(n * max(2.0, 1.25 * target_seconds / dt))))
+        if grown <= n:
+            # max_n (or its granularity floor) reached — re-timing the
+            # same n forever would hang; accept the sub-window run.
+            break
+        n = grown
         np.asarray(run(n))  # recompile at the timed length
         t0 = time.perf_counter()
         np.asarray(run(n))
