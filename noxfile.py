@@ -59,6 +59,27 @@ def test_slow(session: nox.Session) -> None:
 
 
 @nox.session
+def tpu_parity(session: nox.Session) -> None:
+    """On-chip golden parity artifacts (requires a TPU): the 14x9x4
+    total-dividend surface through the XLA engine, the flagship fused
+    case scan, and the parity-relaxed MXU variant."""
+    session.install("-e", ".")
+    session.run(
+        "python", "tools/tpu_parity.py",
+        "--impl", "xla", "--out", "TPU_PARITY.json", "--bound", "1.5e-6",
+    )
+    session.run(
+        "python", "tools/tpu_parity.py",
+        "--impl", "fused_scan", "--out", "TPU_PARITY_FUSED.json",
+        "--bound", "1.5e-6",
+    )
+    session.run(
+        "python", "tools/tpu_parity.py",
+        "--impl", "fused_scan_mxu", "--out", "MXU_PARITY.json",
+    )
+
+
+@nox.session
 def make_release(session: nox.Session) -> None:
     """Build sdist+wheel. Publishing runs via the tag-triggered trusted
     publishing workflow (.github/workflows/publish.yml), not from a dev

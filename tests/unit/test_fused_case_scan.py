@@ -433,3 +433,49 @@ def test_simulate_scaled_batch_fused_matches_xla():
     # auto must run everywhere (off-TPU it is the XLA path).
     ta, _ = simulate_scaled_batch(W, S, scales, cfg, spec, epoch_impl="auto")
     np.testing.assert_allclose(np.asarray(ta), np.asarray(tx), rtol=2e-5)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "seed,E,V,M,version,liquid",
+    [
+        (20, 8, 6, 20, "Yuma 0 (subtensor)", False),  # EMA_RUST (f32 only)
+        (21, 13, 3, 2, "Yuma 1 (paper)", False),  # reference case shape
+        (22, 7, 9, 33, "Yuma 2 (Adrian-Fish)", False),  # non-aligned dims
+        (23, 5, 17, 130, "Yuma 3 (Rhef)", False),  # M just past one lane tile
+        (24, 11, 8, 128, "Yuma 4 (Rhef+relative bonds)", True),  # aligned + liquid
+        (25, 9, 2, 5, "Yuma 1 (paper) - liquid alpha on", True),  # tiny + liquid
+        (26, 6, 12, 64, "Yuma 3.2 (Rhef+conditional)", False),  # conditional reset
+    ],
+)
+def test_fused_case_scan_fuzz_vs_xla(seed, E, V, M, version, liquid):
+    """Shape/seed fuzz of the DEFAULT TPU path (`epoch_impl="auto"` ->
+    fused_case_scan) against the XLA engine: sparse weights (zero rows
+    and zero columns included), duplicate values, reset metadata — the
+    structures the golden cases don't randomize over."""
+    if version == "Yuma 0 (subtensor)" and jax.config.jax_enable_x64:
+        pytest.skip("EMA_RUST fused requires f32 mode")
+    rng = np.random.default_rng(seed)
+    W = rng.random((E, V, M)).astype(np.float32)
+    W[W < 0.3] = 0.0  # sparse, with whole-zero rows/columns likely
+    W[:, :, min(1, M - 1)] = 0.0  # a guaranteed all-zero miner column
+    S = (rng.random((E, V)) + 0.001).astype(np.float32)
+    Wj, Sj = jnp.asarray(W), jnp.asarray(S)
+    ri = jnp.asarray(int(rng.integers(0, M)), jnp.int32)
+    re = jnp.asarray(int(rng.integers(1, E)), jnp.int32)
+    params = {}
+    if liquid:
+        params = dict(liquid_alpha=True)
+    cfg = YumaConfig(yuma_params=YumaParams(**params))
+    spec = variant_for_version(version)
+    ys_x = _simulate_scan(Wj, Sj, ri, re, cfg, spec, save_consensus=True)
+    ys_f = _simulate_case_fused(Wj, Sj, ri, re, cfg, spec, save_consensus=True)
+    assert ys_x.keys() == ys_f.keys()
+    for k in ys_x:
+        np.testing.assert_allclose(
+            np.asarray(ys_f[k]),
+            np.asarray(ys_x[k]),
+            atol=3e-6,
+            rtol=2e-5,
+            err_msg=f"{version} seed={seed} shape=({E},{V},{M}): {k}",
+        )
