@@ -22,16 +22,27 @@ _GOLDENS = np.load(os.path.join(GOLDEN_DIR, "trajectory_goldens.npz"))
 _VERSIONS = canonical_versions()
 
 
+@pytest.mark.parametrize("epoch_impl", ["xla", "fused_scan"])
 @pytest.mark.parametrize("short", ["Case 5", "Case 9", "Case 11"])
 @pytest.mark.parametrize("version_params", _VERSIONS, ids=[v for v, _ in _VERSIONS])
-def test_dividend_trajectory_parity(short, version_params):
+def test_dividend_trajectory_parity(short, version_params, epoch_impl):
     version, params = version_params
+    if epoch_impl == "fused_scan":
+        import jax
+
+        if version == "Yuma 0 (subtensor)" and jax.config.jax_enable_x64:
+            pytest.skip(
+                "EMA_RUST fused requires f32 mode; the f32 subprocess twin "
+                "covers Yuma 0"
+            )
     case = create_case(short)
     cfg = YumaConfig(
         simulation=SimulationHyperparameters(bond_penalty=0.99),
         yuma_params=params,
     )
-    res = simulate(case, version, cfg, save_incentives=False)
+    res = simulate(
+        case, version, cfg, save_incentives=False, epoch_impl=epoch_impl
+    )
 
     golden_div = _GOLDENS[f"{short}/{version}/dividends"]
     np.testing.assert_allclose(
