@@ -479,3 +479,71 @@ def test_fused_case_scan_fuzz_vs_xla(seed, E, V, M, version, liquid):
             rtol=2e-5,
             err_msg=f"{version} seed={seed} shape=({E},{V},{M}): {k}",
         )
+
+
+def test_simulate_consensus_auto_defers_to_engine():
+    """consensus_impl="auto": off-TPU the XLA branch resolves the
+    shape-gated default (sorted at small shapes — bitwise twin of
+    bisect); on TPU it must NOT block the fused path. Both directions
+    produce the default-path values exactly."""
+    from yuma_simulation_tpu.scenarios import cases
+    from yuma_simulation_tpu.simulation.engine import simulate_constant
+
+    cfg = YumaConfig()
+    r_def = simulate(cases[0], "Yuma 1 (paper)", cfg)
+    r_auto = simulate(cases[0], "Yuma 1 (paper)", cfg, consensus_impl="auto")
+    np.testing.assert_array_equal(r_auto.dividends, r_def.dividends)
+    np.testing.assert_array_equal(r_auto.bonds, r_def.bonds)
+    # Forcing the fused path with auto consensus is allowed (the kernel
+    # bisects); forcing it with sorted still raises.
+    r_fused = simulate(
+        cases[0], "Yuma 1 (paper)", cfg,
+        consensus_impl="auto", epoch_impl="fused_scan",
+    )
+    np.testing.assert_allclose(
+        r_fused.dividends, r_def.dividends, atol=2e-6, rtol=1e-5
+    )
+    with pytest.raises(ValueError, match="bisect"):
+        simulate(
+            cases[0], "Yuma 1 (paper)", cfg,
+            consensus_impl="sorted", epoch_impl="fused_scan",
+        )
+    # simulate_constant resolves the static "auto" at trace time; the
+    # values are bitwise those of the forced twin implementations.
+    W = jnp.asarray(np.random.default_rng(3).random((6, 12)), jnp.float32)
+    S = jnp.ones((6,), jnp.float32)
+    spec = variant_for_version("Yuma 1 (paper)")
+    t_auto, _ = simulate_constant(W, S, 5, cfg, spec, consensus_impl="auto")
+    t_sorted, _ = simulate_constant(W, S, 5, cfg, spec, consensus_impl="sorted")
+    np.testing.assert_array_equal(np.asarray(t_auto), np.asarray(t_sorted))
+
+
+def test_consensus_impl_validated_everywhere():
+    """Typos must raise on every entry point, not silently run a
+    dispatch fallback (one shared contract: resolve_consensus_impl)."""
+    from yuma_simulation_tpu.scenarios import cases
+    from yuma_simulation_tpu.simulation.engine import (
+        simulate_constant,
+        simulate_scaled,
+    )
+
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 1 (paper)")
+    W = jnp.ones((4, 8), jnp.float32)
+    S = jnp.ones((4,), jnp.float32)
+    ones = jnp.ones(2, jnp.float32)
+    with pytest.raises(ValueError, match="unknown consensus_impl"):
+        simulate(cases[0], "Yuma 1 (paper)", cfg, consensus_impl="atuo")
+    with pytest.raises(ValueError, match="unknown consensus_impl"):
+        simulate_constant(W, S, 2, cfg, spec, consensus_impl="atuo")
+    with pytest.raises(ValueError, match="unknown consensus_impl"):
+        simulate_scaled(W, S, ones, cfg, spec, consensus_impl="atuo")
+    with pytest.raises(ValueError, match="unknown consensus_impl"):
+        simulate_scaled_batch(
+            W[None], S[None], ones, cfg, spec, consensus_impl="atuo"
+        )
+    # "auto" runs on all four (values pinned by the sibling test).
+    simulate(cases[0], "Yuma 1 (paper)", cfg, consensus_impl="auto")
+    simulate_constant(W, S, 2, cfg, spec, consensus_impl="auto")
+    simulate_scaled(W, S, ones, cfg, spec, consensus_impl="auto")
+    simulate_scaled_batch(W[None], S[None], ones, cfg, spec, consensus_impl="auto")

@@ -47,6 +47,23 @@ def default_consensus_impl(num_validators: int, num_miners: int) -> str:
     return "sorted" if cells < SORTED_COMPILE_PATHOLOGY_CELLS else "bisect"
 
 
+def resolve_consensus_impl(
+    consensus_impl: str, num_validators: int, num_miners: int
+) -> str:
+    """The one resolution/validation point every engine entry point
+    shares: "auto" becomes the shape-gated default, "sorted"/"bisect"
+    pass through, anything else raises (instead of silently running
+    some dispatch fallback under the wrong label)."""
+    if consensus_impl == "auto":
+        return default_consensus_impl(num_validators, num_miners)
+    if consensus_impl not in ("sorted", "bisect"):
+        raise ValueError(
+            f"unknown consensus_impl {consensus_impl!r}; "
+            "expected 'auto', 'sorted' or 'bisect'"
+        )
+    return consensus_impl
+
+
 def stake_weighted_median(
     W: jnp.ndarray,
     S: jnp.ndarray,

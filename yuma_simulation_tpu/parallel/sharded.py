@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from yuma_simulation_tpu.models.config import YumaConfig
 from yuma_simulation_tpu.models.epoch import yuma_epoch
 from yuma_simulation_tpu.models.variants import VariantSpec, variant_for_version
-from yuma_simulation_tpu.ops.consensus import default_consensus_impl
+from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
 from yuma_simulation_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from yuma_simulation_tpu.scenarios.base import Scenario
 from yuma_simulation_tpu.simulation.engine import simulate_constant
@@ -165,13 +165,9 @@ def montecarlo_total_dividends(
     """
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
-    if consensus_impl == "auto":
-        consensus_impl = default_consensus_impl(num_validators, num_miners)
-    elif consensus_impl not in ("sorted", "bisect"):
-        raise ValueError(
-            f"unknown consensus_impl {consensus_impl!r}; "
-            "expected 'auto', 'sorted' or 'bisect'"
-        )
+    consensus_impl = resolve_consensus_impl(
+        consensus_impl, num_validators, num_miners
+    )
     if epoch_impl == "auto":
         epoch_impl = "hoisted"
     if epoch_impl not in ("hoisted", "xla"):

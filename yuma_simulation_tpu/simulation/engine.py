@@ -304,6 +304,12 @@ def simulate(
         CSV-parity contract is not in play (bound pinned on chip in
         MXU_PARITY.json via tools/tpu_parity.py).
 
+    `consensus_impl`: "bisect" (default), "sorted" (bitwise twin — the
+    fuzz battery pins them equal — but with pathological XLA compile
+    times at >= 512x8192 cells), or "auto" (defer to the engine: the
+    fused path when epoch_impl selects it, else the shape-gated
+    sorted/bisect default).
+
     With ``mesh``, the miner axis of every `[V, M]` matrix is sharded over
     the mesh's last axis for the whole multi-epoch scan — the path for
     subnets whose `V x M` state outgrows one chip's HBM (XLA path only).
@@ -324,6 +330,17 @@ def simulate(
         -1 if scenario.reset_bonds_epoch is None else scenario.reset_bonds_epoch,
         jnp.int32,
     )
+    # consensus_impl="auto" defers to the engine: the fused path (which
+    # computes by bisection) when epoch_impl selects it, else the
+    # shape-gated sorted/bisect default (the two are bitwise twins —
+    # tests/unit/test_consensus_fuzz.py — so this is purely a
+    # compile/runtime-cost choice, ops/consensus.py).
+    if consensus_impl not in ("auto", "sorted", "bisect"):
+        raise ValueError(
+            f"unknown consensus_impl {consensus_impl!r}; "
+            "expected 'auto', 'sorted' or 'bisect'"
+        )
+    consensus_auto = consensus_impl == "auto"
 
     if epoch_impl == "auto":
         from yuma_simulation_tpu.ops.pallas_epoch import fused_case_scan_eligible
@@ -331,7 +348,7 @@ def simulate(
         epoch_impl = (
             "fused_scan"
             if mesh is None
-            and consensus_impl == "bisect"
+            and (consensus_auto or consensus_impl == "bisect")
             and weights.shape[0] >= 1
             and fused_case_scan_eligible(
                 weights.shape, spec.bonds_mode, config, dtype, save_bonds
@@ -344,7 +361,7 @@ def simulate(
                 "the fused case scan is a single-core Pallas program; "
                 "miner-axis sharding requires epoch_impl='xla'"
             )
-        if consensus_impl != "bisect":
+        if not consensus_auto and consensus_impl != "bisect":
             raise ValueError(
                 "the fused case scan computes consensus by bisection; "
                 f"consensus_impl={consensus_impl!r} requires epoch_impl='xla'"
@@ -362,6 +379,12 @@ def simulate(
             mxu=epoch_impl == "fused_scan_mxu",
         )
     elif epoch_impl == "xla":
+        if consensus_auto:
+            from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
+
+            consensus_impl = resolve_consensus_impl(
+                consensus_impl, *weights.shape[-2:]
+            )
         if mesh is not None:
             axis = mesh.axis_names[-1]
             weights = jax.device_put(
@@ -468,6 +491,12 @@ def simulate_scaled(
     """
     V, M = W.shape
     dtype = W.dtype
+    # The fused branches bisect in-kernel and never read consensus_impl,
+    # but resolve/validate it unconditionally so "auto" works and typos
+    # raise on every path (one shared contract, ops/consensus.py).
+    from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
+
+    consensus_impl = resolve_consensus_impl(consensus_impl, V, M)
 
     def to_dividends(D_n):
         return _dividends_per_1k(D_n, S, config, dtype)
@@ -638,6 +667,9 @@ def simulate_scaled_batch(
 
     Returns `(total_dividends [B, V], final_bonds [B, V, M])`.
     """
+    from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
+
+    consensus_impl = resolve_consensus_impl(consensus_impl, *W.shape[-2:])
     if epoch_impl == "auto":
         from yuma_simulation_tpu.ops.pallas_epoch import fused_scan_eligible
 
@@ -716,6 +748,10 @@ def simulate_constant(
     degenerates to zeros at 0 epochs; the hoisted form has no epoch to
     seed from).
 
+    `consensus_impl="auto"` resolves to the shape-gated sorted/bisect
+    default at trace time (sorted below the documented compile-pathology
+    threshold — the two produce bitwise-identical values).
+
     `hoist_invariant=True` exploits the constant weights: the consensus
     front half (normalize, bisection, quantize, clip, incentive, liquid
     alpha) depends only on `(W, S)`, so it runs once and the scan carries
@@ -727,6 +763,12 @@ def simulate_constant(
     With ``mesh``, the miner axis is sharded over the mesh's last axis
     across the whole scan (both paths), for subnets beyond one chip's HBM.
     """
+    # Static-arg resolution/validation at trace time: "auto" becomes the
+    # shape-gated sorted/bisect default (bitwise twins; compile-cost
+    # choice only), unknown strings raise.
+    from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
+
+    consensus_impl = resolve_consensus_impl(consensus_impl, *W.shape)
     if hoist_invariant:
         return _simulate_constant_hoisted(
             W, S, num_epochs, config, spec, consensus_impl, mesh
