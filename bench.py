@@ -79,6 +79,7 @@ def _true_weights_reps(W_e, S_e, config, spec, reps, epoch_impl):
     CSE the passes into one; the accumulator chains them so none is
     dead-code-eliminated."""
     from yuma_simulation_tpu.ops.pallas_epoch import fused_case_scan
+    from yuma_simulation_tpu.simulation.engine import fused_hparams
 
     ri = jnp.asarray(-1, jnp.int32)
 
@@ -89,18 +90,10 @@ def _true_weights_reps(W_e, S_e, config, spec, reps, epoch_impl):
             out = fused_case_scan(
                 W_e,
                 S_r,
-                kappa=config.kappa,
-                bond_penalty=config.bond_penalty,
-                bond_alpha=config.bond_alpha,
-                capacity_alpha=config.capacity_alpha,
-                decay_rate=config.decay_rate,
-                liquid_alpha=config.liquid_alpha,
-                alpha_low=config.alpha_low,
-                alpha_high=config.alpha_high,
                 mode=spec.bonds_mode,
-                precision=config.consensus_precision,
                 save_bonds=False,
                 save_incentives=False,
+                **fused_hparams(config),
             )
             acc = acc + out["dividends_normalized"].sum()
         else:

@@ -83,6 +83,25 @@ def _dividends_per_1k(D_n, S, config, dtype):
     return jnp.where(stakes_units > 1e-6, emission / stakes_units, 0.0)
 
 
+def fused_hparams(config: YumaConfig) -> dict:
+    """The one config -> fused-kernel hyperparameter mapping. This
+    spelling is parity-critical (a drifted field silently changes the
+    simulated model), so every fused call site — the engine paths here
+    and bench.py's true-weights runner — must build its kwargs through
+    this helper."""
+    return dict(
+        kappa=config.kappa,
+        bond_penalty=config.bond_penalty,
+        bond_alpha=config.bond_alpha,
+        capacity_alpha=config.capacity_alpha,
+        decay_rate=config.decay_rate,
+        liquid_alpha=config.liquid_alpha,
+        alpha_low=config.alpha_low,
+        alpha_high=config.alpha_high,
+        precision=config.consensus_precision,
+    )
+
+
 def _apply_reset(B, C_prev, epoch, reset_index, reset_epoch, reset_mode, M):
     """Zero the reset miner's bond column when the variant's rule fires
     (reference simulation_utils.py:62-88). `reset_epoch < 0` disables.
@@ -246,20 +265,12 @@ def _simulate_case_fused(
         reset_index=reset_index,
         reset_epoch=reset_epoch,
         reset_mode=spec.reset_mode,
-        kappa=config.kappa,
-        bond_penalty=config.bond_penalty,
-        bond_alpha=config.bond_alpha,
-        capacity_alpha=config.capacity_alpha,
-        decay_rate=config.decay_rate,
-        liquid_alpha=config.liquid_alpha,
-        alpha_low=config.alpha_low,
-        alpha_high=config.alpha_high,
         mode=spec.bonds_mode,
         mxu=mxu,
-        precision=config.consensus_precision,
         save_bonds=save_bonds,
         save_incentives=save_incentives,
         save_consensus=save_consensus,
+        **fused_hparams(config),
     )
     ys = {
         "dividends": _dividends_per_1k(
@@ -530,17 +541,9 @@ def simulate_scaled(
             W,
             S / S.sum(),
             scales,
-            kappa=config.kappa,
-            bond_penalty=config.bond_penalty,
-            bond_alpha=config.bond_alpha,
-            capacity_alpha=config.capacity_alpha,
-            decay_rate=config.decay_rate,
-            liquid_alpha=config.liquid_alpha,
-            alpha_low=config.alpha_low,
-            alpha_high=config.alpha_high,
             mode=spec.bonds_mode,
             mxu=epoch_impl == "fused_scan_mxu",
-            precision=config.consensus_precision,
+            **fused_hparams(config),
         )
         # The per-1000-tao conversion is linear in D_n, so applying it to
         # the in-kernel epoch sum equals summing per-epoch conversions.
@@ -555,6 +558,11 @@ def simulate_scaled(
             raise ValueError("fused epoch_impl does not support liquid alpha")
         mxu = epoch_impl == "fused_mxu"
         S_n = S / S.sum()  # stake is epoch-constant; normalize once
+        # fused_ema_epoch takes only the EMA-family subset of the shared
+        # mapping (no capacity/decay/liquid fields) — still sourced from
+        # the one helper so the spellings cannot drift between impls.
+        hp = fused_hparams(config)
+        ema_hp = {k: hp[k] for k in ("kappa", "bond_penalty", "bond_alpha", "precision")}
 
         def epoch_body(B, W_prev, scale, first):
             clip = None
@@ -566,14 +574,11 @@ def simulate_scaled(
                 S_n,
                 B,
                 w_scale=scale,
-                kappa=config.kappa,
-                bond_penalty=config.bond_penalty,
-                bond_alpha=config.bond_alpha,
                 first_epoch=first,
                 clip_base=clip,
                 mode=spec.bonds_mode,
                 mxu=mxu,
-                precision=config.consensus_precision,
+                **ema_hp,
             )
             return B_next, normalize_weight_rows(W * scale), D_n
 
@@ -694,16 +699,8 @@ def simulate_scaled_batch(
             W,
             S / S.sum(axis=-1, keepdims=True),
             scales,
-            kappa=config.kappa,
-            bond_penalty=config.bond_penalty,
-            bond_alpha=config.bond_alpha,
-            capacity_alpha=config.capacity_alpha,
-            decay_rate=config.decay_rate,
-            liquid_alpha=config.liquid_alpha,
-            alpha_low=config.alpha_low,
-            alpha_high=config.alpha_high,
             mode=spec.bonds_mode,
-            precision=config.consensus_precision,
+            **fused_hparams(config),
         )
         return _dividends_per_1k(D_tot, S, config, W.dtype), B_final
     if epoch_impl != "xla":

@@ -23,6 +23,9 @@ from yuma_simulation_tpu.models.config import (  # noqa: F401  (public re-export
     YumaParams,
     YumaSimulationNames,
 )
+from yuma_simulation_tpu.models.variants import (
+    variant_for_version as _variant_for_version,
+)
 from yuma_simulation_tpu.reporting.charts import (
     plot_bonds as _plot_bonds,
     plot_dividends as _plot_dividends,
@@ -35,9 +38,6 @@ from yuma_simulation_tpu.reporting.tables import (
 )
 from yuma_simulation_tpu.reporting.tables import (  # noqa: F401  (promoted)
     generate_total_dividends_table,
-)
-from yuma_simulation_tpu.models.variants import (
-    variant_for_version as _variant_for_version,
 )
 from yuma_simulation_tpu.scenarios.base import Scenario
 from yuma_simulation_tpu.simulation.engine import run_simulation  # noqa: F401
