@@ -11,11 +11,19 @@ checkpoint/resume. Everything runs on whatever JAX platform is available
 """
 
 import argparse
+import os
 import pathlib
+import sys
 
 import numpy as np
 
 import jax
+
+# Self-locating like tools/*: `python examples/quickstart.py` works from
+# anywhere without installing the package (PYTHONPATH cannot be used
+# instead — setting it breaks the TPU plugin registration in some
+# environments).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from yuma_simulation_tpu.models.config import (
     SimulationHyperparameters,
