@@ -8,14 +8,15 @@ vs the XLA `lax.scan` engine, per output, per shape, per version.
 
     python tools/cross_engine_check.py --out CROSS_ENGINE.json
 
-Expectation (DESIGN.md "Precision policy"): on smooth workloads the
-engines agree bitwise on consensus; the sparse workloads swept here
-deliberately manufacture knife-edge `support == kappa` ties (measured
-example: a column whose exact f64 support is 0.500000004), where the
-VPU select-into-reduce and the XLA einsum-at-HIGHEST support sums can
-land on opposite sides of the strict `>` — the same failure class as
-the documented one-grid-step sharded-vs-unsharded bound. Dividends stay
-within the golden tolerance class throughout.
+Measured behavior (DESIGN.md "Precision policy"): in BOTH regimes
+(default ~25%-zeroed sparse, `--dense` uniform) a handful of runs hit
+knife-edge `support == kappa` ties (one diagnosed column's exact f64
+support: 0.500000004), where the VPU select-into-reduce and the XLA
+einsum-at-HIGHEST support sums land on opposite sides of the strict
+`>`, moving that column's consensus to a different support plateau.
+Small spot checks can show bitwise agreement by sample luck; run this
+sweep, not a spot check, before claiming it. Per-validator dividends
+stay within the golden tolerance class throughout.
 """
 
 import argparse
@@ -60,6 +61,12 @@ SEEDS = (0, 1, 2)
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--dense",
+        action="store_true",
+        help="dense uniform weights (no manufactured ties) instead of the "
+        "default ~25%%-zeroed sparse regime",
+    )
     args = ap.parse_args()
     assert not jax.config.jax_enable_x64, "run in the shipped f32 mode"
 
@@ -71,7 +78,9 @@ def main() -> None:
         for seed in SEEDS:
             rng = np.random.default_rng(seed)
             W_np = rng.random((E, V, M)).astype(np.float32)
-            W_np[W_np < 0.25] = 0.0  # sparsity incl. zero rows/columns
+            if not args.dense:
+                # ~25% zeros: manufactures stake-sum ties / zero columns
+                W_np[W_np < 0.25] = 0.0
             W = jnp.asarray(W_np)
             S = jnp.asarray(rng.random((E, V)).astype(np.float32) + 0.01)
             ri = jnp.asarray(int(rng.integers(0, M)), jnp.int32)
@@ -101,9 +110,12 @@ def main() -> None:
     dev = jax.devices()[0]
     artifact = {
         "artifact": (
-            "fused case scan vs XLA engine on randomized sparse workloads "
-            "(the default-TPU path vs the fallback path, all outputs)"
+            "fused case scan vs XLA engine on randomized "
+            + ("dense" if args.dense else "sparse")
+            + " workloads (the default-TPU path vs the fallback path, "
+            "all outputs)"
         ),
+        "regime": "dense" if args.dense else "sparse (~25% zeroed weights)",
         "device": f"{dev.device_kind} ({dev.platform})",
         "shapes_EVM": SHAPES,
         "seeds": list(SEEDS),
@@ -114,18 +126,19 @@ def main() -> None:
         "worst_deviation_rel_to_output_scale": worst_rel,
         "captured": datetime.date.today().isoformat(),
         "notes": (
-            "These sparse workloads deliberately manufacture knife-edge "
-            "support == kappa ties (a diagnosed mismatch column had exact "
-            "f64 support 0.500000004 vs kappa = 0.5): the two engines' "
-            "f32 support sums can land on opposite sides of the strict > "
-            "there, shifting that column's consensus level and, through "
-            "the shared quantization sum, nudging the rest — the same "
-            "failure class as the documented one-grid-step "
-            "sharded-vs-unsharded bound. On smooth workloads (no "
-            "manufactured ties) consensus agrees bitwise. Dividends stay "
-            "within the golden tolerance class throughout; the golden "
-            "artifacts pin both engines against the reference "
-            "independently."
+            "Mismatch runs are knife-edge support == kappa ties (a "
+            "diagnosed mismatch column had exact f64 support 0.500000004 "
+            "vs kappa = 0.5): the two engines' f32 support sums land on "
+            "opposite sides of the strict > there, moving that column's "
+            "consensus to a different support plateau and, through the "
+            "shared quantization sum, nudging the rest. Ties occur in "
+            "both the sparse and dense regimes — neither engine is "
+            "'right' about a tie; parity is defined against the "
+            "reference, and the golden artifacts pin both engines "
+            "against it independently. Deviations are quantized (the "
+            "repeated worst consensus value 6/65535 is six u16 grid "
+            "steps; the capacity-bond worst is one 2^38 quantum of its "
+            "~2^64-scaled state)."
         ),
     }
     text = json.dumps(artifact, indent=2)
