@@ -27,20 +27,20 @@ LINT_TARGETS = (
 @nox.session(name="format")
 def format_(session: nox.Session) -> None:
     """Auto-format with ruff (the reference uses ruff format + isort)."""
-    session.install("ruff")
+    session.install("ruff==0.8.4")
     session.run("ruff", "format", *LINT_TARGETS)
     session.run("ruff", "check", "--fix", *LINT_TARGETS)
 
 
 @nox.session
 def lint(session: nox.Session) -> None:
-    session.install("ruff")
+    session.install("ruff==0.8.4")
     session.run("ruff", "check", *LINT_TARGETS)
 
 
 @nox.session
 def typecheck(session: nox.Session) -> None:
-    session.install("mypy", "-e", ".")
+    session.install("mypy==1.13.0", "-e", ".")
     session.run("mypy", "yuma_simulation_tpu", "yuma_simulation")
 
 

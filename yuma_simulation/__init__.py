@@ -1,11 +1,17 @@
 """Drop-in compatibility package for the reference `yuma_simulation`.
 
-Users of the reference package can switch to the TPU framework without
-changing imports: the module paths, public names and signatures mirror the
-reference's layout (`yuma_simulation.v1.api`,
+Users of the reference package can keep their import paths: the module
+paths, public names and signatures mirror the reference's layout (`yuma_simulation.v1.api`,
 `yuma_simulation._internal.{yumas,cases,simulation_utils,charts_utils}` —
 reference src/yuma_simulation/), every entry point backed by the
 JAX/XLA/Pallas engine in :mod:`yuma_simulation_tpu`.
+
+Caveat (see MIGRATION.md): kernels *accept* torch tensors but *return*
+JAX arrays — downstream code that calls torch-only methods on outputs
+(``.clone()``, ``.item()`` chains as in the reference's own driver,
+reference simulation_utils.py:102-109) needs the small edits MIGRATION.md
+lists. "Drop-in" covers import paths and call signatures, not torch-typed
+return values.
 
 The reference's top-level ``__init__`` is empty (ApiVer contract,
 reference README.md:10-18); so is this one.

@@ -99,6 +99,15 @@ def _simulate_suite(
     `pad_scenarios`' inert padding) reduces it to one dispatch per
     version. Returns `{(case_idx, version): (config, (dividends_dict,
     bonds_per_epoch, incentives_per_epoch))}`.
+
+    Engine note (DESIGN.md "Precision policy"): this path always uses the
+    XLA batch engine, while `run_simulation` on TPU defaults to the fused
+    Pallas scan (`epoch_impl="auto"`). Both pass the golden surface
+    independently and agree bitwise on consensus for the built-in suite;
+    on adversarial knife-edge `support == kappa` ties the engines can
+    differ within the documented tolerance class (CROSS_ENGINE.json).
+    Users who want the chart path on the fused engine can call
+    `run_simulation(..., epoch_impl="pallas")` per case and plot directly.
     """
     import numpy as np
 
