@@ -149,6 +149,35 @@ def test_all_zero_matrix_hits_grid_floor():
         )
 
 
+def test_near_tie_rounds_onto_kappa_like_the_reference():
+    # Stakes [0.4, 0.3, 0.2, 0.1] (normalized f32) make subset sums whose
+    # EXACT value is ~7.5e-9 above 0.5 — within half an f32 ulp, so the
+    # reference's f32 support tensor rounds onto 0.5 and the strict `>`
+    # fails (torch-verified; this pinned the round-3 kernel goldens).
+    # The canonical test must reproduce that: exact integer sum, ONE
+    # rounding to dtype, then compare (ops/consensus.py::support_rounded).
+    S = np.array([0.4, 0.3, 0.2, 0.1], np.float32)
+    S = jnp.asarray(S / S.sum())
+    # miner 0: validators {0, 3} above any c < 0.8 -> support exactly
+    # rounds to 0.5 -> never above -> descend to the grid floor.
+    W = jnp.asarray(
+        np.array(
+            [[0.8, 0.2], [0.0, 1.0], [0.0, 1.0], [0.8, 0.2]], np.float32
+        )
+    )
+    a = np.asarray(stake_weighted_median(W, S, 0.5))
+    b = np.asarray(stake_weighted_median_sorted(W, S, 0.5))
+    p = np.asarray(stake_weighted_median_pallas(W, S, 0.5, interpret=True))
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, p)
+    assert a[0] == np.float32(GRID), a
+    # Control: at kappa=0.3 the same rounded support (0.5) IS strictly
+    # above, so miner 0's consensus converges to the grid point just
+    # above the 0.8 weight level instead of collapsing to the floor.
+    c = np.asarray(stake_weighted_median(W, S, 0.3))
+    assert c[0] == np.float32(np.ceil(0.8 * 2**17) * GRID), c
+
+
 def test_support_exactly_kappa_is_not_above():
     # S = [0.5, 0.25, 0.25]; miner 0's support at any c in (0, 0.6) is
     # exactly 0.5 == kappa -> strict `>` fails, bisection walks down.

@@ -155,7 +155,11 @@ def yuma_epoch(
         reference), "sorted" (closed-form sort-based fast path), or
         "pallas" (fused VMEM-resident bisection kernel, TPU; falls back
         to the interpreter off-TPU). All three produce identical values.
-      precision_config: matmul precision for the stake contractions.
+      precision_config: matmul precision for the prerank/rank einsums
+        (`P`, `R`). The consensus support test no longer uses it — it
+        runs on the canonical fixed-point integers
+        (ops/consensus.py::support_fixed_stakes), which have no float
+        contraction to configure.
     """
     config = config if config is not None else YumaConfig()
     dtype = W.dtype

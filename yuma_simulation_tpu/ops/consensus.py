@@ -1,19 +1,27 @@
-"""Stake-weighted-median consensus, vectorized for the MXU.
+"""Stake-weighted-median consensus, vectorized whole-array on the VPU.
 
 The reference computes consensus with a per-miner Python `while` bisection
 (reference yumas.py:83-95 and the four duplicates), which is the measured
 hot spot (~83% of kernel time on CPU). Here the bisection runs as a fixed
 number of whole-array iterations: each step evaluates the stake support of
-every miner at once with a single masked mat-vec `S @ (W > c_mid)` — one
-MXU-friendly contraction per iteration instead of `M` Python loop bodies.
+every miner at once with one masked whole-array reduction instead of `M`
+Python loop bodies. The support test itself runs on canonical fixed-point
+integers (:func:`support_fixed_stakes`) shared by every consensus engine
+in the package, so the strict `support > kappa` decision is exact and
+independent of reduction order — no engine pair can disagree at
+knife-edge ties (the round-3 CROSS_ENGINE.json failure mode).
 
 Exactness: the reference loop `while (c_high - c_low) > 1/precision` from the
 interval [0, 1] runs exactly `ceil(log2(precision))` halvings (17 for the
 default precision of 100 000, yumas.py:14). Every midpoint is a dyadic
 rational `k/2^17`, exactly representable in float32, so the fixed-iteration
-vector form produces bit-identical `c_high` values; comparisons are strict
-`>` on both the weight and the kappa test, as in the reference
-(yumas.py:89-91).
+vector form produces bit-identical `c_high` values away from knife-edge
+ties; comparisons are strict `>` on both the weight and the kappa test, as
+in the reference (yumas.py:89-91). AT a knife-edge tie (exact support
+within f32 rounding noise of kappa) no deterministic implementation can
+track the reference's order-dependent intermediate rounding — the
+canonical test keeps its final-rounding semantics (see
+:func:`support_rounded`) and discards only that noise.
 """
 
 from __future__ import annotations
@@ -29,6 +37,58 @@ def _bisection_iterations(precision: int) -> int:
     # Halving [0,1] k times gives interval width 2^-k; the loop stops once
     # that is <= 1/precision.
     return int(math.ceil(math.log2(precision)))
+
+
+#: Fixed-point bits of the canonical support test (see below). 2^30 keeps
+#: the sum of normalized stakes (<= ~1 + V/2^31) inside int32.
+SUPPORT_FIXED_BITS = 30
+
+
+def support_fixed_stakes(S: jnp.ndarray) -> jnp.ndarray:
+    """Canonical fixed-point stake encoding for the consensus support test.
+
+    Every engine (XLA bisection, sorted closed form, Pallas consensus
+    kernel, fused epoch scan) evaluates the reference's strict support
+    test `sum(S[W > c]) > kappa` (reference yumas.py:89-91) on THESE
+    integers rather than on a floating-point sum: integer addition is
+    exact and order-independent, so the test's outcome cannot depend on
+    the engine's reduction tree. Floating-point support sums were the
+    diagnosed source of cross-engine consensus flips at knife-edge
+    `support == kappa` ties (CROSS_ENGINE.json, round 3): two correct f32
+    summations of the same addends can land on opposite sides of the
+    strict `>`.
+
+    Precondition: `S` normalized (`S / S.sum()`, as every caller does).
+    Accuracy: each addend is rounded to the nearest multiple of 2^-30, so
+    the fixed-point sum differs from the exact real sum of the f32 stakes
+    by <= V * 2^-31 — tighter than ANY f32 summation of V addends, whose
+    rounding error scales with V * eps * partial-sum magnitudes (~V *
+    6e-8). `S * 2^30` is an exact exponent shift in f32/f64, and the
+    nearest-integer round is deterministic on every backend.
+    """
+    scale = jnp.asarray(2.0**SUPPORT_FIXED_BITS, S.dtype)
+    return jnp.round(S * scale).astype(jnp.int32)
+
+
+def support_rounded(support_int: jnp.ndarray, dtype) -> jnp.ndarray:
+    """The canonical support VALUE the strict kappa comparison sees: the
+    exact integer sum rounded ONCE to `dtype` (an int->float convert plus
+    an exact exponent shift — both deterministic on every backend).
+
+    The final rounding is semantically load-bearing, not a convenience:
+    the reference compares an f32 support tensor against kappa
+    (yumas.py:88-91), so a sum whose exact value sits within half an f32
+    ulp ABOVE kappa still rounds onto kappa and fails the strict `>`.
+    Hand stakes like [0.4, 0.3, 0.2, 0.1] manufacture exactly this
+    (subset sums 0.5000000075 -> f32 0.5), and the kernel golden tests
+    pin that behavior. Comparing the raw integers would resolve such
+    ties by exact arithmetic instead and diverge from the reference.
+    What this deliberately does NOT reproduce is the reference's
+    order-dependent INTERMEDIATE rounding noise — that noise is exactly
+    what made the round-3 engines disagree with each other.
+    """
+    scale = jnp.asarray(2.0**-SUPPORT_FIXED_BITS, dtype)
+    return support_int.astype(dtype) * scale
 
 
 #: Above this many `V x M` cells the sorted closed form's XLA program hits
@@ -79,17 +139,20 @@ def stake_weighted_median(
       S: normalized stake `[..., V]`.
       kappa: consensus threshold (scalar or batched scalar `[...]`).
       precision: the reference's `consensus_precision` (static).
-      precision_config: matmul precision for the support contraction. The
-        support values are compared strictly against kappa, so on TPU this
-        defaults to HIGHEST (full fp32) rather than the bf16 MXU passes.
+      precision_config: retained for signature compatibility; inert. The
+        support test runs on the canonical fixed-point integers
+        (:func:`support_fixed_stakes`), not a matmul, so no float
+        contraction precision applies.
 
     Returns:
       `C`: consensus weight per miner `[..., M]` (the bisection's final
       `c_high`), in `W.dtype`.
     """
+    del precision_config  # support test is canonical fixed-point; see docstring
     iters = _bisection_iterations(precision)
     dtype = W.dtype
     batch_m = W.shape[:-2] + W.shape[-1:]
+    S_int = support_fixed_stakes(S)  # [..., V]
     kappa = jnp.asarray(kappa, dtype)
     if kappa.ndim:  # batched kappa broadcasts against [..., M]
         kappa = kappa[..., None]
@@ -97,11 +160,15 @@ def stake_weighted_median(
     def body(_, carry):
         c_lo, c_hi = carry
         c_mid = (c_hi + c_lo) / 2.0
-        mask = (W > c_mid[..., None, :]).astype(dtype)
-        support = jnp.einsum(
-            "...v,...vm->...m", S, mask, precision=precision_config
+        support = jnp.sum(
+            jnp.where(
+                W > c_mid[..., None, :],
+                S_int[..., :, None],
+                jnp.zeros((), jnp.int32),
+            ),
+            axis=-2,
         )
-        above = support > kappa
+        above = support_rounded(support, dtype) > kappa
         return jnp.where(above, c_mid, c_lo), jnp.where(above, c_hi, c_mid)
 
     c_lo = jnp.zeros(batch_m, dtype)
@@ -191,15 +258,20 @@ def stake_weighted_median_sorted(
     scale = float(2**iters)
     dtype = W.dtype
     kappa = jnp.asarray(kappa, dtype)
-    kap = kappa[..., None, None] if kappa.ndim else kappa
+    batched_kappa = kappa.ndim > 0
+    kap = kappa[..., None, None] if batched_kappa else kappa
 
     # Sort each miner column by weight, descending, carrying stakes along.
     # One stable multi-operand sort instead of argsort + two gathers: the
     # gathers are catastrophically slow on TPU (~100x) while a co-sorted
     # value operand is free; the permutation is identical (stable sort on
     # the negated key == stable argsort of the negated key).
+    # Stakes ride along in the canonical fixed-point encoding so the
+    # cumulative support below is the exact integer sum — bitwise the
+    # same test every other engine runs, in any summation order.
+    S_int = support_fixed_stakes(S)
     Wt = jnp.swapaxes(W, -1, -2)  # [..., M, V]
-    St = jnp.broadcast_to(S[..., None, :], Wt.shape)
+    St = jnp.broadcast_to(S_int[..., None, :], Wt.shape)
     w_neg, s_sorted = lax.sort(
         (-Wt, St), dimension=-1, num_keys=1, is_stable=True
     )
@@ -216,11 +288,14 @@ def stake_weighted_median_sorted(
         ],
         axis=-1,
     )
-    run_support = jnp.where(first_of_run, excl, -jnp.inf)
+    run_support = jnp.where(
+        first_of_run, excl, jnp.iinfo(jnp.int32).min
+    )
     support_at = lax.associative_scan(jnp.maximum, run_support, axis=-1)
     # Smallest qualifying weight; support at the max weight is 0 <= kappa,
-    # so one always exists.
-    qualifies = support_at <= kap
+    # so one always exists. The canonical rounded support value makes the
+    # `<=` here the exact complement of the other engines' strict `>`.
+    qualifies = support_rounded(support_at, dtype) <= kap
     w_star = jnp.min(jnp.where(qualifies, w_sorted, jnp.inf), axis=-1)
 
     # Round w* up to the dyadic grid without trusting f32 rounding of the
@@ -234,7 +309,14 @@ def stake_weighted_median_sorted(
     g = jnp.min(jnp.where(ok, grid, jnp.inf), axis=-1)
 
     # The support(0+) <= kappa regime: c_high bottoms out at 2^-p.
-    support0 = jnp.einsum("...vm,...v->...m", (W > 0).astype(dtype), S)
-    kap0 = kappa[..., None] if kappa.ndim else kappa
+    support0 = jnp.sum(
+        jnp.where(W > 0, S_int[..., :, None], jnp.zeros((), jnp.int32)),
+        axis=-2,
+    )
+    kap0 = kappa[..., None] if batched_kappa else kappa
     floor_c = jnp.asarray(1.0 / scale, dtype)
-    return jnp.where(support0 > kap0, jnp.maximum(g, floor_c), floor_c).astype(dtype)
+    return jnp.where(
+        support_rounded(support0, dtype) > kap0,
+        jnp.maximum(g, floor_c),
+        floor_c,
+    ).astype(dtype)

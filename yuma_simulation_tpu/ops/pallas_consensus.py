@@ -7,7 +7,10 @@ resident in VMEM and runs all 17 halvings on it before moving to the next
 block — a single HBM read of W per epoch, with the support reduction on
 the VPU (8x128 lanes, reduction over the validator sublane axis).
 
-Numerics are identical to the reference loop (reference yumas.py:83-95):
+Numerics follow the reference loop (reference yumas.py:83-95), with the
+canonical fixed-point support test shared by every engine in the package
+(ops/consensus.py — exact away from knife-edge ties, deterministic at
+them):
 midpoints are dyadic rationals `k/2^17` (exact in f32), comparisons are
 strict `>` on both the weight and the kappa test, and the returned value
 is the final `c_high`.
@@ -27,6 +30,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from yuma_simulation_tpu.ops.consensus import (
+    support_fixed_stakes,
+    support_rounded,
+)
+
 _LANES = 128
 _SUBLANES = 8
 
@@ -39,6 +47,11 @@ def _consensus_kernel(kappa_ref, s_ref, w_ref, c_ref, *, iters: int):
     """One grid step: full bisection for a `[V, TILE_M]` weight block."""
     W = w_ref[:]  # [V, TILE_M], VMEM-resident for all iterations
     S = s_ref[:]  # [V, 1]
+    # Canonical fixed-point support test: the SHARED helpers (plain jnp
+    # ops, trace fine under Mosaic) guarantee this kernel's support
+    # decisions stay bitwise those of every other consensus engine even
+    # if the canonical definition evolves.
+    S_int = support_fixed_stakes(S)
     kappa = kappa_ref[0]
 
     tile = (1, W.shape[1])
@@ -48,9 +61,12 @@ def _consensus_kernel(kappa_ref, s_ref, w_ref, c_ref, *, iters: int):
     def body(_, carry):
         c_lo, c_hi = carry
         c_mid = (c_hi + c_lo) * 0.5
-        mask = (W > c_mid).astype(W.dtype)  # strict, as the reference
-        support = jnp.sum(mask * S, axis=0, keepdims=True)  # [1, TILE_M]
-        above = support > kappa
+        support = jnp.sum(  # strict >, as the reference
+            jnp.where(W > c_mid, S_int, jnp.zeros((), jnp.int32)),
+            axis=0,
+            keepdims=True,
+        )  # [1, TILE_M]
+        above = support_rounded(support, W.dtype) > kappa
         return jnp.where(above, c_mid, c_lo), jnp.where(above, c_hi, c_mid)
 
     _, c_hi = jax.lax.fori_loop(0, iters, body, (c_lo, c_hi), unroll=True)

@@ -30,10 +30,13 @@ scenarios only pays at small shapes where a single run is latency-bound
 (DESIGN.md "Utilization", measured bandwidth ceiling ~4.3 TB/s).
 
 Numerics:
-- `mxu=False` (default): all reductions on the VPU in f32. Matches the
-  XLA kernel to reduction-order rounding (~1e-9 on bonds at 256x4096);
-  the bisection support sum is the same compare/select/sum sequence the
-  XLA path fuses, so consensus grid flips do not occur in practice.
+- `mxu=False` (default): the consensus support test runs on the
+  canonical fixed-point integers shared by every engine
+  (ops/consensus.py::support_fixed_stakes / support_rounded), so
+  consensus agrees BITWISE with the XLA kernels by construction —
+  including knife-edge ties (CROSS_ENGINE.json: 0 mismatch runs). All
+  other reductions stay on the VPU in f32 and match the XLA kernel to
+  reduction-order rounding (~1e-9 on bonds at 256x4096).
 - `mxu=True` (bench fast path): support and rank ride the MXU's bf16x3
   f32 decomposition. Support values can differ from the VPU sum by ~1 ulp,
   which near `support == kappa` can flip one 2^-17 consensus grid point
@@ -77,6 +80,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from yuma_simulation_tpu.models.epoch import _EMA_MODES, MAXINT, BondsMode
 from yuma_simulation_tpu.models.variants import ResetMode
+from yuma_simulation_tpu.ops.consensus import (
+    support_fixed_stakes as _support_fixed_stakes,
+    support_rounded as _support_rounded,
+)
 
 _LANES = 128
 _SUBLANES = 8
@@ -253,6 +260,15 @@ def _epoch_math(
     # consensus from the current ones, reference yumas.py:309-325).
     c_lo = jnp.zeros(W.shape[:-2] + (1, Mp), W.dtype)
     c_hi = jnp.ones(W.shape[:-2] + (1, Mp), W.dtype)
+    # Canonical fixed-point support test, via the SHARED helpers
+    # (ops/consensus.py — plain jnp ops, trace fine under Mosaic): the
+    # integer sum is exact and order-independent, then rounded ONCE to
+    # W.dtype before the strict `> kappa` compare, so the decision here
+    # is bitwise the XLA engines' decision — no cross-engine tie flips.
+    # The i32 select-into-reduce has the same VMEM traffic as the f32
+    # one it replaces; the int->float convert touches only the
+    # [.., 1, Mp] support row.
+    S_int = _support_fixed_stakes(S)
 
     def body(_, carry):
         c_lo, c_hi = carry
@@ -260,18 +276,20 @@ def _epoch_math(
         if mxu:
             mask = (W_n > c_mid).astype(W.dtype)  # strict, as the reference
             support = _support(S, mask, mxu)
+            above = support > kappa
         else:
             # One fused traversal (select straight into the reduce): the
             # compare->astype->multiply->reduce chain costs ~3 VMEM passes
             # over [V, M] per halving and dominates the whole VPU epoch;
-            # summing the same addends (S_i or 0.0, strict >) in the same
-            # sublane order this way measures ~2.4x faster end-to-end.
+            # selecting the integer addends straight into the reduce keeps
+            # that shape (measured ~2.4x faster than the mask-multiply
+            # form when this was f32; i32 adds run at the same VPU rate).
             support = jnp.sum(
-                jnp.where(W_n > c_mid, S, jnp.zeros((), W.dtype)),
+                jnp.where(W_n > c_mid, S_int, jnp.zeros((), jnp.int32)),
                 axis=-2,
                 keepdims=True,
             )
-        above = support > kappa
+            above = _support_rounded(support, W.dtype) > kappa
         return jnp.where(above, c_mid, c_lo), jnp.where(above, c_hi, c_mid)
 
     _, c_hi = lax.fori_loop(0, iters, body, (c_lo, c_hi), unroll=True)

@@ -39,13 +39,18 @@ def time_best(
     a Monte-Carlo shard count), so `n / best` never over-counts.
     """
     def on_grid(x: int) -> int:
-        return max(granularity, x // granularity * granularity)
+        # Cap at the largest grid multiple <= max_n so the result both
+        # honors the divisibility contract and never exceeds the cap
+        # (when max_n < granularity no such multiple exists; the floor of
+        # one granularity quantum is the least-wrong answer).
+        cap = max(max_n // granularity, 1) * granularity
+        return min(cap, max(granularity, x // granularity * granularity))
 
     n = on_grid(n)  # the caller's n must honor the divisibility contract too
     np.asarray(run(n))  # compile + warm up
     t0 = time.perf_counter()
     np.asarray(run(n))
-    dt = time.perf_counter() - t0
+    dt = max(time.perf_counter() - t0, 1e-9)  # coarse timers can report 0.0
     while dt < target_seconds:
         grown = on_grid(min(max_n, int(n * max(2.0, 1.25 * target_seconds / dt))))
         if grown <= n:
@@ -56,7 +61,7 @@ def time_best(
         np.asarray(run(n))  # recompile at the timed length
         t0 = time.perf_counter()
         np.asarray(run(n))
-        dt = time.perf_counter() - t0
+        dt = max(time.perf_counter() - t0, 1e-9)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()

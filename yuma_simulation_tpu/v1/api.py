@@ -103,11 +103,11 @@ def _simulate_suite(
     Engine note (DESIGN.md "Precision policy"): this path always uses the
     XLA batch engine, while `run_simulation` on TPU defaults to the fused
     Pallas scan (`epoch_impl="auto"`). Both pass the golden surface
-    independently and agree bitwise on consensus for the built-in suite;
-    on adversarial knife-edge `support == kappa` ties the engines can
-    differ within the documented tolerance class (CROSS_ENGINE.json).
-    Users who want the chart path on the fused engine can call
-    `run_simulation(..., epoch_impl="pallas")` per case and plot directly.
+    independently, and since the canonical fixed-point support test
+    (r4) they agree BITWISE on consensus for every input — including
+    adversarial knife-edge `support == kappa` ties (CROSS_ENGINE.json:
+    0/90 mismatch runs in both regimes); residual cross-engine output
+    differences are downstream f32 arithmetic-order effects at ~1e-7.
     """
     import numpy as np
 
