@@ -273,32 +273,71 @@ def test_simulate_epoch_impl_routing():
         simulate(case, "Yuma 1 (paper)", cfg, epoch_impl="nope")
 
 
-def test_fused_paths_reject_liquid_overrides():
-    """Every explicit fused entry point must refuse consensus-quantile
-    overrides (the kernels have no override branch) rather than silently
-    dropping them — mirroring the eligibility predicate `auto` uses."""
-    from yuma_simulation_tpu.scenarios import cases
-    from yuma_simulation_tpu.simulation.engine import simulate_scaled
+@pytest.mark.parametrize(
+    "overrides",
+    [
+        dict(override_consensus_high=0.03),
+        dict(override_consensus_low=0.001),
+        dict(override_consensus_high=0.03, override_consensus_low=0.001),
+        # equal overrides collapse the spread -> the reference's
+        # 0.99-quantile degenerate fallback must fire in-kernel too
+        dict(override_consensus_high=0.02, override_consensus_low=0.02),
+    ],
+    ids=["high", "low", "both", "degenerate"],
+)
+def test_fused_liquid_overrides_match_xla(overrides):
+    """Consensus-quantile overrides run IN-KERNEL on the fused paths
+    (static compile-time constants replacing the joint quantile
+    selection, reference yumas.py:124-133) and must match the XLA
+    engine, including the degenerate equal-override fallback.
 
-    cfg = YumaConfig(
-        yuma_params=YumaParams(liquid_alpha=True, override_consensus_high=0.5)
+    Random data, not a built-in case: the 14-case suite's 2-miner
+    consensus is exactly {0, 1}, which saturates the liquid-alpha
+    sigmoid clamp for ANY quantile fit — overrides provably change
+    nothing there, so a case-based comparison would pass vacuously.
+    The override magnitudes are chosen near the random C scale
+    (~1/64 per miner) and each run asserts the override actually
+    moved the bonds before asserting the engines agree on them."""
+    from yuma_simulation_tpu.simulation.engine import (
+        _simulate_case_fused,
+        _simulate_scan,
     )
-    spec = variant_for_version("Yuma 1 (paper) - liquid alpha on")
-    with pytest.raises(ValueError, match="override"):
-        simulate(
-            cases[0], "Yuma 1 (paper) - liquid alpha on", cfg,
-            epoch_impl="fused_scan",
+
+    rng = np.random.default_rng(7)
+    E, V, M = 8, 16, 64
+    W = jnp.asarray(rng.random((E, V, M)).astype(np.float32))
+    S = jnp.asarray(rng.random((E, V)).astype(np.float32) + 0.01)
+    ri = jnp.asarray(-1, jnp.int32)
+    re = jnp.asarray(-1, jnp.int32)
+    cfg = YumaConfig(yuma_params=YumaParams(liquid_alpha=True, **overrides))
+    base = YumaConfig(yuma_params=YumaParams(liquid_alpha=True))
+    for version in (
+        "Yuma 1 (paper) - liquid alpha on",
+        "Yuma 4 (Rhef+relative bonds) - liquid alpha on",
+    ):
+        spec = variant_for_version(version)
+        ys_base = _simulate_scan(W, S, ri, re, base, spec, save_bonds=True)
+        ys_xla = _simulate_scan(W, S, ri, re, cfg, spec, save_bonds=True)
+        ys_fused = _simulate_case_fused(
+            W, S, ri, re, cfg, spec, save_bonds=True
         )
-    rng = np.random.default_rng(0)
-    W = jnp.asarray(rng.random((2, 4, 8)), jnp.float32)
-    S = jnp.asarray(rng.random((2, 4)) + 0.01, jnp.float32)
-    ones = jnp.ones(3, jnp.float32)
-    with pytest.raises(ValueError, match="override"):
-        simulate_scaled_batch(W, S, ones, cfg, spec, epoch_impl="fused_scan")
-    with pytest.raises(ValueError, match="override"):
-        simulate_scaled(W[0], S[0], ones, cfg, spec, epoch_impl="fused_scan")
-    # ...but the XLA paths accept the overrides.
-    simulate(cases[0], "Yuma 1 (paper) - liquid alpha on", cfg, epoch_impl="xla")
+        effect = float(
+            np.abs(
+                np.asarray(ys_xla["bonds"]) - np.asarray(ys_base["bonds"])
+            ).max()
+        )
+        assert effect > 1e-3, (
+            f"override {overrides} had no effect on {version}; the "
+            "agreement assertion below would be vacuous"
+        )
+        np.testing.assert_allclose(
+            ys_fused["bonds"], ys_xla["bonds"], atol=2e-6, rtol=2e-5,
+            err_msg=f"{version} {overrides}",
+        )
+        np.testing.assert_allclose(
+            ys_fused["dividends"], ys_xla["dividends"], atol=2e-6, rtol=2e-5,
+            err_msg=f"{version} {overrides}",
+        )
 
 
 def test_simulate_scaled_batch_rejects_unknown_impl():
@@ -365,13 +404,17 @@ def test_fused_case_scan_eligible_gating():
     assert not fused_case_scan_eligible(shape, BondsMode.EMA, cfg, jnp.float64)
     # over the VMEM budget is never eligible
     assert not fused_case_scan_eligible((40, 8192, 65536), BondsMode.EMA, cfg)
-    # liquid-alpha quantile overrides stay on the XLA path
+    # liquid-alpha quantile overrides are supported in-kernel (r4) and
+    # no longer gate eligibility
     liquid_override = YumaConfig(
         yuma_params=YumaParams(
             liquid_alpha=True, override_consensus_high=0.5
         )
     )
-    assert not fused_case_scan_eligible(shape, BondsMode.EMA, liquid_override)
+    assert (
+        fused_case_scan_eligible(shape, BondsMode.EMA, liquid_override)
+        == on_tpu
+    )
     assert (
         fused_case_scan_eligible(shape, BondsMode.CAPACITY, liquid_override)
         == on_tpu  # CAPACITY ignores the liquid fit entirely

@@ -106,6 +106,61 @@ def test_simulate_scaled_fused_matches_xla(version):
     )
 
 
+def test_simulate_scaled_fused_scan_liquid_overrides_match_xla():
+    """In-kernel consensus-quantile overrides on the fused_ema_scan path
+    (simulate_scaled / simulate_scaled_batch): the override must (a)
+    actually change the output vs the no-override config — silent
+    dropping of the static kwargs through the pallas_call partial is
+    exactly the wiring bug this guards — and (b) match the XLA oracle."""
+    from yuma_simulation_tpu.models.config import YumaParams
+    from yuma_simulation_tpu.simulation.engine import simulate_scaled_batch
+
+    V, M, E = 16, 64, 10
+    rng = np.random.default_rng(11)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    scales = jnp.asarray(1.0 + 1e-4 * rng.random(E), jnp.float32)
+    # Yuma 4, not Yuma 1: with epoch-constant weights the EMA families
+    # sit at their bond fixed point from epoch 0 (B_1 = B_t), so the
+    # liquid rate — and hence any override — provably cannot move their
+    # outputs (the rejected closed-form shortcut, DESIGN.md). The
+    # RELATIVE bonds model accumulates rate-scaled purchases instead,
+    # so the override has a real effect to compare.
+    spec = variant_for_version("Yuma 4 (Rhef+relative bonds) - liquid alpha on")
+    base = YumaConfig(yuma_params=YumaParams(liquid_alpha=True))
+    cfg = YumaConfig(
+        yuma_params=YumaParams(
+            liquid_alpha=True,
+            override_consensus_high=0.03,
+            override_consensus_low=0.001,
+        )
+    )
+    t_base, b_base = simulate_scaled(W, S, scales, base, spec, epoch_impl="xla")
+    t_xla, b_xla = simulate_scaled(W, S, scales, cfg, spec, epoch_impl="xla")
+    t_fused, b_fused = simulate_scaled(
+        W, S, scales, cfg, spec, epoch_impl="fused_scan"
+    )
+    assert float(np.abs(np.asarray(b_xla) - np.asarray(b_base)).max()) > 1e-3, (
+        "override had no effect; agreement below would be vacuous"
+    )
+    np.testing.assert_allclose(np.asarray(t_fused), np.asarray(t_xla), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(b_fused), np.asarray(b_xla), atol=2e-6)
+    # batched path shares the kernel but passes the static kwargs through
+    # its own call site
+    tb_xla, bb_xla = simulate_scaled_batch(
+        W[None], S[None], scales, cfg, spec, epoch_impl="xla"
+    )
+    tb_fused, bb_fused = simulate_scaled_batch(
+        W[None], S[None], scales, cfg, spec, epoch_impl="fused_scan"
+    )
+    np.testing.assert_allclose(
+        np.asarray(tb_fused), np.asarray(tb_xla), rtol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(bb_fused), np.asarray(bb_xla), atol=2e-6
+    )
+
+
 @pytest.mark.parametrize(
     "version",
     ["Yuma 0 (subtensor)", "Yuma 1 (paper)", "Yuma 2 (Adrian-Fish)"],

@@ -86,9 +86,11 @@ def liquid_alpha_rate(
     else:
         c_low = quant(0.25)
 
-    if override_consensus_high is None:
-        # Degenerate spread: fall back to the 0.99 quantile (yumas.py:132-133).
-        c_high = jnp.where(c_high == c_low, quant(0.99), c_high)
+    # Degenerate spread: fall back to the 0.99 quantile (yumas.py:132-133).
+    # The reference runs this check AFTER substituting the overrides, so
+    # it applies even when consensus_high is overridden (an override equal
+    # to the low side still collapses the spread and must fall back).
+    c_high = jnp.where(c_high == c_low, quant(0.99), c_high)
 
     if isinstance(alpha_high, (int, float)) and isinstance(alpha_low, (int, float)):
         logit_high = _logit(alpha_high)

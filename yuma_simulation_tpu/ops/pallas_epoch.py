@@ -111,7 +111,15 @@ def _support(S_col, mask, mxu: bool):
 
 
 def _liquid_rate_on_grid(
-    C, logit_low, logit_num, alpha_low, alpha_high, *, n: int
+    C,
+    logit_low,
+    logit_num,
+    alpha_low,
+    alpha_high,
+    *,
+    n: int,
+    override_high: float | None = None,
+    override_low: float | None = None,
 ):
     """Per-miner liquid-alpha EMA rate from the quantized consensus row
     `[..., 1, Mp]`, computed WITHOUT a sort (Mosaic has none): every C
@@ -143,6 +151,29 @@ def _liquid_rate_on_grid(
 
     Supports leading batch dims (the batched scan): counts reduce over
     the miner axis only.
+
+    `override_high` / `override_low` are the STATIC consensus-quantile
+    overrides (reference yumas.py:124-133): a set override replaces the
+    corresponding quantile selection with a compile-time constant (its
+    ranks are simply dropped from the joint bisection). The degenerate
+    fallback to the 0.99 quantile still applies regardless — the
+    reference's `consensus_high == consensus_low` check runs after the
+    overrides are substituted — so the 0.99 ranks are always selected.
+    With an override in play the degenerate test is the float equality
+    of the actual values compared (as the reference and the XLA oracle
+    compute it); the exact integer-order-statistic test applies only
+    when both sides are computed quantiles. Caveat (same class as the
+    documented interpolation-coincidence edge): with exactly ONE
+    override set, this equality compares the override constant against
+    an interpolated quantile whose last-ulp rounding can differ between
+    this kernel and `jnp.quantile` — an override bit-equal to one
+    engine's interpolation but one ulp off the other's would fire the
+    0.99 fallback on one side only. Constructing that requires an
+    override tuned to a specific data-dependent quantile to 2^-24;
+    never observed on real data, and unlike the (fixed) support-sum tie
+    flips there is no order-independent value to canonicalize — the
+    quantile interpolations themselves differ, which the precision
+    policy already documents.
     """
     dtype = C.dtype
     Mp = C.shape[-1]
@@ -150,10 +181,16 @@ def _liquid_rate_on_grid(
     real = col < n
     C_int = jnp.round(C * 65535.0).astype(jnp.int32)  # [..., 1, Mp]
 
-    # Ranks (0-indexed order statistics) needed by the three quantiles.
+    # Ranks (0-indexed order statistics) needed by the computed
+    # quantiles (overridden ones need no selection).
+    quantiles = [0.99]
+    if override_high is None:
+        quantiles.append(0.75)
+    if override_low is None:
+        quantiles.append(0.25)
     pos: dict[float, tuple[int, int, float]] = {}
     ks: list[int] = []
-    for q in (0.25, 0.75, 0.99):
+    for q in quantiles:
         p = q * (n - 1)
         lo_i, hi_i = int(math.floor(p)), int(math.ceil(p))
         pos[q] = (lo_i, hi_i, p - lo_i)
@@ -199,11 +236,24 @@ def _liquid_rate_on_grid(
             return v_lo
         return v_lo * (1.0 - frac) + stat(hi_i) * frac
 
-    c_high0 = quant(0.75)
-    c_low = quant(0.25)
-    # Degenerate spread -> 0.99-quantile fallback, tested on the exact
-    # integer grid (see docstring).
-    degenerate = stat_i(pos[0.75][1]) == stat_i(pos[0.25][0])
+    c_high0 = (
+        quant(0.75)
+        if override_high is None
+        else jnp.asarray(override_high, dtype)
+    )
+    c_low = (
+        quant(0.25)
+        if override_low is None
+        else jnp.asarray(override_low, dtype)
+    )
+    # Degenerate spread -> 0.99-quantile fallback (runs even when
+    # overridden, reference yumas.py:132-133): tested on the exact
+    # integer grid when both quantiles are computed (see docstring),
+    # on the compared float values when an override is in play.
+    if override_high is None and override_low is None:
+        degenerate = stat_i(pos[0.75][1]) == stat_i(pos[0.25][0])
+    else:
+        degenerate = c_high0 == c_low
     c_high = jnp.where(degenerate, quant(0.99), c_high0)
     a = logit_num / (c_low - c_high)
     b = logit_low + a * c_low
@@ -230,6 +280,7 @@ def _epoch_math(
     decay=None,
     liquid: bool = False,
     liquid_scal=None,  # (logit_low, logit_num, alpha_low, alpha_high)
+    liquid_overrides=(None, None),  # static (override_high, override_low)
 ):
     """The one shared epoch pipeline all fused kernels trace:
     row-normalize -> bisection -> u16 quantize -> clip -> incentive ->
@@ -325,7 +376,13 @@ def _epoch_math(
     # model never uses a rate (models/epoch.py: the fit is skipped there).
     rate = alpha
     if liquid and mode is not BondsMode.CAPACITY:
-        rate = _liquid_rate_on_grid(C, *liquid_scal, n=m_real)
+        rate = _liquid_rate_on_grid(
+            C,
+            *liquid_scal,
+            n=m_real,
+            override_high=liquid_overrides[0],
+            override_low=liquid_overrides[1],
+        )
 
     # Bond update, by model family.
     if mode in _EMA_MODES:
@@ -423,22 +480,6 @@ def _fused_ema_epoch_kernel(
 _SCAN_MODES = _EMA_MODES + (BondsMode.CAPACITY, BondsMode.RELATIVE)
 
 
-def liquid_overrides_block_fused(config, mode: BondsMode) -> bool:
-    """True when liquid-alpha consensus-quantile overrides force the XLA
-    path: the in-kernel quantile selection has no override branch.
-    CAPACITY skips the liquid fit entirely (models/epoch.py), so the
-    overrides are moot there. The one shared gate for every fused-scan
-    eligibility predicate and explicit-path guard."""
-    return (
-        config.liquid_alpha
-        and mode is not BondsMode.CAPACITY
-        and (
-            config.override_consensus_high is not None
-            or config.override_consensus_low is not None
-        )
-    )
-
-
 def _scan_resident_bytes(shape, mode: BondsMode) -> int:
     """VMEM bytes the fused scan keeps resident (W + B [+ W_prev]),
     padded to tile boundaries — the one source of truth for both the
@@ -452,17 +493,15 @@ def _scan_resident_bytes(shape, mode: BondsMode) -> int:
 
 def fused_scan_eligible(shape, mode: BondsMode, config, dtype=None) -> bool:
     """Whether :func:`fused_ema_scan` can run this workload — the
-    `epoch_impl="auto"` predicate: float32 arrays, no consensus-quantile
-    overrides, not Yuma-0-under-x64, within the VMEM budget, and on a
-    real TPU (interpret mode would be slower than XLA, not faster). All
-    five bond models and liquid alpha are supported."""
+    `epoch_impl="auto"` predicate: float32 arrays, not Yuma-0-under-x64,
+    within the VMEM budget, and on a real TPU (interpret mode would be
+    slower than XLA, not faster). All five bond models, liquid alpha and
+    its consensus-quantile overrides are supported in-kernel."""
     if mode not in _SCAN_MODES:
         return False
     if dtype is not None and jnp.dtype(dtype) != jnp.float32:
         # Pallas TPU kernels here are f32-only (module docstring); an
         # f64 input must fall back to XLA, not crash in Mosaic.
-        return False
-    if liquid_overrides_block_fused(config, mode):
         return False
     if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
         return False
@@ -487,6 +526,7 @@ def _fused_ema_scan_kernel(
     m_real: int,
     num_epochs: int,
     liquid: bool,
+    liquid_overrides: tuple = (None, None),
 ):
     """One grid step = one epoch; the bond state lives in VMEM scratch for
     the WHOLE scan, so the per-epoch HBM traffic of the lax.scan carry
@@ -523,6 +563,7 @@ def _fused_ema_scan_kernel(
         decay=scal_ref[4],
         liquid=liquid,
         liquid_scal=(scal_ref[5], scal_ref[6], scal_ref[7], scal_ref[8]),
+        liquid_overrides=liquid_overrides,
     )
 
     b_scr[:] = B_ema
@@ -538,7 +579,15 @@ def _fused_ema_scan_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mode", "mxu", "interpret", "precision", "liquid_alpha"),
+    static_argnames=(
+        "mode",
+        "mxu",
+        "interpret",
+        "precision",
+        "liquid_alpha",
+        "override_consensus_high",
+        "override_consensus_low",
+    ),
 )
 def fused_ema_scan(
     W: jnp.ndarray,
@@ -553,13 +602,17 @@ def fused_ema_scan(
     liquid_alpha: bool = False,
     alpha_low=0.7,
     alpha_high=0.9,
+    override_consensus_high: float | None = None,
+    override_consensus_low: float | None = None,
     mode: BondsMode = BondsMode.EMA,
     mxu: bool = False,
     precision: int = 100_000,
     interpret: bool | None = None,
 ):
     """The WHOLE epoch scan as one Pallas program (all five bond models,
-    liquid alpha included — quantile overrides stay on the XLA path).
+    liquid alpha included, consensus-quantile overrides in-kernel as
+    compile-time constants — they are static config fields,
+    models/config.py).
 
     Epoch `e` simulates `W * scales[e]` (the epoch-varying workload of
     `simulate_scaled`). The grid iterates over epochs sequentially; the
@@ -676,6 +729,10 @@ def fused_ema_scan(
             m_real=M,
             num_epochs=E,
             liquid=liquid_alpha,
+            liquid_overrides=(
+                override_consensus_high,
+                override_consensus_low,
+            ),
         ),
         grid=(E,),
         in_specs=[
@@ -722,14 +779,13 @@ def fused_case_scan_eligible(
 ) -> bool:
     """Whether :func:`fused_case_scan` can run this workload — the
     `epoch_impl="auto"` predicate of :func:`..simulation.engine.simulate`:
-    float32 arrays, no consensus-quantile overrides, not Yuma-0-under-x64,
-    within the VMEM budget, and on a real TPU (interpret mode would be
-    slower than XLA, not faster). `shape` is `[E, V, M]` or `[V, M]`."""
+    float32 arrays, not Yuma-0-under-x64, within the VMEM budget, and on
+    a real TPU (interpret mode would be slower than XLA, not faster).
+    `shape` is `[E, V, M]` or `[V, M]`; liquid alpha and its
+    consensus-quantile overrides are supported in-kernel."""
     if mode not in _SCAN_MODES:
         return False
     if dtype is not None and jnp.dtype(dtype) != jnp.float32:
-        return False
-    if liquid_overrides_block_fused(config, mode):
         return False
     if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
         return False
@@ -756,6 +812,7 @@ def _fused_case_scan_kernel(
     save_bonds: bool,
     save_incentives: bool,
     save_consensus: bool,
+    liquid_overrides: tuple = (None, None),
 ):
     """One grid step = one epoch of the reference's REAL workload: this
     epoch's weight block `[1, Vp, Mp]` and stake block `[1, Vp, 1]` are
@@ -826,6 +883,7 @@ def _fused_case_scan_kernel(
         decay=scal_ref[4],
         liquid=liquid,
         liquid_scal=(scal_ref[5], scal_ref[6], scal_ref[7], scal_ref[8]),
+        liquid_overrides=liquid_overrides,
     )
 
     b_scr[...] = B_next
@@ -855,6 +913,8 @@ def _fused_case_scan_kernel(
         "interpret",
         "precision",
         "liquid_alpha",
+        "override_consensus_high",
+        "override_consensus_low",
         "save_bonds",
         "save_incentives",
         "save_consensus",
@@ -875,6 +935,8 @@ def fused_case_scan(
     liquid_alpha: bool = False,
     alpha_low=0.7,
     alpha_high=0.9,
+    override_consensus_high: float | None = None,
+    override_consensus_low: float | None = None,
     mode: BondsMode = BondsMode.EMA,
     mxu: bool = False,
     precision: int = 100_000,
@@ -885,7 +947,8 @@ def fused_case_scan(
 ):
     """The reference's ACTUAL epoch loop — genuinely different weights
     and stakes every epoch, bond-reset injection included — as one
-    Pallas program (all five bond models, liquid alpha in-kernel).
+    Pallas program (all five bond models, liquid alpha and its static
+    consensus-quantile overrides in-kernel).
 
     This is the r2 verdict's top item: `fused_ema_scan` only simulates
     scalar-scaled weights, so every real scenario (reference
@@ -1008,6 +1071,10 @@ def fused_case_scan(
             save_bonds=save_bonds,
             save_incentives=save_incentives,
             save_consensus=save_consensus,
+            liquid_overrides=(
+                override_consensus_high,
+                override_consensus_low,
+            ),
         ),
         grid=(E,),
         in_specs=[

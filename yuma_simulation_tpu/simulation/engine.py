@@ -98,6 +98,8 @@ def fused_hparams(config: YumaConfig) -> dict:
         liquid_alpha=config.liquid_alpha,
         alpha_low=config.alpha_low,
         alpha_high=config.alpha_high,
+        override_consensus_high=config.override_consensus_high,
+        override_consensus_low=config.override_consensus_low,
         precision=config.consensus_precision,
     )
 
@@ -248,16 +250,8 @@ def _simulate_case_fused(
     the dividend-per-1000-tao conversion (linear, needs the raw per-epoch
     stakes) happens out here. Returns the same ys dict as
     `_simulate_scan`."""
-    from yuma_simulation_tpu.ops.pallas_epoch import (
-        fused_case_scan,
-        liquid_overrides_block_fused,
-    )
+    from yuma_simulation_tpu.ops.pallas_epoch import fused_case_scan
 
-    if liquid_overrides_block_fused(config, spec.bonds_mode):
-        raise ValueError(
-            "the fused case scan does not support consensus-quantile "
-            "overrides; use epoch_impl='xla'"
-        )
     dtype = weights.dtype
     res = fused_case_scan(
         weights,
@@ -527,16 +521,8 @@ def simulate_scaled(
         )
 
     if epoch_impl in ("fused_scan", "fused_scan_mxu"):
-        from yuma_simulation_tpu.ops.pallas_epoch import (
-            fused_ema_scan,
-            liquid_overrides_block_fused,
-        )
+        from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
 
-        if liquid_overrides_block_fused(config, spec.bonds_mode):
-            raise ValueError(
-                "fused epoch_impl does not support consensus-quantile "
-                "overrides; use the XLA path"
-            )
         B_final, D_tot = fused_ema_scan(
             W,
             S / S.sum(),
@@ -685,16 +671,8 @@ def simulate_scaled_batch(
             else "xla"
         )
     if epoch_impl == "fused_scan":
-        from yuma_simulation_tpu.ops.pallas_epoch import (
-            fused_ema_scan,
-            liquid_overrides_block_fused,
-        )
+        from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
 
-        if liquid_overrides_block_fused(config, spec.bonds_mode):
-            raise ValueError(
-                "fused epoch_impl does not support consensus-quantile "
-                "overrides; use the XLA path"
-            )
         B_final, D_tot = fused_ema_scan(
             W,
             S / S.sum(axis=-1, keepdims=True),
