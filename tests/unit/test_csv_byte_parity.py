@@ -1,0 +1,38 @@
+"""Byte-level gate on the rendered CSV artifacts.
+
+The full-precision golden surface (test_parity_golden.py) bounds every
+value to <1.5e-6, but the artifact the reference actually ships is the
+`%.6f`-rendered CSV — and a deviation of a few 1e-7 can flip a rendered
+6th decimal on a knife-edge cell. This test renders the framework's CSVs
+byte-for-byte as the CLI does and classifies every differing cell
+against the reference-rendered goldens via the same logic as
+`tools/csv_byte_parity.py` (which writes the CSV_BYTE_PARITY.json
+artifact): a differing cell must be a one-unit 6th-decimal rounding of
+a <1.5e-6 full-precision deviation, nothing else.
+"""
+
+import pytest
+
+from tools.csv_byte_parity import BETAS, classify_beta
+
+
+@pytest.mark.parametrize("beta", BETAS)
+def test_rendered_csv_within_rounding_class(beta):
+    res = classify_beta(beta)
+    if res["byte_identical"]:
+        return
+    diffs = res["differing_cells"]
+    # The comparison must not be vacuous: the header and case labels must
+    # have matched (classify_beta asserts), and differing cells exist.
+    assert diffs, "files differ but no cell-level diffs found"
+    bad = [d for d in diffs if not d["is_sixth_decimal_rounding"]]
+    assert not bad, (
+        f"beta={beta}: {len(bad)} differing cells are NOT one-unit "
+        f"6th-decimal roundings of <1.5e-6 deviations: {bad[:5]}"
+    )
+    # Knife-edge flips are a small minority of the surface; a majority
+    # differing would mean a real numerical regression even if each cell
+    # individually stayed in class.
+    assert len(diffs) < 0.25 * res["cells_total"], (
+        f"beta={beta}: {len(diffs)}/{res['cells_total']} cells differ"
+    )

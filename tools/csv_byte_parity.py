@@ -1,0 +1,207 @@
+"""Byte-level parity of the rendered total-dividends CSV artifacts.
+
+The reference's parity artifact is the `%.6f`-rendered
+`total_dividends_b{beta}.csv` (reference
+scripts/total_dividends_sheet_generator.py:64). The golden-surface tests
+pin full-precision values to <1.5e-6, but a deviation of a few 1e-7 can
+still flip the 6th rendered decimal on a knife-edge cell — so the
+literal byte artifact needs its own gate:
+
+    python tools/csv_byte_parity.py --out CSV_BYTE_PARITY.json
+
+For each beta this renders the framework's CSV exactly as the CLI does
+(x64 CPU parity mode, same `to_csv(index=False, float_format="%.6f")`)
+and byte-compares it against the reference-rendered golden
+(`tests/golden/total_dividends_b{beta}.csv`, generated from the torch
+reference by tools/gen_goldens.py). Every differing cell is enumerated
+and must satisfy BOTH:
+
+- the rendered strings differ by exactly one unit in the 6th decimal
+  (a rounding-boundary flip, not a numerical disagreement), and
+- the framework's full-precision value is within the 1.5e-6 golden
+  tolerance of the reference's full-precision value
+  (`*_full.csv`, `%.17g`).
+
+Any cell outside that class fails the run (exit 1) and the artifact's
+status says so. `tests/unit/test_csv_byte_parity.py` runs the same
+classification in-suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import datetime
+import io
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+GOLDEN_DIR = os.path.join(REPO, "tests", "golden")
+
+BETAS = ("0", "0.5", "0.99", "1.0")
+FULL_TOL = 1.5e-6
+#: One unit in the 6th rendered decimal, with float slack.
+RENDER_UNIT = 1.0000001e-6
+
+
+def render_csv_text(beta: str) -> tuple[str, "object"]:
+    """The framework's rendered CSV for one beta, byte-for-byte as the
+    CLI writes it, plus the unrendered DataFrame (full precision)."""
+    import pandas as pd  # noqa: F401  (df.to_csv)
+
+    from yuma_simulation_tpu.models.config import SimulationHyperparameters
+    from yuma_simulation_tpu.models.variants import canonical_versions
+    from yuma_simulation_tpu.reporting.tables import (
+        generate_total_dividends_table,
+    )
+    from yuma_simulation_tpu.scenarios import get_cases
+
+    hp = SimulationHyperparameters(bond_penalty=float(beta))
+    df = generate_total_dividends_table(get_cases(), canonical_versions(), hp)
+    buf = io.StringIO()
+    df.to_csv(buf, index=False, float_format="%.6f")
+    return buf.getvalue(), df
+
+
+def classify_beta(beta: str) -> dict:
+    """Byte-compare one beta's rendered CSV against the reference-rendered
+    golden; enumerate and classify every differing cell."""
+    mine_text, df = render_csv_text(beta)
+    golden_path = os.path.join(GOLDEN_DIR, f"total_dividends_b{beta}.csv")
+    with open(golden_path, newline="") as f:
+        golden_text = f.read()
+    if mine_text == golden_text:
+        return {
+            "beta": beta,
+            "byte_identical": True,
+            "differing_cells": [],
+            "cells_total": sum(1 for _ in csv.reader(io.StringIO(mine_text))),
+        }
+
+    mine_rows = list(csv.reader(io.StringIO(mine_text)))
+    golden_rows = list(csv.reader(io.StringIO(golden_text)))
+    full_path = os.path.join(GOLDEN_DIR, f"total_dividends_b{beta}_full.csv")
+    with open(full_path, newline="") as f:
+        full_rows = list(csv.reader(f))
+    assert len(mine_rows) == len(golden_rows) == len(full_rows)
+    header = mine_rows[0]
+    assert header == golden_rows[0]
+    # Row alignment: cells are compared by index, so a reordered case
+    # list must fail loudly here, not misattribute diffs across cases.
+    for r in range(1, len(mine_rows)):
+        assert mine_rows[r][0] == golden_rows[r][0] == full_rows[r][0], (
+            f"row {r} case labels misaligned: {mine_rows[r][0]!r} vs "
+            f"{golden_rows[r][0]!r} vs {full_rows[r][0]!r}"
+        )
+
+    diffs = []
+    cells = 0
+    for r in range(1, len(mine_rows)):
+        for c in range(1, len(header)):
+            cells += 1
+            a, b = mine_rows[r][c], golden_rows[r][c]
+            if a == b:
+                continue
+            mine_full = float(df.iloc[r - 1, c])
+            ref_full = float(full_rows[r][c])
+            full_dev = abs(mine_full - ref_full)
+            rendered_dev = abs(float(a) - float(b))
+            diffs.append(
+                {
+                    "case": mine_rows[r][0],
+                    "column": header[c],
+                    "rendered_mine": a,
+                    "rendered_reference": b,
+                    "full_precision_deviation": full_dev,
+                    "is_sixth_decimal_rounding": bool(
+                        rendered_dev <= RENDER_UNIT and full_dev < FULL_TOL
+                    ),
+                }
+            )
+    return {
+        "beta": beta,
+        "byte_identical": False,
+        "cells_total": cells,
+        "differing_cells": diffs,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    # Parity mode: CPU + x64 (the Yuma-0 f64 quantization divide), the
+    # same regime the goldens were generated in. config.update, not env:
+    # the env snapshot is stale once sitecustomize has imported jax
+    # (tests/conftest.py documents the same trap).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    per_beta = [classify_beta(beta) for beta in BETAS]
+    bad = [
+        d
+        for p in per_beta
+        for d in p["differing_cells"]
+        if not d["is_sixth_decimal_rounding"]
+    ]
+    artifact = {
+        "artifact": (
+            "byte-level diff of the rendered total_dividends_b{beta}.csv "
+            "artifacts (framework CLI rendering, x64 CPU parity mode) vs "
+            "the reference-rendered goldens"
+        ),
+        "reference_renderer": (
+            "/root/reference/scripts/total_dividends_sheet_generator.py:64 "
+            "via tools/gen_goldens.py"
+        ),
+        "status": "ok" if not bad else "FAILED_cells_outside_rounding_class",
+        "betas": list(BETAS),
+        "cells_per_beta": per_beta[0]["cells_total"],
+        "differing_cells_per_beta": {
+            p["beta"]: len(p["differing_cells"]) for p in per_beta
+        },
+        "out_of_class_cells": len(bad),
+        "per_beta": per_beta,
+        "captured": datetime.date.today().isoformat(),
+        "notes": (
+            "Rendered CSVs are not byte-identical: a minority of cells "
+            "(~10%) sit on a 6th-decimal rounding boundary where the "
+            "framework's <1.5e-6 full-precision deviation flips the last "
+            "rendered digit by one unit. Every differing cell is "
+            "enumerated above and classified; is_sixth_decimal_rounding "
+            "must be true for all (one rendered-unit string delta AND "
+            "full-precision deviation < 1.5e-6). Bit-identical rendering "
+            "would require reproducing torch's f32 reduction orders, "
+            "which the canonical consensus support test deliberately "
+            "does not chase (DESIGN.md 'Precision policy')."
+        ),
+    }
+    text = json.dumps(artifact, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(
+        json.dumps(
+            {
+                k: artifact[k]
+                for k in (
+                    "status",
+                    "differing_cells_per_beta",
+                    "out_of_class_cells",
+                )
+            }
+        )
+    )
+    if bad:
+        sys.exit(f"FAIL: {len(bad)} differing cells outside the rounding class")
+
+
+if __name__ == "__main__":
+    main()

@@ -77,20 +77,32 @@ def liquid_alpha_rate(
             return jnp.quantile(C, q, axis=-1)
         return _masked_quantile(C, q, miner_mask)
 
-    if override_consensus_high is not None:
-        c_high = jnp.asarray(override_consensus_high, dtype)
-    else:
-        c_high = quant(0.75)
-    if override_consensus_low is not None:
-        c_low = jnp.asarray(override_consensus_low, dtype)
-    else:
-        c_low = quant(0.25)
-
     # Degenerate spread: fall back to the 0.99 quantile (yumas.py:132-133).
     # The reference runs this check AFTER substituting the overrides, so
     # it applies even when consensus_high is overridden (an override equal
-    # to the low side still collapses the spread and must fall back).
-    c_high = jnp.where(c_high == c_low, quant(0.99), c_high)
+    # to the low side still collapses the spread and must fall back). The
+    # comparison's operand types mirror the reference per case: with BOTH
+    # sides overridden it compares two raw Python floats (f64) — decided
+    # statically here, so overrides distinct in f64 but equal after f32
+    # rounding do NOT fire the fallback; with at most one override the
+    # comparison involves an f32 quantile tensor and stays traced.
+    if override_consensus_high is not None and override_consensus_low is not None:
+        c_low = jnp.asarray(override_consensus_low, dtype)
+        c_high = (
+            quant(0.99)
+            if override_consensus_high == override_consensus_low
+            else jnp.asarray(override_consensus_high, dtype)
+        )
+    else:
+        if override_consensus_high is not None:
+            c_high = jnp.asarray(override_consensus_high, dtype)
+        else:
+            c_high = quant(0.75)
+        if override_consensus_low is not None:
+            c_low = jnp.asarray(override_consensus_low, dtype)
+        else:
+            c_low = quant(0.25)
+        c_high = jnp.where(c_high == c_low, quant(0.99), c_high)
 
     if isinstance(alpha_high, (int, float)) and isinstance(alpha_low, (int, float)):
         logit_high = _logit(alpha_high)

@@ -156,24 +156,31 @@ def _liquid_rate_on_grid(
     overrides (reference yumas.py:124-133): a set override replaces the
     corresponding quantile selection with a compile-time constant (its
     ranks are simply dropped from the joint bisection). The degenerate
-    fallback to the 0.99 quantile still applies regardless — the
-    reference's `consensus_high == consensus_low` check runs after the
-    overrides are substituted — so the 0.99 ranks are always selected.
-    With an override in play the degenerate test is the float equality
-    of the actual values compared (as the reference and the XLA oracle
-    compute it); the exact integer-order-statistic test applies only
-    when both sides are computed quantiles. Caveat (same class as the
-    documented interpolation-coincidence edge): with exactly ONE
-    override set, this equality compares the override constant against
-    an interpolated quantile whose last-ulp rounding can differ between
-    this kernel and `jnp.quantile` — an override bit-equal to one
-    engine's interpolation but one ulp off the other's would fire the
-    0.99 fallback on one side only. Constructing that requires an
-    override tuned to a specific data-dependent quantile to 2^-24;
-    never observed on real data, and unlike the (fixed) support-sum tie
-    flips there is no order-independent value to canonicalize — the
-    quantile interpolations themselves differ, which the precision
-    policy already documents.
+    fallback to the 0.99 quantile still applies — the reference's
+    `consensus_high == consensus_low` check runs after the overrides are
+    substituted — and its comparison mirrors the reference's operand
+    types per case:
+
+    - BOTH overridden: the reference compares two raw Python floats
+      (f64), so the test is decided STATICALLY here (`override_high ==
+      override_low` at trace time). Overrides distinct in f64 but equal
+      after f32 rounding therefore do NOT fire the fallback, exactly as
+      in the reference; and in the common non-degenerate case the whole
+      counting bisection is skipped (no ranks needed at all).
+    - exactly ONE overridden: the reference compares the override float
+      against an f32 quantile tensor (an f32 comparison), reproduced as
+      a traced f32 equality. Caveat (same class as the documented
+      interpolation-coincidence edge): the computed side's last-ulp
+      interpolation rounding can differ between this kernel and
+      `jnp.quantile`, so an override bit-equal to one engine's
+      interpolation but one ulp off the other's would fire the fallback
+      on one side only. Constructing that requires tuning an override
+      to a data-dependent quantile to 2^-24; never observed on real
+      data, and there is no order-independent value to canonicalize —
+      the interpolations themselves differ (precision policy).
+    - NEITHER overridden: the exact integer-order-statistic test
+      (degenerate iff the 0.25-floor and 0.75-ceil ranks select the
+      same grid value), as documented above.
     """
     dtype = C.dtype
     Mp = C.shape[-1]
@@ -181,13 +188,21 @@ def _liquid_rate_on_grid(
     real = col < n
     C_int = jnp.round(C * 65535.0).astype(jnp.int32)  # [..., 1, Mp]
 
+    # Which degenerate-test regime applies is static (see docstring).
+    both_static = override_high is not None and override_low is not None
+    static_degenerate = both_static and override_high == override_low
+
     # Ranks (0-indexed order statistics) needed by the computed
-    # quantiles (overridden ones need no selection).
-    quantiles = [0.99]
+    # quantiles. Overridden quantiles need no selection; with both
+    # overridden the fallback is decided statically, so 0.99 is needed
+    # only when it actually fires (or may fire at runtime).
+    quantiles = []
     if override_high is None:
         quantiles.append(0.75)
     if override_low is None:
         quantiles.append(0.25)
+    if not both_static or static_degenerate:
+        quantiles.append(0.99)
     pos: dict[float, tuple[int, int, float]] = {}
     ks: list[int] = []
     for q in quantiles:
@@ -198,30 +213,33 @@ def _liquid_rate_on_grid(
             if k not in ks:
                 ks.append(k)
     K = len(ks)
-    # Built from an iota + static scalars (a materialized constant array
-    # would be a captured const, which Pallas kernels reject).
-    iota_k = lax.broadcasted_iota(jnp.int32, (K, 1), 0)
-    thresh = jnp.zeros((K, 1), jnp.int32)
-    for i, k in enumerate(ks):
-        thresh = jnp.where(iota_k == i, k + 1, thresh)
-    batch = C.shape[:-2]
+    if K:
+        # Built from an iota + static scalars (a materialized constant
+        # array would be a captured const, which Pallas kernels reject).
+        iota_k = lax.broadcasted_iota(jnp.int32, (K, 1), 0)
+        thresh = jnp.zeros((K, 1), jnp.int32)
+        for i, k in enumerate(ks):
+            thresh = jnp.where(iota_k == i, k + 1, thresh)
+        batch = C.shape[:-2]
 
-    def body(_, carry):
-        lo, hi = carry  # [..., K, 1]
-        mid = (lo + hi) // 2
-        # [..., 1, Mp] vs [..., K, 1] -> one [..., K, Mp] count per
-        # halving covering every rank at once.
-        cnt = jnp.sum(
-            jnp.where(real & (C_int <= mid), 1, 0), axis=-1, keepdims=True
-        )
-        ok = cnt >= thresh
-        return jnp.where(ok, lo, mid + 1), jnp.where(ok, mid, hi)
+        def body(_, carry):
+            lo, hi = carry  # [..., K, 1]
+            mid = (lo + hi) // 2
+            # [..., 1, Mp] vs [..., K, 1] -> one [..., K, Mp] count per
+            # halving covering every rank at once.
+            cnt = jnp.sum(
+                jnp.where(real & (C_int <= mid), 1, 0),
+                axis=-1,
+                keepdims=True,
+            )
+            ok = cnt >= thresh
+            return jnp.where(ok, lo, mid + 1), jnp.where(ok, mid, hi)
 
-    lo0 = jnp.zeros(batch + (K, 1), jnp.int32)
-    hi0 = jnp.full(batch + (K, 1), 65535, jnp.int32)
-    _, sel = lax.fori_loop(0, 16, body, (lo0, hi0), unroll=True)
-    # Same division that built C, so the values are bitwise C's.
-    stats = sel.astype(dtype) / 65535.0  # [..., K, 1]
+        lo0 = jnp.zeros(batch + (K, 1), jnp.int32)
+        hi0 = jnp.full(batch + (K, 1), 65535, jnp.int32)
+        _, sel = lax.fori_loop(0, 16, body, (lo0, hi0), unroll=True)
+        # Same division that built C, so the values are bitwise C's.
+        stats = sel.astype(dtype) / 65535.0  # [..., K, 1]
 
     def stat_i(k: int):
         return lax.index_in_dim(sel, ks.index(k), axis=-2, keepdims=True)
@@ -236,25 +254,35 @@ def _liquid_rate_on_grid(
             return v_lo
         return v_lo * (1.0 - frac) + stat(hi_i) * frac
 
-    c_high0 = (
-        quant(0.75)
-        if override_high is None
-        else jnp.asarray(override_high, dtype)
-    )
-    c_low = (
-        quant(0.25)
-        if override_low is None
-        else jnp.asarray(override_low, dtype)
-    )
-    # Degenerate spread -> 0.99-quantile fallback (runs even when
-    # overridden, reference yumas.py:132-133): tested on the exact
-    # integer grid when both quantiles are computed (see docstring),
-    # on the compared float values when an override is in play.
-    if override_high is None and override_low is None:
-        degenerate = stat_i(pos[0.75][1]) == stat_i(pos[0.25][0])
+    if both_static:
+        # Reference compares the two raw Python floats (f64); decided at
+        # trace time, and the non-degenerate case runs no bisection.
+        c_low = jnp.asarray(override_low, dtype)
+        c_high = (
+            quant(0.99)
+            if static_degenerate
+            else jnp.asarray(override_high, dtype)
+        )
     else:
-        degenerate = c_high0 == c_low
-    c_high = jnp.where(degenerate, quant(0.99), c_high0)
+        c_high0 = (
+            quant(0.75)
+            if override_high is None
+            else jnp.asarray(override_high, dtype)
+        )
+        c_low = (
+            quant(0.25)
+            if override_low is None
+            else jnp.asarray(override_low, dtype)
+        )
+        # Degenerate spread -> 0.99-quantile fallback (runs even when
+        # one side is overridden, reference yumas.py:132-133): exact
+        # integer grid test when both quantiles are computed, f32 value
+        # equality when one is an override (see docstring).
+        if override_high is None and override_low is None:
+            degenerate = stat_i(pos[0.75][1]) == stat_i(pos[0.25][0])
+        else:
+            degenerate = c_high0 == c_low
+        c_high = jnp.where(degenerate, quant(0.99), c_high0)
     a = logit_num / (c_low - c_high)
     b = logit_low + a * c_low
     sig = 1.0 / (1.0 + jnp.asarray(math.e, dtype) ** (-a * C + b))
