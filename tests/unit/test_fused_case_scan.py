@@ -65,11 +65,6 @@ ALL_VERSIONS = [
     "version,params", ALL_VERSIONS, ids=[v for v, _ in ALL_VERSIONS]
 )
 def test_fused_case_scan_matches_xla_scan(version, params):
-    if version == "Yuma 0 (subtensor)" and jax.config.jax_enable_x64:
-        pytest.skip(
-            "EMA_RUST fused requires f32 mode; the f32 golden subprocess "
-            "twin covers it"
-        )
     W, S = _workload()
     ri = jnp.asarray(2, jnp.int32)
     re = jnp.asarray(4, jnp.int32)
@@ -153,11 +148,10 @@ def _golden_surface_worst(beta, versions):
 
 
 def _x64_safe_versions():
-    return [
-        (v, p)
-        for v, p in canonical_versions()
-        if not (v == "Yuma 0 (subtensor)" and jax.config.jax_enable_x64)
-    ]
+    # Since the double-single f64-quantize emulation (r4), every version
+    # — Yuma 0 under x64 included — runs fused; kept as a named hook for
+    # the golden-surface tests' history.
+    return list(canonical_versions())
 
 
 def test_fused_case_scan_golden_surface_beta1():
@@ -176,8 +170,9 @@ def test_fused_case_scan_golden_surface_other_betas(beta):
 
 
 def test_fused_case_scan_yuma0_golden_in_f32_subprocess():
-    """Yuma 0's fused case scan can only run in f32 mode (the x64 harness
-    skips it above); pin it against both the XLA engine and the golden
+    """Yuma 0's fused case scan in plain f32 mode (the x64 harness above
+    runs the double-single emulation instead); pin it against both the
+    XLA engine and the golden
     CSV rows in a subprocess with x64 off."""
     import subprocess
     import sys
@@ -496,8 +491,6 @@ def test_fused_case_scan_fuzz_vs_xla(seed, E, V, M, version, liquid):
     fused_case_scan) against the XLA engine: sparse weights (zero rows
     and zero columns included), duplicate values, reset metadata — the
     structures the golden cases don't randomize over."""
-    if version == "Yuma 0 (subtensor)" and jax.config.jax_enable_x64:
-        pytest.skip("EMA_RUST fused requires f32 mode")
     rng = np.random.default_rng(seed)
     W = rng.random((E, V, M)).astype(np.float32)
     W[W < 0.3] = 0.0  # sparse, with whole-zero rows/columns likely

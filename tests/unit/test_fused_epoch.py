@@ -36,12 +36,9 @@ def _case(rng, V, M):
 def test_fused_epoch_matches_yuma_epoch(shape, mode, first):
     import jax
 
-    if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
-        # Under the x64 parity harness the fused kernel refuses Yuma-0
-        # (float64 quantization divide); covered by
-        # test_fused_rejects_yuma0_under_x64. The f32-mode subprocess
-        # golden test exercises the EMA_RUST fused path.
-        pytest.skip("EMA_RUST fused requires f32 mode")
+    # EMA_RUST under the x64 parity harness exercises the double-single
+    # emulation of the f64 quantization divide (_rust64_quantize) against
+    # the XLA engine's real f64 divide.
     V, M = shape
     rng = np.random.default_rng(V * M + first)
     W, S_n, B0 = _case(rng, V, M)
@@ -168,10 +165,6 @@ def test_simulate_scaled_fused_scan_liquid_overrides_match_xla():
 def test_simulate_scaled_fused_scan_matches_per_epoch_fused(version):
     """The single-Pallas-program scan (bond state in VMEM scratch across
     grid steps) reproduces the lax.scan-over-fused-epoch path."""
-    import jax
-
-    if version == "Yuma 0 (subtensor)" and jax.config.jax_enable_x64:
-        pytest.skip("EMA_RUST fused requires f32 mode")
     V, M, E = 8, 16, 12
     rng = np.random.default_rng(7)
     W = jnp.asarray(rng.random((V, M)), jnp.float32)
@@ -307,21 +300,55 @@ def test_simulate_scaled_ones_matches_simulate_constant():
     np.testing.assert_array_equal(np.asarray(b_const), np.asarray(b_scaled))
 
 
-def test_fused_rejects_yuma0_under_x64():
+def test_rust64_quantize_tracks_f64_oracle_at_large_K():
+    """The double-single emulation of Yuma-0's f64 quantization divide
+    (`_rust64_quantize`) against a true-f64 oracle, at column sums far
+    beyond the golden surface's (K ~ 2^28 vs the goldens' <= 2^18 —
+    where the documented ~1e-7 near-boundary risk window is tightest).
+    82k random dyadic-grid cells, zero grid flips expected (seeded)."""
+    from yuma_simulation_tpu.ops.pallas_epoch import _rust64_quantize
+
+    mismatches = 0
+    for seed in range(20):
+        rng = np.random.default_rng(seed)
+        M = 4096
+        k = rng.integers(1, 2**17 + 1, size=M)
+        c = (k.astype(np.float64) * 2.0**-17).astype(np.float32)
+        c64 = c.astype(np.float64)
+        q64 = np.floor(c64 / c64.sum() * 65535.0).astype(np.int64)
+        ds = np.asarray(
+            _rust64_quantize(
+                jnp.asarray(c[None], jnp.float32), jnp.float32, 17
+            )
+        )[0]
+        qds = np.round(ds * 65535.0).astype(np.int64)
+        mismatches += int((q64 != qds).sum())
+    assert mismatches == 0
+
+
+def test_fused_yuma0_under_x64_matches_f64_engine():
     # The x64 parity harness (tests/conftest.py) is active here; Yuma-0's
-    # float64 quantization divide cannot run inside a f32 Pallas kernel,
-    # so the fused path must refuse rather than silently diverge.
+    # float64 quantization divide runs in the fused kernels as the
+    # double-single f32 emulation (_rust64_quantize) and must track the
+    # XLA engine's real f64 divide.
     import jax
 
     assert jax.config.jax_enable_x64
-    V, M, E = 4, 8, 3
+    V, M, E = 16, 64, 10
     rng = np.random.default_rng(5)
     W = jnp.asarray(rng.random((V, M)), jnp.float32)
     S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
-    with pytest.raises(ValueError, match="float64 quantization"):
-        simulate_scaled(
-            W, S, jnp.ones(E, jnp.float32), YumaConfig(),
-            variant_for_version("Yuma 0 (subtensor)"), epoch_impl="fused",
+    scales = jnp.asarray(1.0 + 1e-4 * rng.random(E), jnp.float32)
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 0 (subtensor)")
+    t_xla, b_xla = simulate_scaled(W, S, scales, cfg, spec, epoch_impl="xla")
+    for impl in ("fused", "fused_scan"):
+        t_f, b_f = simulate_scaled(W, S, scales, cfg, spec, epoch_impl=impl)
+        np.testing.assert_allclose(
+            np.asarray(t_f), np.asarray(t_xla), rtol=2e-5, err_msg=impl
+        )
+        np.testing.assert_allclose(
+            np.asarray(b_f), np.asarray(b_xla), atol=2e-6, err_msg=impl
         )
 
 

@@ -27,14 +27,6 @@ _VERSIONS = canonical_versions()
 @pytest.mark.parametrize("version_params", _VERSIONS, ids=[v for v, _ in _VERSIONS])
 def test_dividend_trajectory_parity(short, version_params, epoch_impl):
     version, params = version_params
-    if epoch_impl == "fused_scan":
-        import jax
-
-        if version == "Yuma 0 (subtensor)" and jax.config.jax_enable_x64:
-            pytest.skip(
-                "EMA_RUST fused requires f32 mode; the f32 subprocess twin "
-                "covers Yuma 0"
-            )
     case = create_case(short)
     cfg = YumaConfig(
         simulation=SimulationHyperparameters(bond_penalty=0.99),

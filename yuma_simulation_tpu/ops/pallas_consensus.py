@@ -65,6 +65,7 @@ def _consensus_kernel(kappa_ref, s_ref, w_ref, c_ref, *, iters: int):
             jnp.where(W > c_mid, S_int, jnp.zeros((), jnp.int32)),
             axis=0,
             keepdims=True,
+            dtype=jnp.int32,  # x64 would promote to i64 (no Mosaic)
         )  # [1, TILE_M]
         above = support_rounded(support, W.dtype) > kappa
         return jnp.where(above, c_mid, c_lo), jnp.where(above, c_hi, c_mid)

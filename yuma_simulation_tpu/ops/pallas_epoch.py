@@ -12,7 +12,8 @@ entire epoch pipeline —
 (bond update = blended/column-normalized EMA for the Yuma 0/1/2 family;
 :func:`fused_ema_scan` additionally covers the Yuma 3 capacity-purchase
 and Yuma 4 relative-bond models plus liquid alpha, so every named
-version has a fused scan path — Yuma 0 only outside x64 parity mode)
+version has a fused scan path, including Yuma 0 in x64 parity mode via
+the double-single quantization emulation, `_rust64_quantize`)
 
 — as ONE Pallas program with W, B, and every intermediate resident in
 VMEM, and (optionally) the two stake contractions (bisection support,
@@ -56,12 +57,14 @@ dividend-normalization epsilon (yumas.py:262).
 Liquid alpha (per-miner EMA rates from consensus quantiles) is fused in
 the scan kernel: the quantiles are order statistics on the u16 grid,
 selected by an integer counting-bisection (no sort needed — see
-`_liquid_rate_on_grid`); only the static quantile *overrides* stay
-XLA-only. The per-epoch `fused_ema_epoch` remains liquid-free. Likewise
-the x64 parity mode's Yuma-0 float64 quantization divide (reference
-yumas.py:81,97): Pallas TPU kernels are f32-only, so the EMA_RUST mode
-raises under `jax_enable_x64` rather than silently diverging from the
-XLA path's f64 grid. Padded miner columns (from heterogeneous-case
+`_liquid_rate_on_grid`), with the static quantile *overrides* embedded
+as compile-time constants. The per-epoch `fused_ema_epoch` remains
+liquid-free. The x64 parity mode's Yuma-0 float64 quantization divide
+(reference yumas.py:81,97) is emulated in double-single f32
+(`_rust64_quantize`) — Pallas TPU kernels are f32-only, but the
+divide's operands are exactly representable as int32 + two-f32 pairs,
+so the fused paths track the XLA engine's f64 grid to ~2^-24 grid
+units. Padded miner columns (from heterogeneous-case
 batching) are handled by passing the true miner count `m_real`; padded
 columns are excluded from the quantization sum and produce zero
 bonds/incentive.
@@ -108,6 +111,71 @@ def _support(S_col, mask, mxu: bool):
             preferred_element_type=jnp.float32,
         )
     return jnp.sum(mask * S_col, axis=-2, keepdims=True)
+
+
+def _ds_split(a):
+    """Dekker split of an f32 into two 12-bit halves (hi + lo == a
+    exactly). Relies on correctly-rounded f32 multiply/add, which the
+    VPU provides; XLA does not reassociate float ops, so the algebra
+    survives compilation."""
+    c = a * 4097.0  # 2^12 + 1
+    hi = c - (c - a)
+    return hi, a - hi
+
+
+def _ds_two_prod(a, b):
+    """Exact f32 product as a (head, tail) pair: head + tail == a * b."""
+    p = a * b
+    ah, al = _ds_split(a)
+    bh, bl = _ds_split(b)
+    err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, err
+
+
+def _rust64_quantize(c_hi, dtype, iters: int):
+    """Yuma-0's float64 quantization divide
+    `int(C / C.sum() * 65535) / 65535` (reference yumas.py:81,97)
+    emulated in double-single f32 — the piece that kept the fused scan
+    off-limits in x64 parity mode (Pallas TPU is f32-only).
+
+    Exactness structure: every bisection output is a dyadic grid point
+    `k * 2^-iters` (k integer <= 2^iters; iters = ceil(log2(
+    consensus_precision)), 17 at the default precision), so the column
+    sum is `K * 2^-iters` with `K = sum(k)` computed EXACTLY in int32
+    (callers guard `M * 2^iters < 2^31`), and the f64 divide's
+    operand values are represented here without loss (`K` as a two-f32
+    head/tail pair). The quotient-and-scale `(k / K) * 65535` is then
+    computed to ~2^-24 absolute accuracy in grid units via one Newton
+    residual step (Dekker products, no FMA needed) — vs f64's ~1e-11.
+    The two agree except when the exact rational `k * 65535 / K` lies
+    within ~1e-7 of a truncation boundary; boundaries are spaced
+    `1/K >= 2^-29` apart, so disagreement needs K >~ 2^23 AND a
+    near-boundary cell. On the golden surface (M = 2, K <= 2^18,
+    boundaries >= 4e-6 apart — and measured: zero f32-vs-f64 flips over
+    all 1120 cells) agreement is certain; the residual risk class is
+    documented in DESIGN.md "Precision policy".
+    """
+    k = jnp.round(c_hi * float(2**iters))  # exact dyadic ints <= 2^iters
+    K_int = jnp.sum(  # dtype pinned: x64 would promote i32 sums to i64,
+        # which Mosaic cannot lower
+        k.astype(jnp.int32), axis=-1, keepdims=True, dtype=jnp.int32
+    )
+    y_hi = K_int.astype(dtype)  # 24-bit head of K
+    y_lo = (K_int - y_hi.astype(jnp.int32)).astype(dtype)  # exact tail
+    # q1 + q2 ~= k / K (double-single): one coarse quotient plus the
+    # exactly-computed residual re-divided.
+    q1 = k / y_hi
+    p, e = _ds_two_prod(q1, y_hi)
+    pl, el = _ds_two_prod(q1, y_lo)
+    r = ((k - p) - e) - pl - el  # k - q1 * K, exact to f32 rounding
+    q2 = r / y_hi
+    # (q1 + q2) * 65535, head exact via Dekker.
+    p1, e1 = _ds_two_prod(q1, jnp.asarray(65535.0, dtype))
+    p2 = q2 * 65535.0 + e1
+    t = jnp.floor(p1)
+    d = (p1 - t) + p2  # fractional part in DS; may be slightly <0 or >=1
+    n = t + jnp.floor(d)
+    return n.astype(dtype) / 65535.0
 
 
 def _liquid_rate_on_grid(
@@ -231,6 +299,7 @@ def _liquid_rate_on_grid(
                 jnp.where(real & (C_int <= mid), 1, 0),
                 axis=-1,
                 keepdims=True,
+                dtype=jnp.int32,  # x64 would promote to i64 (no Mosaic)
             )
             ok = cnt >= thresh
             return jnp.where(ok, lo, mid + 1), jnp.where(ok, mid, hi)
@@ -309,6 +378,7 @@ def _epoch_math(
     liquid: bool = False,
     liquid_scal=None,  # (logit_low, logit_num, alpha_low, alpha_high)
     liquid_overrides=(None, None),  # static (override_high, override_low)
+    rust64: bool = False,  # static: emulate Yuma-0's f64 quantize divide
 ):
     """The one shared epoch pipeline all fused kernels trace:
     row-normalize -> bisection -> u16 quantize -> clip -> incentive ->
@@ -367,6 +437,7 @@ def _epoch_math(
                 jnp.where(W_n > c_mid, S_int, jnp.zeros((), jnp.int32)),
                 axis=-2,
                 keepdims=True,
+                dtype=jnp.int32,  # x64 would promote to i64 (no Mosaic)
             )
             above = _support_rounded(support, W.dtype) > kappa
         return jnp.where(above, c_mid, c_lo), jnp.where(above, c_hi, c_mid)
@@ -379,8 +450,11 @@ def _epoch_math(
     if m_real != Mp:
         col = lax.broadcasted_iota(jnp.int32, (1, Mp), 1)
         c_hi = jnp.where(col < m_real, c_hi, jnp.zeros_like(c_hi))
-    C = c_hi / jnp.sum(c_hi, axis=-1, keepdims=True) * 65535.0
-    C = C.astype(jnp.int32).astype(W.dtype) / 65535.0
+    if rust64:
+        C = _rust64_quantize(c_hi, W.dtype, iters)
+    else:
+        C = c_hi / jnp.sum(c_hi, axis=-1, keepdims=True) * 65535.0
+        C = C.astype(jnp.int32).astype(W.dtype) / 65535.0
 
     if clip_prev is not None:
         # Only the EMA_PREV callers pass this (both kernels guard it).
@@ -473,6 +547,7 @@ def _fused_ema_epoch_kernel(
     mxu: bool,
     m_real: int,
     has_clip_base: bool,
+    rust64: bool = False,
 ):
     """scal = [w_scale, kappa, beta, alpha, first]. `rest` is
     `([clip_ref,] b_ref, bout_ref, d_ref, inc_ref)` — the clip-base
@@ -496,6 +571,7 @@ def _fused_ema_epoch_kernel(
         mode=mode,
         mxu=mxu,
         m_real=m_real,
+        rust64=rust64,
     )
     bout_ref[:] = B_ema
     d_ref[:] = D_n
@@ -521,17 +597,25 @@ def _scan_resident_bytes(shape, mode: BondsMode) -> int:
 
 def fused_scan_eligible(shape, mode: BondsMode, config, dtype=None) -> bool:
     """Whether :func:`fused_ema_scan` can run this workload — the
-    `epoch_impl="auto"` predicate: float32 arrays, not Yuma-0-under-x64,
-    within the VMEM budget, and on a real TPU (interpret mode would be
-    slower than XLA, not faster). All five bond models, liquid alpha and
-    its consensus-quantile overrides are supported in-kernel."""
+    `epoch_impl="auto"` predicate: float32 arrays, within the VMEM
+    budget, and on a real TPU (interpret mode would be slower than XLA,
+    not faster). All five bond models are supported in-kernel — liquid
+    alpha, its consensus-quantile overrides, and Yuma-0's x64 f64
+    quantization divide (double-single emulation) included."""
     if mode not in _SCAN_MODES:
         return False
     if dtype is not None and jnp.dtype(dtype) != jnp.float32:
         # Pallas TPU kernels here are f32-only (module docstring); an
         # f64 input must fall back to XLA, not crash in Mosaic.
         return False
-    if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
+    if (
+        mode is BondsMode.EMA_RUST
+        and jax.config.jax_enable_x64
+        and (shape[-1] << math.ceil(math.log2(config.consensus_precision)))
+        >= 2**31
+    ):
+        # The f64-quantize emulation's exact int32 column sum overflows;
+        # only the XLA f64 path is faithful there.
         return False
     if jax.default_backend() != "tpu":
         return False
@@ -555,6 +639,7 @@ def _fused_ema_scan_kernel(
     num_epochs: int,
     liquid: bool,
     liquid_overrides: tuple = (None, None),
+    rust64: bool = False,
 ):
     """One grid step = one epoch; the bond state lives in VMEM scratch for
     the WHOLE scan, so the per-epoch HBM traffic of the lax.scan carry
@@ -592,6 +677,7 @@ def _fused_ema_scan_kernel(
         liquid=liquid,
         liquid_scal=(scal_ref[5], scal_ref[6], scal_ref[7], scal_ref[8]),
         liquid_overrides=liquid_overrides,
+        rust64=rust64,
     )
 
     b_scr[:] = B_ema
@@ -665,11 +751,13 @@ def fused_ema_scan(
     """
     if mode not in _SCAN_MODES:
         raise ValueError(f"fused scan does not implement bonds mode {mode}")
-    if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
-        raise ValueError(
-            "the fused kernel cannot reproduce Yuma-0's float64 quantization "
-            "divide (x64 parity mode); use the XLA epoch path"
-        )
+    # In x64 parity mode Yuma-0's f64 quantization divide is emulated
+    # in-kernel with double-single f32 (_rust64_quantize); the flag is
+    # static so f32 mode pays nothing. The emulation's exact integer
+    # column sum needs M * 2^iters to fit int32 (default precision:
+    # M < 2^14 miners) — beyond that the XLA f64 path is the only
+    # faithful engine.
+    rust64 = mode is BondsMode.EMA_RUST and bool(jax.config.jax_enable_x64)
     if W.ndim == 3:
         if mxu:
             raise ValueError(
@@ -688,6 +776,12 @@ def fused_ema_scan(
         raise ValueError("fused scan requires at least one epoch")
     dtype = W.dtype
     iters = int(math.ceil(math.log2(precision)))
+    if rust64 and (M << iters) >= 2**31:
+        raise ValueError(
+            "the double-single f64-quantize emulation needs M * 2^iters "
+            f"< 2^31 for its exact int32 column sum (M={M}, "
+            f"precision={precision}); use the XLA epoch path"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -761,6 +855,7 @@ def fused_ema_scan(
                 override_consensus_high,
                 override_consensus_low,
             ),
+            rust64=rust64,
         ),
         grid=(E,),
         in_specs=[
@@ -807,15 +902,23 @@ def fused_case_scan_eligible(
 ) -> bool:
     """Whether :func:`fused_case_scan` can run this workload — the
     `epoch_impl="auto"` predicate of :func:`..simulation.engine.simulate`:
-    float32 arrays, not Yuma-0-under-x64, within the VMEM budget, and on
-    a real TPU (interpret mode would be slower than XLA, not faster).
-    `shape` is `[E, V, M]` or `[V, M]`; liquid alpha and its
-    consensus-quantile overrides are supported in-kernel."""
+    float32 arrays, within the VMEM budget, and on a real TPU (interpret
+    mode would be slower than XLA, not faster). `shape` is `[E, V, M]`
+    or `[V, M]`; liquid alpha, its consensus-quantile overrides, and
+    Yuma-0's x64 f64 quantization divide (double-single emulation) are
+    all supported in-kernel."""
     if mode not in _SCAN_MODES:
         return False
     if dtype is not None and jnp.dtype(dtype) != jnp.float32:
         return False
-    if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
+    if (
+        mode is BondsMode.EMA_RUST
+        and jax.config.jax_enable_x64
+        and (shape[-1] << math.ceil(math.log2(config.consensus_precision)))
+        >= 2**31
+    ):
+        # The f64-quantize emulation's exact int32 column sum overflows;
+        # only the XLA f64 path is faithful there.
         return False
     if jax.default_backend() != "tpu":
         return False
@@ -841,6 +944,7 @@ def _fused_case_scan_kernel(
     save_incentives: bool,
     save_consensus: bool,
     liquid_overrides: tuple = (None, None),
+    rust64: bool = False,
 ):
     """One grid step = one epoch of the reference's REAL workload: this
     epoch's weight block `[1, Vp, Mp]` and stake block `[1, Vp, 1]` are
@@ -912,6 +1016,7 @@ def _fused_case_scan_kernel(
         liquid=liquid,
         liquid_scal=(scal_ref[5], scal_ref[6], scal_ref[7], scal_ref[8]),
         liquid_overrides=liquid_overrides,
+        rust64=rust64,
     )
 
     b_scr[...] = B_next
@@ -996,11 +1101,13 @@ def fused_case_scan(
         reset_mode = ResetMode.NONE
     if mode not in _SCAN_MODES:
         raise ValueError(f"fused scan does not implement bonds mode {mode}")
-    if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
-        raise ValueError(
-            "the fused kernel cannot reproduce Yuma-0's float64 quantization "
-            "divide (x64 parity mode); use the XLA epoch path"
-        )
+    # In x64 parity mode Yuma-0's f64 quantization divide is emulated
+    # in-kernel with double-single f32 (_rust64_quantize); the flag is
+    # static so f32 mode pays nothing. The emulation's exact integer
+    # column sum needs M * 2^iters to fit int32 (default precision:
+    # M < 2^14 miners) — beyond that the XLA f64 path is the only
+    # faithful engine.
+    rust64 = mode is BondsMode.EMA_RUST and bool(jax.config.jax_enable_x64)
     E, V, M = W.shape
     if E < 1:
         raise ValueError("fused scan requires at least one epoch")
@@ -1008,6 +1115,12 @@ def fused_case_scan(
         raise ValueError(f"stakes must be [E, V] = {(E, V)}, got {S.shape}")
     dtype = W.dtype
     iters = int(math.ceil(math.log2(precision)))
+    if rust64 and (M << iters) >= 2**31:
+        raise ValueError(
+            "the double-single f64-quantize emulation needs M * 2^iters "
+            f"< 2^31 for its exact int32 column sum (M={M}, "
+            f"precision={precision}); use the XLA epoch path"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -1103,6 +1216,7 @@ def fused_case_scan(
                 override_consensus_high,
                 override_consensus_low,
             ),
+            rust64=rust64,
         ),
         grid=(E,),
         in_specs=[
@@ -1187,14 +1301,22 @@ def fused_ema_epoch(
         # The XLA reference kernel (yuma_epoch) ignores W_prev for the
         # other modes; silently honoring it here would diverge from it.
         raise ValueError("clip_base is only meaningful for EMA_PREV")
-    if mode is BondsMode.EMA_RUST and jax.config.jax_enable_x64:
-        raise ValueError(
-            "the fused kernel cannot reproduce Yuma-0's float64 quantization "
-            "divide (x64 parity mode); use the XLA epoch path"
-        )
+    # In x64 parity mode Yuma-0's f64 quantization divide is emulated
+    # in-kernel with double-single f32 (_rust64_quantize); the flag is
+    # static so f32 mode pays nothing. The emulation's exact integer
+    # column sum needs M * 2^iters to fit int32 (default precision:
+    # M < 2^14 miners) — beyond that the XLA f64 path is the only
+    # faithful engine.
+    rust64 = mode is BondsMode.EMA_RUST and bool(jax.config.jax_enable_x64)
     V, M = W.shape
     dtype = W.dtype
     iters = int(math.ceil(math.log2(precision)))
+    if rust64 and (M << iters) >= 2**31:
+        raise ValueError(
+            "the double-single f64-quantize emulation needs M * 2^iters "
+            f"< 2^31 for its exact int32 column sum (M={M}, "
+            f"precision={precision}); use the XLA epoch path"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -1246,6 +1368,7 @@ def fused_ema_epoch(
             mxu=mxu,
             m_real=m_real,
             has_clip_base=has_clip,
+            rust64=rust64,
         ),
         in_specs=in_specs,
         out_specs=[vm((Vp, Mp)), vm((Vp, 1)), vm((1, Mp))],
