@@ -257,15 +257,79 @@ def test_simulate_epoch_impl_routing():
     np.testing.assert_allclose(
         r_fused.dividends, r_xla.dividends, atol=2e-6, rtol=1e-5
     )
-    # The explicit MXU opt-in routes too (in interpret mode its dot is
-    # plain f32, so it stays within rounding of the XLA path; the bf16x3
-    # on-chip bound is pinned by MXU_PARITY.json via tools/tpu_parity.py).
+    # The MXU scan is BITWISE the VPU scan (r4: exact limb-split
+    # support; the contract `auto` relies on — on-chip twin pinned by
+    # CROSS_ENGINE*.json's mxu_vs_vpu_bitwise_mismatch_runs=0 and
+    # MXU_PARITY.json at the shared 1.5e-6 golden bound).
     r_mxu = simulate(case, "Yuma 1 (paper)", cfg, epoch_impl="fused_scan_mxu")
-    np.testing.assert_allclose(
-        r_mxu.dividends, r_xla.dividends, atol=1e-4, rtol=1e-3
-    )
+    np.testing.assert_array_equal(r_mxu.dividends, r_fused.dividends)
+    np.testing.assert_array_equal(r_mxu.bonds, r_fused.bonds)
     with pytest.raises(ValueError, match="epoch_impl"):
         simulate(case, "Yuma 1 (paper)", cfg, epoch_impl="nope")
+
+
+@pytest.mark.parametrize("V", [24, 510, 1024])
+def test_mxu_scan_bitwise_equals_vpu_scan(V):
+    """The r4 exact-MXU contract at both limb regimes (15-bit limbs for
+    V <= 512, 10-bit for V <= 2^14): every output of the MXU case scan
+    must be bit-identical to the VPU case scan. Interpret mode computes
+    the dot in plain f32, which is exact on the limb-split operands for
+    the same reason the bf16 MXU is — this pins the split/recombination
+    logic; the hardware cast is pinned on chip by the artifacts."""
+    rng = np.random.default_rng(V)
+    E, M = 4, 64
+    W = jnp.asarray(rng.random((E, V, M)), jnp.float32)
+    S = jnp.asarray(rng.random((E, V)) + 0.01, jnp.float32)
+    ri = jnp.asarray(-1, jnp.int32)
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 1 (paper)")
+    ys_v = _simulate_case_fused(W, S, ri, ri, cfg, spec, save_consensus=True)
+    ys_m = _simulate_case_fused(
+        W, S, ri, ri, cfg, spec, save_consensus=True, mxu=True
+    )
+    for k in ys_v:
+        np.testing.assert_array_equal(
+            np.asarray(ys_m[k]), np.asarray(ys_v[k]), err_msg=f"V={V}: {k}"
+        )
+
+
+def test_stake_limb_split_recombines_exactly():
+    """_stake_limb_split / _support_limbs_mxu vs an integer oracle at
+    both limb regimes, including the 2^30 == stake-1.0 top-limb bit."""
+    from yuma_simulation_tpu.ops.pallas_epoch import (
+        _stake_limb_split,
+        _support_limbs_mxu,
+    )
+
+    for V in (8, 512, 4096):
+        rng = np.random.default_rng(V)
+        # Canonical normalized stakes: column sum ~= 2^30 (the helpers'
+        # precondition — support_fixed_stakes of S with sum(S) == 1).
+        raw = rng.random(V) + 1e-3
+        S_int = np.round(raw / raw.sum() * 2**30).astype(np.int64)[:, None]
+        if V == 8:
+            # the stake-1.0 edge: one validator holds everything
+            S_int = np.zeros((V, 1), np.int64)
+            S_int[0, 0] = 2**30
+        rows, bits = _stake_limb_split(
+            jnp.asarray(S_int, jnp.int32), V, jnp.float32
+        )
+        # limbs recombine to the stakes exactly
+        n = rows.shape[0] // 2
+        rec = np.zeros(V, np.int64)
+        rows_np = np.asarray(rows, np.float64)
+        for j in range(n):
+            rec = (rec << bits) + (
+                rows_np[2 * j] + rows_np[2 * j + 1]
+            ).astype(np.int64)
+        np.testing.assert_array_equal(rec, S_int[:, 0])
+        # masked support equals the integer oracle
+        mask = (rng.random((V, 64)) > 0.5).astype(np.float32)
+        got = np.asarray(
+            _support_limbs_mxu(rows, bits, jnp.asarray(mask))
+        )[0]
+        oracle = mask.T.astype(np.int64) @ S_int[:, 0]
+        np.testing.assert_array_equal(got.astype(np.int64), oracle)
 
 
 @pytest.mark.parametrize(

@@ -17,7 +17,10 @@ gone at the source. This sweep re-measures that claim on chip after any
 kernel change; `consensus_mismatch_runs` must be 0 in both regimes.
 Residual nonzero deviations in bonds/dividends/incentives are DOWNSTREAM
 f32 arithmetic-order effects on identical consensus (the capacity-bond
-worst is one low-mantissa quantum of its ~2^64-scaled state).
+worst is one low-mantissa quantum of its ~2^64-scaled state). The sweep
+additionally requires the exact-MXU scan (the r4 `auto` default) to be
+BITWISE the VPU scan on every output of every run
+(`mxu_vs_vpu_bitwise_mismatch_runs` must be 0).
 """
 
 import argparse
@@ -74,6 +77,7 @@ def main() -> None:
     worst = {"consensus": 0.0, "bonds": 0.0, "dividends": 0.0, "incentives": 0.0}
     worst_rel = dict(worst)
     consensus_mismatch_runs = 0
+    mxu_bitwise_mismatch_runs = 0
     runs = 0
     for E, V, M in SHAPES:
         for seed in SEEDS:
@@ -95,6 +99,18 @@ def main() -> None:
                 ys_f = _simulate_case_fused(
                     W, S, ri, re, cfg, spec, save_consensus=True
                 )
+                # The exact-MXU scan must be BITWISE the VPU scan on
+                # every output (its limb-split support is the same
+                # canonical integer sum; everything else shares ops).
+                ys_m = _simulate_case_fused(
+                    W, S, ri, re, cfg, spec, save_consensus=True, mxu=True
+                )
+                for k in worst:
+                    if not np.array_equal(
+                        np.asarray(ys_m[k]), np.asarray(ys_f[k])
+                    ):
+                        mxu_bitwise_mismatch_runs += 1
+                        break
                 for k in worst:
                     a = np.asarray(ys_f[k], np.float64)
                     b = np.asarray(ys_x[k], np.float64)
@@ -123,6 +139,7 @@ def main() -> None:
         "versions": [v for v, _ in VERSIONS],
         "runs": runs,
         "consensus_mismatch_runs": consensus_mismatch_runs,
+        "mxu_vs_vpu_bitwise_mismatch_runs": mxu_bitwise_mismatch_runs,
         "worst_abs_deviation": worst,
         "worst_deviation_rel_to_output_scale": worst_rel,
         "captured": datetime.date.today().isoformat(),
@@ -147,16 +164,22 @@ def main() -> None:
     # support_fixed_stakes/support_rounded). The status field is stamped
     # BEFORE the artifact is written so a failing run can never leave a
     # clean-looking JSON on disk, and the exit code fails CI loudly.
-    artifact["status"] = (
-        "ok" if consensus_mismatch_runs == 0 else "FAILED_consensus_mismatch"
-    )
+    failed = []
+    if consensus_mismatch_runs:
+        failed.append("consensus_mismatch")
+    if mxu_bitwise_mismatch_runs:
+        failed.append("mxu_bitwise_mismatch")
+    artifact["status"] = "ok" if not failed else "FAILED_" + "+".join(failed)
     text = json.dumps(artifact, indent=2)
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
     print(text)
-    if consensus_mismatch_runs:
-        sys.exit(f"FAIL: {consensus_mismatch_runs} consensus mismatch runs")
+    if failed:
+        sys.exit(
+            f"FAIL: {consensus_mismatch_runs} consensus mismatch runs, "
+            f"{mxu_bitwise_mismatch_runs} MXU-vs-VPU bitwise mismatch runs"
+        )
 
 
 if __name__ == "__main__":

@@ -120,10 +120,11 @@ def main() -> None:
         "captured": datetime.date.today().isoformat(),
         "notes": (
             "Deviations are vs the reference-generated golden CSVs "
-            "(tests/golden/, 6-decimal totals). The parity-safe paths "
-            "(auto/xla/fused_scan) are expected within ~1.5e-6; "
-            "fused_scan_mxu is the parity-relaxed variant whose artifact "
-            "pins the measured bound of its MXU support-sum rounding."
+            "(tests/golden/, 6-decimal totals). Every path — auto, xla, "
+            "fused_scan AND fused_scan_mxu — shares the 1.5e-6 contract: "
+            "since r4 the MXU scan's consensus support is the exact "
+            "limb-split integer contraction, bitwise identical to the "
+            "VPU scan (the former parity-relaxed tier no longer exists)."
         ),
     }
     text = json.dumps(artifact, indent=2)

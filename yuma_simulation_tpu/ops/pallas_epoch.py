@@ -21,30 +21,32 @@ rank, nothing else reduces over V) on the MXU instead of the VPU. At
 256x4096 with weights varying every epoch (nothing hoistable) and long
 scans (per-dispatch tunnel latency amortized), :func:`fused_ema_scan` —
 the whole scan as a single Pallas program with the bond state never
-leaving VMEM — runs ~38k epochs/s (~26 us/epoch) on the parity-safe VPU
-path (the bench.py headline) and ~75k (~13 us/epoch) on the
-parity-relaxed MXU variant, vs ~17k for the unfused XLA epoch
-(~59 us/epoch) on one v5e chip. The scan is VMEM-bandwidth-bound: the
-17 bisection halvings each traverse the [V, M] weights, so the select
-is fused straight into the stake reduce (`_epoch_math`), and batching
-scenarios only pays at small shapes where a single run is latency-bound
-(DESIGN.md "Utilization", measured bandwidth ceiling ~4.3 TB/s).
+leaving VMEM — runs ~60k epochs/s (~17 us/epoch) with the exact MXU
+support (the bench.py headline; `auto` selects it) and ~37k (~27
+us/epoch) on the all-VPU path, vs ~17k for the unfused XLA epoch
+(~59 us/epoch) on one v5e chip. The VPU scan is VMEM-bandwidth-bound:
+the 17 bisection halvings each traverse the [V, M] weights, so the
+select is fused straight into the stake reduce (`_epoch_math`), and
+batching scenarios only pays at small shapes where a single run is
+latency-bound (DESIGN.md "Utilization", measured bandwidth ceiling
+~4.3 TB/s); the MXU path moves those traversals onto the systolic
+array.
 
-Numerics:
-- `mxu=False` (default): the consensus support test runs on the
-  canonical fixed-point integers shared by every engine
-  (ops/consensus.py::support_fixed_stakes / support_rounded), so
-  consensus agrees BITWISE with the XLA kernels by construction —
-  including knife-edge ties (CROSS_ENGINE.json: 0 mismatch runs). All
-  other reductions stay on the VPU in f32 and match the XLA kernel to
+Numerics (both paths share one parity contract since r4):
+- The consensus support test runs on the canonical fixed-point integers
+  shared by every engine (ops/consensus.py::support_fixed_stakes /
+  support_rounded), so consensus agrees BITWISE with the XLA kernels by
+  construction — including knife-edge ties (CROSS_ENGINE.json: 0
+  mismatch runs).
+- `mxu=False`: the integer support sum is a VPU select-into-reduce.
+- `mxu=True`: the SAME integer sum computed on the MXU via the
+  bf16-term limb split (`_stake_limb_split` / `_support_limbs_mxu` —
+  every operand cast, product and f32 partial sum exact; verified on
+  chip). Rank stays on the VPU, so the whole scan is bitwise the VPU
+  scan (checked on chip at 256x4096 over 512 epochs), ~1.6x faster;
+  requires V <= 2^14 and a single scenario (the dot shapes are 2-D).
+- Everything else stays f32 on the VPU and matches the XLA kernel to
   reduction-order rounding (~1e-9 on bonds at 256x4096).
-- `mxu=True` (bench fast path): support and rank ride the MXU's bf16x3
-  f32 decomposition. Support values can differ from the VPU sum by ~1 ulp,
-  which near `support == kappa` can flip one 2^-17 consensus grid point
-  (observed max bond deviation ~4e-5 at 256x4096; worst total-dividend
-  deviation over the full 14x9x4 golden suite measured ON CHIP at 2.1e-4
-  — pinned in MXU_PARITY.json by tools/tpu_parity.py). Opt-in, for
-  throughput sweeps where the CSV-parity contract is not in play.
 
 Reference semantics reproduced (same as `yuma_epoch`, reference
 yumas.py:61-282): `+1e-6` row-normalization epsilon, strict `>` in the
@@ -97,20 +99,81 @@ def _round_up(x: int, mult: int) -> int:
     return (x + mult - 1) // mult * mult
 
 
-def _support(S_col, mask, mxu: bool):
-    """Stake contraction over validators: `[..., V, 1] x [..., V, T] ->
-    [..., 1, T]`. The MXU variant (bf16x3, default dot precision) is 2-D
-    only (batched callers force the VPU sum, which is also the
-    parity-safe side). A HIGHEST-precision (bf16x6) MXU variant — the
-    XLA engine's own einsum setting, ops/consensus.py:56 — was measured
-    SLOWER than the fused VPU select-into-reduce and rejected (DESIGN.md
-    "Utilization")."""
-    if mxu:
-        return jax.lax.dot_general(
-            S_col.T, mask, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+def _support(S_col, mask):
+    """Float stake contraction over validators on the VPU:
+    `[..., V, 1] x [..., V, T] -> [..., 1, T]`. Used for the
+    once-per-epoch rank contraction (every epoch path, MXU mode
+    included — rank has no exact integer form, and keeping it on the
+    VPU preserves the MXU scan's bitwise-VPU contract). An approximate
+    MXU dot and a HIGHEST-precision (bf16x6) variant were both
+    measured and rejected (DESIGN.md "Utilization"; the consensus
+    support rides the MXU exactly via `_support_limbs_mxu` instead)."""
     return jnp.sum(mask * S_col, axis=-2, keepdims=True)
+
+
+def _stake_limb_split(S_int, Vp: int, dtype):
+    """Split the canonical fixed-point stakes `[Vp, 1] int32` into a
+    `[2 * n_limbs, Vp]` float matrix whose single-pass-bf16 MXU
+    contraction against a 0/1 mask is EXACT:
+
+    - the stakes are first cut into small integer limbs, and each limb
+      into its bf16 head + residual — both exactly bf16-representable,
+      so the MXU's operand cast (default dot precision; Mosaic lowers
+      neither HIGH nor HIGHEST here) rounds nothing;
+    - products against a 0/1 mask are exact, and every f32 partial sum
+      is an integer below 2^24 (head-row sums <= Vp * 2^limb_bits,
+      residual-row sums <= Vp * 2^7), so accumulation rounds nothing
+      either — verified on chip at 256x4096.
+
+    15-bit limbs satisfy the sum bound for Vp <= 512; 10-bit limbs
+    extend exactness to Vp <= 2^14. Larger V has no MXU fast path
+    (callers fall back to the VPU reduce).
+    Returns `(rows [2n, Vp], limb_bits)` — per limb, head row then
+    residual row, most-significant limb first.
+    """
+    if Vp <= 512:
+        bits, n = 15, 2
+    elif Vp <= 2**14:
+        bits, n = 10, 3
+    else:
+        raise ValueError(f"no exact MXU stake split for V={Vp}")
+    S_flat = S_int[..., 0]  # [Vp]
+    rows = []
+    for i in reversed(range(n)):  # most-significant limb first
+        limb = (S_flat >> (bits * i)) & ((1 << bits) - 1)
+        if i == n - 1:
+            # Top limb unmasked: it may carry the 2^30 == stake-1.0 bit,
+            # so S_int == sum of limbs exactly.
+            limb = S_flat >> (bits * i)
+        limb_f = limb.astype(dtype)
+        head = limb_f.astype(jnp.bfloat16).astype(dtype)
+        rows += [head, limb_f - head]  # residual is an exact small int
+    return jnp.stack(rows), bits
+
+
+def _support_limbs_mxu(S_rows, limb_bits: int, mask):
+    """EXACT consensus support on the MXU: one `[2n, V] x [V, M]`
+    default-precision contraction of the bf16-term stake rows
+    (:func:`_stake_limb_split`) against the 0/1 mask, recombined in
+    int32. Bitwise-identical to the VPU `where(mask, S_int, 0).sum()`
+    by construction (every operand cast, product and partial sum is
+    exact), so the MXU scan shares the VPU scan's parity contract."""
+    out = jax.lax.dot_general(
+        S_rows, mask, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [2n, M]
+    n = out.shape[0] // 2
+    support = jnp.zeros_like(
+        lax.index_in_dim(out, 0, axis=0, keepdims=True), dtype=jnp.int32
+    )
+    for j in range(n):
+        pair = lax.index_in_dim(out, 2 * j, axis=0, keepdims=True).astype(
+            jnp.int32
+        ) + lax.index_in_dim(out, 2 * j + 1, axis=0, keepdims=True).astype(
+            jnp.int32
+        )
+        support = (support << limb_bits) + pair
+    return support  # [1, M] int32
 
 
 def _ds_split(a):
@@ -418,14 +481,19 @@ def _epoch_math(
     # one it replaces; the int->float convert touches only the
     # [.., 1, Mp] support row.
     S_int = _support_fixed_stakes(S)
+    if mxu:
+        S_limbs, limb_bits = _stake_limb_split(S_int, W.shape[-2], W.dtype)
 
     def body(_, carry):
         c_lo, c_hi = carry
         c_mid = (c_hi + c_lo) * 0.5
         if mxu:
+            # EXACT MXU support: the limb-split canonical stakes against
+            # the strict-> mask, recombined in int32 — bitwise the VPU
+            # branch's decision (see _support_limbs_mxu), at MXU speed.
             mask = (W_n > c_mid).astype(W.dtype)  # strict, as the reference
-            support = _support(S, mask, mxu)
-            above = support > kappa
+            support = _support_limbs_mxu(S_limbs, limb_bits, mask)
+            above = _support_rounded(support, W.dtype) > kappa
         else:
             # One fused traversal (select straight into the reduce): the
             # compare->astype->multiply->reduce chain costs ~3 VMEM passes
@@ -471,7 +539,8 @@ def _epoch_math(
         clip_base = W_n
     W_clipped = jnp.minimum(clip_base, C)
 
-    R = _support(S, W_clipped, mxu)
+    # Rank: once per epoch (vs 17 support halvings), always VPU f32.
+    R = _support(S, W_clipped)
     incentive = jnp.nan_to_num(R / jnp.sum(R, axis=-1, keepdims=True))
 
     # Consensus-dependent per-miner EMA rate (liquid alpha); the CAPACITY
@@ -593,6 +662,14 @@ def _scan_resident_bytes(shape, mode: BondsMode) -> int:
     Bb = shape[0] if len(shape) == 3 else 1
     Vp, Mp = _round_up(V, _SUBLANES), _round_up(M, _LANES)
     return (3 if mode is BondsMode.EMA_PREV else 2) * Bb * Vp * Mp * 4
+
+
+def exact_mxu_support_covers(num_validators: int) -> bool:
+    """Whether the exact limb-split MXU support (`_stake_limb_split`)
+    covers this validator count — the `auto` gate for preferring the
+    MXU scan over the VPU scan. Beyond it the VPU reduce is the only
+    exact form."""
+    return num_validators <= 2**14
 
 
 def fused_scan_eligible(shape, mode: BondsMode, config, dtype=None) -> bool:
@@ -769,6 +846,11 @@ def fused_ema_scan(
     else:
         V, M = W.shape
         lead = ()
+    if mxu and not exact_mxu_support_covers(V):
+        raise ValueError(
+            f"the exact MXU stake split covers V <= 2^14 validators, got "
+            f"V={V}; use the VPU path (mxu=False)"
+        )
     E = scales.shape[0]
     if E < 1:
         # grid=(0,) does not compile, and the output refs would never be
@@ -1109,6 +1191,11 @@ def fused_case_scan(
     # faithful engine.
     rust64 = mode is BondsMode.EMA_RUST and bool(jax.config.jax_enable_x64)
     E, V, M = W.shape
+    if mxu and not exact_mxu_support_covers(V):
+        raise ValueError(
+            f"the exact MXU stake split covers V <= 2^14 validators, got "
+            f"V={V}; use the VPU path (mxu=False)"
+        )
     if E < 1:
         raise ValueError("fused scan requires at least one epoch")
     if S.shape != (E, V):
@@ -1309,6 +1396,11 @@ def fused_ema_epoch(
     # faithful engine.
     rust64 = mode is BondsMode.EMA_RUST and bool(jax.config.jax_enable_x64)
     V, M = W.shape
+    if mxu and not exact_mxu_support_covers(V):
+        raise ValueError(
+            f"the exact MXU stake split covers V <= 2^14 validators, got "
+            f"V={V}; use the VPU path (mxu=False)"
+        )
     dtype = W.dtype
     iters = int(math.ceil(math.log2(precision)))
     if rust64 and (M << iters) >= 2**31:

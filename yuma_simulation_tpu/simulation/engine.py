@@ -296,17 +296,19 @@ def simulate(
       - "auto" (default): run the whole epoch loop as a single Pallas
         program (`fused_case_scan` — per-epoch weights/stakes streamed
         through VMEM, the flagship kernel) when the variant/config/shape
-        allow it on a real TPU, else the XLA `lax.scan`. The fused path
-        matches the XLA path to reduction-order rounding (pinned against
-        the golden CSV surface by tests/unit/test_fused_case_scan.py).
+        allow it on a real TPU, else the XLA `lax.scan`. Prefers the
+        MXU variant (exact limb-split support, bitwise the VPU scan,
+        ~1.6x) wherever it covers V. The fused path matches the XLA
+        path to reduction-order rounding (pinned against the golden CSV
+        surface by tests/unit/test_fused_case_scan.py).
       - "xla": always the `lax.scan` over the unfused epoch kernel.
-      - "fused_scan": require the fused path (raises if ineligible;
-        off-TPU it runs in interpret mode — correct but slow, for tests).
-      - "fused_scan_mxu": the fused path with the two stake contractions
-        on the MXU. ~2x faster, but the bf16x3 support sums can flip
-        one 2^-17 consensus grid point vs the VPU/XLA paths — never
-        selected by "auto"; opt-in for throughput sweeps where the
-        CSV-parity contract is not in play (bound pinned on chip in
+      - "fused_scan": require the fused path with VPU reductions (raises
+        if ineligible; off-TPU it runs in interpret mode — correct but
+        slow, for tests).
+      - "fused_scan_mxu": the fused path with the consensus support on
+        the MXU as the EXACT limb-split integer contraction (r4):
+        bitwise-identical outputs to "fused_scan", ~1.6x faster, V <=
+        2^14 — what "auto" selects on TPU (parity pinned on chip in
         MXU_PARITY.json via tools/tpu_parity.py).
 
     `consensus_impl`: "bisect" (default), "sorted" (bitwise twin — the
@@ -348,18 +350,30 @@ def simulate(
     consensus_auto = consensus_impl == "auto"
 
     if epoch_impl == "auto":
-        from yuma_simulation_tpu.ops.pallas_epoch import fused_case_scan_eligible
+        from yuma_simulation_tpu.ops.pallas_epoch import (
+            exact_mxu_support_covers,
+            fused_case_scan_eligible,
+        )
 
-        epoch_impl = (
-            "fused_scan"
-            if mesh is None
+        if (
+            mesh is None
             and (consensus_auto or consensus_impl == "bisect")
             and weights.shape[0] >= 1
             and fused_case_scan_eligible(
                 weights.shape, spec.bonds_mode, config, dtype, save_bonds
             )
-            else "xla"
-        )
+        ):
+            # Since r4 the MXU scan's consensus support is EXACT (the
+            # limb-split integer contraction, ~1.6x the VPU scan) and the
+            # whole scan is bitwise the VPU scan, so auto prefers it
+            # wherever the limb split covers V.
+            epoch_impl = (
+                "fused_scan_mxu"
+                if exact_mxu_support_covers(weights.shape[-2])
+                else "fused_scan"
+            )
+        else:
+            epoch_impl = "xla"
     if epoch_impl in ("fused_scan", "fused_scan_mxu"):
         if mesh is not None:
             raise ValueError(
@@ -469,27 +483,30 @@ def simulate_scaled(
 
     `epoch_impl`:
       - "auto": pick the fastest *parity-safe* path — the
-        single-Pallas-program VPU scan ("fused_scan") when the
-        variant/config/shape allow it (any bonds model incl. liquid
-        alpha, no quantile overrides, f32 arrays, non-Yuma-0 under x64,
-        fits the VMEM budget, on TPU, >= 1 epoch), otherwise the XLA
-        path. Never selects the MXU
-        variants (their support sums can flip one 2^-17 consensus grid
-        point); opt into "fused_scan_mxu" explicitly for the last ~2x.
+        single-Pallas-program scan when the variant/config/shape allow
+        it (any bonds model incl. liquid alpha, quantile overrides,
+        Yuma-0 under x64, f32 arrays, fits the VMEM budget, on TPU,
+        >= 1 epoch), otherwise the XLA path. Since r4 that means the
+        MXU scan ("fused_scan_mxu") wherever the exact limb-split
+        support covers V (<= 2^14): its consensus support is the exact
+        canonical integer sum on the MXU and the whole scan is BITWISE
+        the VPU scan, ~1.6x faster.
       - "xla": the unfused `yuma_epoch` (any variant/consensus_impl).
       - "fused": the Pallas VMEM-resident EMA-family epoch kernel
         (:func:`yuma_simulation_tpu.ops.pallas_epoch.fused_ema_epoch`),
         VPU reductions (matches XLA to ~1e-9).
-      - "fused_mxu": same kernel with the stake contractions on the MXU
-        (~1.7x faster; support sums can flip one 2^-17 consensus grid
-        point vs the VPU path — see pallas_epoch.py docstring).
+      - "fused_mxu": same per-epoch kernel with the consensus support
+        on the exact limb-split MXU contraction (bitwise the "fused"
+        path since r4; requires V <= 2^14).
       - "fused_scan" / "fused_scan_mxu": the ENTIRE epoch scan as one
         Pallas program — bond state resident in VMEM scratch across grid
         steps, W fetched from HBM once, no per-epoch dispatch
         (:func:`yuma_simulation_tpu.ops.pallas_epoch.fused_ema_scan`).
         Covers all five bond models (capacity/relative included, unlike
-        the per-epoch "fused" paths); same numerics as "fused"/
-        "fused_mxu" for the EMA family.
+        the per-epoch "fused" paths). The two are bitwise-identical
+        (the MXU scan's support is the exact limb-split integer
+        contraction); "fused_scan_mxu" is ~1.6x faster and needs
+        V <= 2^14.
 
     Returns `(total_dividends[V], final_bonds[V, M])` like
     `simulate_constant`.
@@ -507,18 +524,25 @@ def simulate_scaled(
         return _dividends_per_1k(D_n, S, config, dtype)
 
     if epoch_impl == "auto":
-        from yuma_simulation_tpu.ops.pallas_epoch import fused_scan_eligible
-
-        # The VPU scan, not the MXU variant: auto must be correct by
-        # default (the MXU support sums can flip one 2^-17 consensus
-        # grid point — opt into "fused_scan_mxu" explicitly for that
-        # last ~2x). E=0 falls back to XLA, which returns zeros.
-        epoch_impl = (
-            "fused_scan"
-            if scales.shape[0] >= 1
-            and fused_scan_eligible(W.shape, spec.bonds_mode, config, W.dtype)
-            else "xla"
+        from yuma_simulation_tpu.ops.pallas_epoch import (
+            exact_mxu_support_covers,
+            fused_scan_eligible,
         )
+
+        # Since r4 the MXU scan's consensus support is EXACT (limb-split
+        # integer contraction) and the whole scan is bitwise the VPU
+        # scan, so auto prefers it wherever the limb split covers V.
+        # E=0 falls back to XLA, which returns zeros.
+        if scales.shape[0] >= 1 and fused_scan_eligible(
+            W.shape, spec.bonds_mode, config, W.dtype
+        ):
+            epoch_impl = (
+                "fused_scan_mxu"
+                if exact_mxu_support_covers(V)
+                else "fused_scan"
+            )
+        else:
+            epoch_impl = "xla"
 
     if epoch_impl in ("fused_scan", "fused_scan_mxu"):
         from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
