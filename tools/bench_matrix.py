@@ -202,6 +202,50 @@ def bench_hyperparam_grid():
     )
 
 
+def bench_hyperparam_grid_fused(V=64, M=1024, epochs=2048):
+    """The r3-verdict item-5 configuration: a hyperparameter grid through
+    the FUSED batched scan as ONE dispatch — per-scenario [B]
+    kappa/bond_penalty/bond_alpha vectors ride a VMEM operand
+    (`fused_ema_scan` per_scenario_hp), vs the vmap'd XLA engine. The
+    16-scenario batch at 64x1024 stays inside the VMEM residency budget
+    (the 256x4096 stress shape fits only ~4 resident scenarios) and is
+    the latency-bound regime where batching pays (DESIGN.md
+    "Utilization")."""
+    from yuma_simulation_tpu.simulation.sweep import sweep_scaled_fused
+
+    configs, points = config_grid(
+        bond_alpha=[0.05, 0.2],
+        kappa=[0.4, 0.5],
+        bond_penalty=[0.0, 0.5, 0.99, 1.0],
+    )
+    B = len(points)
+    rng = np.random.default_rng(17)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    scales = jnp.asarray(
+        1.0 + 1e-7 * np.arange(1 << 16, dtype=np.float32), jnp.float32
+    )
+
+    for impl in ("fused_scan", "xla") if jax.default_backend() == "tpu" else ("xla",):
+        def run(n):
+            _fetch(
+                sweep_scaled_fused(
+                    W, S, scales[:n], configs, "Yuma 1 (paper)",
+                    epoch_impl=impl,
+                )[0]
+            )
+
+        rate, meta = _bench(run, epochs, "epochs_timed", max_n=1 << 16)
+        meta["grid_points"] = B
+        _line(
+            f"{B}-point bond_alpha x kappa x beta grid, {V}v x {M}m "
+            f"varying weights, ONE dispatch ({impl})",
+            rate * B,
+            "scenario-epochs/s",
+            meta,
+        )
+
+
 def bench_montecarlo(num_scenarios=256, epochs=100, V=64, M=1024):
     mesh = make_mesh()
     keys = iter(range(1, 1 << 20))
@@ -274,6 +318,7 @@ def main():
         bench_batched_varying()
     bench_correctness_matrix()
     bench_hyperparam_grid()
+    bench_hyperparam_grid_fused()
     bench_batched_throughput()
     bench_montecarlo()
 

@@ -680,11 +680,17 @@ def simulate_scaled_batch(
     VPU reductions; the MXU variant is single-scenario only). "auto"
     picks "fused_scan" when eligible on this backend.
 
+    `config` may carry batched `[B]` float leaves (a
+    :func:`..simulation.sweep.config_grid` grid): the fused path ships
+    them to the kernel as per-scenario hyperparameter vectors (ONE
+    dispatch for the whole grid) and the XLA path vmaps over them.
+
     Returns `(total_dividends [B, V], final_bonds [B, V, M])`.
     """
     from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
 
     consensus_impl = resolve_consensus_impl(consensus_impl, *W.shape[-2:])
+    batched_cfg = any(jnp.ndim(leaf) > 0 for leaf in jax.tree.leaves(config))
     if epoch_impl == "auto":
         from yuma_simulation_tpu.ops.pallas_epoch import fused_scan_eligible
 
@@ -704,7 +710,13 @@ def simulate_scaled_batch(
             mode=spec.bonds_mode,
             **fused_hparams(config),
         )
-        return _dividends_per_1k(D_tot, S, config, W.dtype), B_final
+        if batched_cfg:
+            totals = jax.vmap(
+                lambda d, s, c: _dividends_per_1k(d, s, c, W.dtype)
+            )(D_tot, S, config)
+        else:
+            totals = _dividends_per_1k(D_tot, S, config, W.dtype)
+        return totals, B_final
     if epoch_impl != "xla":
         # "fused_scan_mxu" included: the MXU contraction is 2-D only, so
         # the batched API has no MXU variant — silently measuring the
@@ -713,6 +725,13 @@ def simulate_scaled_batch(
             f"unknown epoch_impl {epoch_impl!r} for simulate_scaled_batch; "
             "expected 'auto', 'xla' or 'fused_scan'"
         )
+    if batched_cfg:
+        return jax.vmap(
+            lambda w, s, c: simulate_scaled(
+                w, s, scales, c, spec,
+                consensus_impl=consensus_impl, epoch_impl="xla",
+            )
+        )(W, S, config)
     return jax.vmap(
         lambda w, s: simulate_scaled(
             w, s, scales, config, spec,
