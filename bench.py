@@ -148,7 +148,7 @@ def main() -> None:
 
     # PRIMARY: the parity-safe single-Pallas-program scan with the exact
     # MXU support contraction (what epoch_impl="auto" selects on TPU —
-    # bitwise-identical to the VPU scan and the XLA engines since r4).
+    # bitwise the VPU scan; consensus bitwise across every engine).
     primary_impl = "fused_scan_mxu" if on_tpu else "xla"
     primary = _time_best(varying(primary_impl), EPOCHS)
     # Off-TPU the primary already IS the XLA path; don't time it twice.
@@ -178,7 +178,7 @@ def main() -> None:
 
         def batched(n):
             total, _ = simulate_scaled_batch(
-                Wb, Sb, scales[:n], config, spec, epoch_impl="fused_scan"
+                Wb, Sb, scales[:n], config, spec, epoch_impl="fused_scan_mxu"
             )
             return total
 

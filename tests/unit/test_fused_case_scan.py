@@ -405,10 +405,11 @@ def test_simulate_scaled_batch_rejects_unknown_impl():
     ones = jnp.ones(3, jnp.float32)
     cfg = YumaConfig()
     spec = variant_for_version("Yuma 1 (paper)")
-    # "fused_scan_mxu" is single-scenario only; silently falling back to
-    # XLA would corrupt benchmarks, so the batched API raises.
+    # A typo'd impl must not silently benchmark the XLA path under the
+    # wrong label ("fused_scan_mxu" itself is valid since r4 — the batch
+    # rides the dot's batch dimensions).
     with pytest.raises(ValueError, match="epoch_impl"):
-        simulate_scaled_batch(W, S, ones, cfg, spec, epoch_impl="fused_scan_mxu")
+        simulate_scaled_batch(W, S, ones, cfg, spec, epoch_impl="nope")
 
 
 def test_simulate_scaled_rejects_unknown_impl():
@@ -509,13 +510,22 @@ def test_fused_ema_scan_batched_matches_per_scenario(mode, liquid):
         np.testing.assert_allclose(np.asarray(Df[i]), np.asarray(Di), atol=1e-7)
 
 
-def test_fused_ema_scan_batched_rejects_mxu():
+def test_fused_ema_scan_batched_mxu_accepted():
+    # r4: the batched MXU scan is supported (leading dims ride the dot's
+    # batch dimensions) and bitwise the batched VPU scan — pinned by
+    # tests/unit/test_fused_epoch.py::test_batched_mxu_scan_bitwise_equals_vpu_scan.
     from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
 
     W = jnp.ones((2, 4, 8), jnp.float32)
     S = jnp.ones((2, 4), jnp.float32) / 4
-    with pytest.raises(ValueError, match="2-D only"):
-        fused_ema_scan(W, S, jnp.ones(3, jnp.float32), mxu=True)
+    b_m, d_m = fused_ema_scan(
+        W, S, jnp.ones(3, jnp.float32), mxu=True, interpret=True
+    )
+    b_v, d_v = fused_ema_scan(
+        W, S, jnp.ones(3, jnp.float32), mxu=False, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(b_m), np.asarray(b_v))
+    np.testing.assert_array_equal(np.asarray(d_m), np.asarray(d_v))
 
 
 def test_simulate_scaled_batch_fused_matches_xla():

@@ -300,6 +300,29 @@ def test_simulate_scaled_ones_matches_simulate_constant():
     np.testing.assert_array_equal(np.asarray(b_const), np.asarray(b_scaled))
 
 
+def test_batched_mxu_scan_bitwise_equals_vpu_scan():
+    """The batched fused scan's MXU support (leading dims on the dot's
+    batch dimensions) must be bitwise the batched VPU scan — the
+    contract simulate_scaled_batch's auto now relies on."""
+    from yuma_simulation_tpu.simulation.engine import simulate_scaled_batch
+
+    rng = np.random.default_rng(13)
+    B, V, M, E = 3, 16, 64, 6
+    W = jnp.asarray(rng.random((B, V, M)), jnp.float32)
+    S = jnp.asarray(rng.random((B, V)) + 0.01, jnp.float32)
+    scales = jnp.asarray(1.0 + 1e-4 * rng.random(E), jnp.float32)
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 1 (paper)")
+    t_v, b_v = simulate_scaled_batch(
+        W, S, scales, cfg, spec, epoch_impl="fused_scan"
+    )
+    t_m, b_m = simulate_scaled_batch(
+        W, S, scales, cfg, spec, epoch_impl="fused_scan_mxu"
+    )
+    np.testing.assert_array_equal(np.asarray(t_m), np.asarray(t_v))
+    np.testing.assert_array_equal(np.asarray(b_m), np.asarray(b_v))
+
+
 def test_rust64_quantize_tracks_f64_oracle_at_large_K():
     """The double-single emulation of Yuma-0's f64 quantization divide
     (`_rust64_quantize`) against a true-f64 oracle, at column sums far

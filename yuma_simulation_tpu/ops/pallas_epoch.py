@@ -128,8 +128,9 @@ def _stake_limb_split(S_int, Vp: int, dtype):
     15-bit limbs satisfy the sum bound for Vp <= 512; 10-bit limbs
     extend exactness to Vp <= 2^14. Larger V has no MXU fast path
     (callers fall back to the VPU reduce).
-    Returns `(rows [2n, Vp], limb_bits)` — per limb, head row then
-    residual row, most-significant limb first.
+    Returns `(rows [..., 2n, Vp], limb_bits)` — per limb, head row then
+    residual row, most-significant limb first; leading batch dims (the
+    batched scan) pass through.
     """
     if Vp <= 512:
         bits, n = 15, 2
@@ -137,7 +138,7 @@ def _stake_limb_split(S_int, Vp: int, dtype):
         bits, n = 10, 3
     else:
         raise ValueError(f"no exact MXU stake split for V={Vp}")
-    S_flat = S_int[..., 0]  # [Vp]
+    S_flat = S_int[..., 0]  # [..., Vp]
     rows = []
     for i in reversed(range(n)):  # most-significant limb first
         limb = (S_flat >> (bits * i)) & ((1 << bits) - 1)
@@ -148,32 +149,39 @@ def _stake_limb_split(S_int, Vp: int, dtype):
         limb_f = limb.astype(dtype)
         head = limb_f.astype(jnp.bfloat16).astype(dtype)
         rows += [head, limb_f - head]  # residual is an exact small int
-    return jnp.stack(rows), bits
+    return jnp.stack(rows, axis=-2), bits
 
 
 def _support_limbs_mxu(S_rows, limb_bits: int, mask):
-    """EXACT consensus support on the MXU: one `[2n, V] x [V, M]`
-    default-precision contraction of the bf16-term stake rows
-    (:func:`_stake_limb_split`) against the 0/1 mask, recombined in
-    int32. Bitwise-identical to the VPU `where(mask, S_int, 0).sum()`
-    by construction (every operand cast, product and partial sum is
+    """EXACT consensus support on the MXU: one `[..., 2n, V] x
+    [..., V, M]` default-precision contraction of the bf16-term stake
+    rows (:func:`_stake_limb_split`) against the 0/1 mask (leading dims
+    are dot batch dims — the batched scan), recombined in int32.
+    Bitwise-identical to the VPU `where(mask, S_int, 0).sum()` by
+    construction (every operand cast, product and partial sum is
     exact), so the MXU scan shares the VPU scan's parity contract."""
+    nb = S_rows.ndim - 2  # leading batch dims
     out = jax.lax.dot_general(
-        S_rows, mask, (((1,), (0,)), ((), ())),
+        S_rows,
+        mask,
+        (
+            ((S_rows.ndim - 1,), (mask.ndim - 2,)),
+            (tuple(range(nb)), tuple(range(nb))),
+        ),
         preferred_element_type=jnp.float32,
-    )  # [2n, M]
-    n = out.shape[0] // 2
+    )  # [..., 2n, M]
+    n = out.shape[-2] // 2
     support = jnp.zeros_like(
-        lax.index_in_dim(out, 0, axis=0, keepdims=True), dtype=jnp.int32
+        lax.index_in_dim(out, 0, axis=-2, keepdims=True), dtype=jnp.int32
     )
     for j in range(n):
-        pair = lax.index_in_dim(out, 2 * j, axis=0, keepdims=True).astype(
-            jnp.int32
-        ) + lax.index_in_dim(out, 2 * j + 1, axis=0, keepdims=True).astype(
-            jnp.int32
-        )
+        pair = lax.index_in_dim(
+            out, 2 * j, axis=-2, keepdims=True
+        ).astype(jnp.int32) + lax.index_in_dim(
+            out, 2 * j + 1, axis=-2, keepdims=True
+        ).astype(jnp.int32)
         support = (support << limb_bits) + pair
-    return support  # [1, M] int32
+    return support  # [..., 1, M] int32
 
 
 def _ds_split(a):
@@ -460,8 +468,8 @@ def _epoch_math(
     All reductions use negative axes so leading batch dims (the batched
     scan kernel: `[B, Vp, Mp]` arrays, one scenario per leading index)
     flow through unchanged; `S` is then `[..., Vp, 1]` and every
-    normalization is per-scenario. The MXU support path stays 2-D only
-    (callers enforce it).
+    normalization is per-scenario; the MXU support contraction treats
+    leading dims as dot batch dimensions.
     """
     Mp = W.shape[-1]
 
@@ -827,12 +835,13 @@ def fused_ema_scan(
 
     `W`/`S_n` may carry a leading scenario-batch axis (`W [Bb, V, M]`,
     `S_n [Bb, V]`): every grid step then advances ALL `Bb` scenarios one
-    epoch with `[Bb, Vp, Mp]`-shaped VPU ops — a single run's arrays are
+    epoch with `[Bb, Vp, Mp]`-shaped ops — a single run's arrays are
     too small to fill the chip (DESIGN.md "Utilization"), so batching is
-    how varying-weights work saturates it. The batch shares `scales` and
-    the hyperparameters; per-scenario normalizations reduce over the last
-    two axes only. The MXU variant stays single-scenario (its dot shapes
-    are 2-D); batched callers get the parity-safe VPU path.
+    how varying-weights work saturates it. The batch shares `scales`;
+    hyperparameters are shared scalars or per-scenario `[Bb]` vectors
+    (see below); per-scenario normalizations reduce over the last two
+    axes only. `mxu=True` works batched too — the leading dims ride the
+    support dot's batch dimensions, bitwise the VPU path.
 
     Returns `(B_final [[Bb,] V, M], D_n_total [[Bb,] V])` where
     `D_n_total` is the sum over epochs of the per-epoch NORMALIZED
@@ -849,11 +858,6 @@ def fused_ema_scan(
     # faithful engine.
     rust64 = mode is BondsMode.EMA_RUST and bool(jax.config.jax_enable_x64)
     if W.ndim == 3:
-        if mxu:
-            raise ValueError(
-                "the MXU support contraction is 2-D only; batched scans "
-                "run the (parity-safe) VPU path"
-            )
         Bb, V, M = W.shape
         lead: tuple[int, ...] = (Bb,)
     else:

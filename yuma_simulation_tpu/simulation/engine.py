@@ -675,10 +675,12 @@ def simulate_scaled_batch(
     pass works on `B`-fold data — the chip-filling configuration for
     varying-weights work.
 
-    `epoch_impl`: "xla" (`vmap` over the per-scenario scan) or
-    "fused_scan" (the batched single-Pallas-program scan — parity-safe
-    VPU reductions; the MXU variant is single-scenario only). "auto"
-    picks "fused_scan" when eligible on this backend.
+    `epoch_impl`: "xla" (`vmap` over the per-scenario scan),
+    "fused_scan" (the batched single-Pallas-program scan, VPU
+    reductions), or "fused_scan_mxu" (same scan with the exact
+    limb-split MXU support — bitwise-identical, the batch rides the
+    dot's batch dimensions; V <= 2^14). "auto" picks the MXU scan when
+    eligible on this backend, else the VPU scan, else XLA.
 
     `config` may carry batched `[B]` float leaves (a
     :func:`..simulation.sweep.config_grid` grid): the fused path ships
@@ -692,15 +694,22 @@ def simulate_scaled_batch(
     consensus_impl = resolve_consensus_impl(consensus_impl, *W.shape[-2:])
     batched_cfg = any(jnp.ndim(leaf) > 0 for leaf in jax.tree.leaves(config))
     if epoch_impl == "auto":
-        from yuma_simulation_tpu.ops.pallas_epoch import fused_scan_eligible
-
-        epoch_impl = (
-            "fused_scan"
-            if scales.shape[0] >= 1
-            and fused_scan_eligible(W.shape, spec.bonds_mode, config, W.dtype)
-            else "xla"
+        from yuma_simulation_tpu.ops.pallas_epoch import (
+            exact_mxu_support_covers,
+            fused_scan_eligible,
         )
-    if epoch_impl == "fused_scan":
+
+        if scales.shape[0] >= 1 and fused_scan_eligible(
+            W.shape, spec.bonds_mode, config, W.dtype
+        ):
+            epoch_impl = (
+                "fused_scan_mxu"
+                if exact_mxu_support_covers(W.shape[-2])
+                else "fused_scan"
+            )
+        else:
+            epoch_impl = "xla"
+    if epoch_impl in ("fused_scan", "fused_scan_mxu"):
         from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
 
         B_final, D_tot = fused_ema_scan(
@@ -708,6 +717,7 @@ def simulate_scaled_batch(
             S / S.sum(axis=-1, keepdims=True),
             scales,
             mode=spec.bonds_mode,
+            mxu=epoch_impl == "fused_scan_mxu",
             **fused_hparams(config),
         )
         if batched_cfg:
@@ -718,12 +728,11 @@ def simulate_scaled_batch(
             totals = _dividends_per_1k(D_tot, S, config, W.dtype)
         return totals, B_final
     if epoch_impl != "xla":
-        # "fused_scan_mxu" included: the MXU contraction is 2-D only, so
-        # the batched API has no MXU variant — silently measuring the
-        # XLA fallback would corrupt benchmarks.
+        # A typo'd impl must not silently benchmark the XLA path under
+        # the wrong label.
         raise ValueError(
             f"unknown epoch_impl {epoch_impl!r} for simulate_scaled_batch; "
-            "expected 'auto', 'xla' or 'fused_scan'"
+            "expected 'auto', 'xla', 'fused_scan' or 'fused_scan_mxu'"
         )
     if batched_cfg:
         return jax.vmap(

@@ -182,10 +182,12 @@ def sweep_scaled_fused(
     (the reference's beta sweep is 4 sequential re-runs of everything,
     reference scripts/charts_table_generator.py:14-16).
 
-    `epoch_impl`: "auto" (fused on TPU when eligible, else the XLA
-    vmap), "fused_scan" (require the batched fused path; interpret mode
-    off-TPU), or "xla" (vmap of the scalar engine over scenarios AND
-    config leaves — the parity oracle the fused path is tested against).
+    `epoch_impl`: "auto" (the batched exact-MXU fused scan on TPU when
+    eligible and the limb split covers V, the VPU scan beyond, else the
+    XLA vmap), "fused_scan" / "fused_scan_mxu" (require the batched
+    fused path — the two are bitwise-identical; interpret mode off-TPU),
+    or "xla" (vmap of the scalar engine over scenarios AND config
+    leaves — the parity oracle the fused paths are tested against).
 
     Returns `(total_dividends [B, V], final_bonds [B, V, M])`.
 
