@@ -135,3 +135,53 @@ def test_sweep_scaled_fused_liquid_alpha_bounds_grid():
     assert float(np.abs(np.asarray(b_xla[0]) - np.asarray(b_xla[-1])).max()) > 1e-3
     np.testing.assert_allclose(np.asarray(t_f), np.asarray(t_xla), rtol=2e-5)
     np.testing.assert_allclose(np.asarray(b_f), np.asarray(b_xla), atol=2e-6)
+
+
+def test_simulate_batch_fused_suite_matches_xla():
+    """The BATCHED fused case scan: the whole 14-case suite (real
+    per-epoch weights, per-scenario reset metadata riding the VMEM
+    operand) advances one epoch per grid step and must match the vmap'd
+    XLA engine — including the versions whose reset rules actually fire
+    — and the MXU variant must be bitwise the VPU variant."""
+    from yuma_simulation_tpu.models.variants import variant_for_version
+    from yuma_simulation_tpu.scenarios import get_cases
+    from yuma_simulation_tpu.simulation.sweep import (
+        simulate_batch,
+        stack_scenarios,
+    )
+
+    cases = get_cases()
+    W, S, ri, re = stack_scenarios(cases)
+    assert int(np.asarray(ri).max()) >= 0  # suite carries real resets
+    for version in (
+        "Yuma 1 (paper)",
+        "Yuma 3.1 (Rhef+reset)",
+        "Yuma 3.2 (Rhef+conditional)",
+        "Yuma 4 (Rhef+relative bonds) - liquid alpha on",
+    ):
+        params = (
+            dict(liquid_alpha=True, bond_alpha=0.025, alpha_high=0.99,
+                 alpha_low=0.9)
+            if "liquid" in version
+            else {}
+        )
+        cfg = YumaConfig(yuma_params=YumaParams(**params))
+        spec = variant_for_version(version)
+        ys_x = simulate_batch(W, S, ri, re, cfg, spec, save_bonds=True)
+        ys_f = simulate_batch(
+            W, S, ri, re, cfg, spec, save_bonds=True,
+            epoch_impl="fused_scan",
+        )
+        ys_m = simulate_batch(
+            W, S, ri, re, cfg, spec, save_bonds=True,
+            epoch_impl="fused_scan_mxu",
+        )
+        for k in ys_x:
+            np.testing.assert_allclose(
+                np.asarray(ys_f[k]), np.asarray(ys_x[k]),
+                atol=2e-6, rtol=1e-5, err_msg=f"{version}: {k}",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(ys_m[k]), np.asarray(ys_f[k]),
+                err_msg=f"{version}: {k} (mxu bitwise)",
+            )

@@ -249,6 +249,40 @@ def bench_hyperparam_grid_fused(V=64, M=1024, epochs=2048):
         )
 
 
+def bench_batched_case_scan(B=2, E=256, V=256, M=4096):
+    """The batched fused case scan (r4): true per-epoch weights for a
+    scenario batch, one Pallas dispatch. At this shape the fused path
+    is ~1.5x the XLA vmap; the tiny built-in suite is faster on XLA
+    (auto's ~2^19-cell gate, DESIGN.md)."""
+    from yuma_simulation_tpu.simulation.sweep import simulate_batch
+
+    rng = np.random.default_rng(23)
+    W = jnp.asarray(rng.random((B, E, V, M)), jnp.float32)
+    S = jnp.asarray(rng.random((B, E, V)) + 0.01, jnp.float32)
+    ri = jnp.full((B,), -1, jnp.int32)
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 1 (paper)")
+
+    impls = ("auto", "xla") if jax.default_backend() == "tpu" else ("xla",)
+    for impl in impls:
+        def run(n):
+            for _ in range(n):
+                _fetch(
+                    simulate_batch(
+                        W, S, ri, ri, cfg, spec, epoch_impl=impl
+                    )["dividends"]
+                )
+
+        rate, meta = _bench(run, 1, "passes_timed", max_n=64)
+        _line(
+            f"batched TRUE-weights case scan: {B} scenarios x {E}e x "
+            f"{V}v x {M}m ({impl})",
+            rate * B * E,
+            "scenario-epochs/s",
+            meta,
+        )
+
+
 def bench_montecarlo(num_scenarios=256, epochs=100, V=64, M=1024):
     mesh = make_mesh()
     keys = iter(range(1, 1 << 20))
@@ -322,6 +356,8 @@ def main():
     bench_correctness_matrix()
     bench_hyperparam_grid()
     bench_hyperparam_grid_fused()
+    if jax.default_backend() == "tpu":
+        bench_batched_case_scan()
     bench_batched_throughput()
     bench_montecarlo()
 

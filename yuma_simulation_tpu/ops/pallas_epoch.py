@@ -707,6 +707,27 @@ def fused_scan_eligible(shape, mode: BondsMode, config, dtype=None) -> bool:
     return _scan_resident_bytes(shape, mode) * 3 <= _VMEM_LIMIT
 
 
+def _pack_hp(hp_vals, lead, dtype):
+    """The per-scenario hyperparameter operand. One shared packer for
+    every fused wrapper — the column order is parity-critical (the
+    kernels read `sc(i)` by index), so it must never drift between call
+    sites (same rule as engine.fused_hparams). Returns `(operand,
+    per_hp)`: a `[Bb, 1, LANES]` VMEM array when any value is batched,
+    else the classic `[9]` SMEM scalar vector."""
+    per_hp = any(v.ndim > 0 for v in hp_vals)
+    if per_hp and not lead:
+        raise ValueError(
+            "per-scenario hyperparameter vectors require a batched scan; "
+            "got single-scenario inputs"
+        )
+    if not per_hp:
+        return jnp.stack(hp_vals), False
+    hp_arr = jnp.zeros(lead + (1, _LANES), dtype)
+    for i, v in enumerate(hp_vals):
+        hp_arr = hp_arr.at[:, 0, i].set(jnp.broadcast_to(v, lead))
+    return hp_arr, True
+
+
 def _fused_ema_scan_kernel(
     *rest,
     iters: int,
@@ -932,17 +953,7 @@ def fused_ema_scan(
     # sweeps): ship the nine values as a [Bb, 1, LANES] VMEM operand
     # instead of SMEM scalars, so a whole hyperparameter grid runs as
     # ONE fused dispatch (r3 verdict item 5).
-    per_hp = any(v.ndim > 0 for v in hp_vals)
-    if per_hp and not lead:
-        raise ValueError(
-            "per-scenario hyperparameter vectors require a batched scan "
-            "(W of rank 3); got scalar-workload inputs"
-        )
-    if per_hp:
-        Bb = lead[0]
-        hp_arr = jnp.zeros((Bb, 1, _LANES), dtype)
-        for i, v in enumerate(hp_vals):
-            hp_arr = hp_arr.at[:, 0, i].set(jnp.broadcast_to(v, (Bb,)))
+    hp_operand, per_hp = _pack_hp(hp_vals, lead, dtype)
 
     vm = lambda shape: pl.BlockSpec(  # noqa: E731
         shape, lambda e: tuple(0 for _ in shape), memory_space=pltpu.VMEM
@@ -955,10 +966,10 @@ def fused_ema_scan(
         scratch.append(pltpu.VMEM(lead + (Vp, Mp), dtype))
 
     if per_hp:
-        operands = [hp_arr]
-        in_specs = [vm((Bb, 1, _LANES))]
+        operands = [hp_operand]
+        in_specs = [vm(lead + (1, _LANES))]
     else:
-        operands = [jnp.stack(hp_vals)]
+        operands = [hp_operand]
         in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
     operands += [scales.astype(dtype), S_p, W_p]
     in_specs += [
@@ -1007,15 +1018,18 @@ def _case_scan_resident_bytes(
 ) -> int:
     """VMEM bytes the streamed case scan keeps live: the bond scratch,
     the EMA_PREV weight scratch, two pipelined per-epoch W blocks, and
-    (when per-epoch bonds are emitted) two pipelined output blocks."""
+    (when per-epoch bonds are emitted) two pipelined output blocks.
+    `shape` is `[E, V, M]` or batched `[Bb, E, V, M]` (everything
+    resident scales by Bb)."""
     V, M = shape[-2:]
+    Bb = shape[0] if len(shape) == 4 else 1
     Vp, Mp = _round_up(V, _SUBLANES), _round_up(M, _LANES)
     mats = 3  # B scratch + double-buffered W blocks
     if mode is BondsMode.EMA_PREV:
         mats += 1
     if save_bonds:
         mats += 2
-    return mats * Vp * Mp * 4
+    return mats * Bb * Vp * Mp * 4
 
 
 def fused_case_scan_eligible(
@@ -1047,13 +1061,7 @@ def fused_case_scan_eligible(
 
 
 def _fused_case_scan_kernel(
-    scal_ref,
-    rst_ref,
-    s_ref,
-    w_ref,
-    dn_ref,
-    bfin_ref,
-    *rest,
+    *refs,
     iters: int,
     mode: BondsMode,
     mxu: bool,
@@ -1066,22 +1074,43 @@ def _fused_case_scan_kernel(
     save_consensus: bool,
     liquid_overrides: tuple = (None, None),
     rust64: bool = False,
+    per_scenario_hp: bool = False,
+    per_scenario_rst: bool = False,
 ):
     """One grid step = one epoch of the reference's REAL workload: this
-    epoch's weight block `[1, Vp, Mp]` and stake block `[1, Vp, 1]` are
-    streamed from HBM (Pallas prefetches step e+1's blocks during step
-    e's compute), the bond state stays in VMEM scratch for the whole
-    scan, and the variant's bond-reset rule
+    epoch's weight block `[1, (Bb,) Vp, Mp]` and stake block
+    `[1, (Bb,) Vp, 1]` are streamed from HBM (Pallas prefetches step
+    e+1's blocks during step e's compute), the bond state stays in VMEM
+    scratch for the whole scan, and the variant's bond-reset rule
     (reference simulation_utils.py:62-88) is applied in-kernel against
-    the previous epoch's consensus held in scratch. scal/rst layouts are
-    documented in :func:`fused_case_scan`."""
-    outs = list(rest)
+    the previous epoch's consensus held in scratch. An optional leading
+    scenario-batch dim advances a whole suite per grid step, with
+    per-scenario hyperparameters / reset metadata carried as
+    `[Bb, 1, LANES]` VMEM operands replacing the SMEM scalars (the
+    `per_scenario_*` flags). scal/rst layouts are documented in
+    :func:`fused_case_scan`."""
+    refs = list(refs)
+    hp_or_scal_ref = refs.pop(0)
+    rst_ref = refs.pop(0)
+    s_ref, w_ref, dn_ref, bfin_ref = refs[:4]
+    outs = refs[4:]
     bonds_ref = outs.pop(0) if save_bonds else None
     inc_ref = outs.pop(0) if save_incentives else None
     cons_ref = outs.pop(0) if save_consensus else None
     b_scr = outs.pop(0)
     cprev_scr = outs.pop(0)
     wprev_scr = outs.pop(0) if mode is BondsMode.EMA_PREV else None
+
+    if per_scenario_hp:
+        hp = hp_or_scal_ref[...]  # [Bb, 1, LANES]
+
+        def sc(i):
+            return hp[..., i : i + 1]  # [Bb, 1, 1]
+
+    else:
+
+        def sc(i):
+            return hp_or_scal_ref[i]
 
     e = pl.program_id(0)
     first = e == 0
@@ -1093,12 +1122,12 @@ def _fused_case_scan_kernel(
         if wprev_scr is not None:
             wprev_scr[...] = jnp.zeros_like(wprev_scr)
 
-    Vp, Mp = b_scr.shape
-    W = w_ref[...].reshape(Vp, Mp)
-    S = s_ref[...].reshape(Vp, 1)
+    Vp, Mp = b_scr.shape[-2:]
+    W = w_ref[...].reshape(b_scr.shape)
+    S = s_ref[...].reshape(b_scr.shape[:-1] + (1,))
     # normalize_stake (reference yumas.py:75); padded validator rows are
-    # zero so they drop out of the sum.
-    S_n = S / jnp.sum(S)
+    # zero so they drop out of the sum. Per-scenario when batched.
+    S_n = S / jnp.sum(S, axis=-2, keepdims=True)
 
     B = b_scr[...]
     if reset_mode is not ResetMode.NONE:
@@ -1106,14 +1135,21 @@ def _fused_case_scan_kernel(
         # simulation_utils.py:62-88): zero the reset miner's column when
         # the rule fires. `epoch > 0` because the reference only tracks
         # B_state/consensus from epoch 1 onward.
-        ri = rst_ref[0]
-        r_epoch = rst_ref[1]
+        if per_scenario_rst:
+            rst = rst_ref[...]  # [Bb, 1, LANES] int32
+            ri = rst[..., 0:1]  # [Bb, 1, 1]
+            r_epoch = rst[..., 1:2]
+        else:
+            ri = rst_ref[0]
+            r_epoch = rst_ref[1]
         colm = lax.broadcasted_iota(jnp.int32, (1, Mp), 1)
         do = (e == r_epoch) & (e > 0) & (ri >= 0)
         if reset_mode is ResetMode.CONDITIONAL:
             idx = jnp.clip(ri, 0, m_real - 1)
             prev_c = jnp.sum(
-                jnp.where(colm == idx, cprev_scr[...], 0.0)
+                jnp.where(colm == idx, cprev_scr[...], 0.0),
+                axis=-1,
+                keepdims=True,
             )
             do = do & (prev_c == 0.0)
         B = jnp.where((colm == ri) & do, jnp.zeros_like(B), B)
@@ -1124,18 +1160,18 @@ def _fused_case_scan_kernel(
         B,
         wprev_scr[...] if wprev_scr is not None else None,
         first,
-        scal_ref[0],
-        scal_ref[1],
-        scal_ref[2],
+        sc(0),
+        sc(1),
+        sc(2),
         iters=iters,
         mode=mode,
         mxu=mxu,
         m_real=m_real,
         clip_fallback=first,
-        cap_alpha=scal_ref[3],
-        decay=scal_ref[4],
+        cap_alpha=sc(3),
+        decay=sc(4),
         liquid=liquid,
-        liquid_scal=(scal_ref[5], scal_ref[6], scal_ref[7], scal_ref[8]),
+        liquid_scal=(sc(5), sc(6), sc(7), sc(8)),
         liquid_overrides=liquid_overrides,
         rust64=rust64,
     )
@@ -1211,12 +1247,21 @@ def fused_case_scan(
     HBM with a per-epoch BlockSpec index map — the fetch overlaps the
     previous epoch's compute — while the bond state never leaves VMEM.
 
+    `W`/`S` may carry a leading scenario-batch axis (`W [Bb, E, V, M]`,
+    `S [Bb, E, V]`): every grid step then advances the whole suite one
+    epoch. Per-scenario reset metadata and hyperparameters (`[Bb]`
+    vectors for reset_index/reset_epoch/kappa/bond_penalty/...) ride
+    `[Bb, 1, LANES]` VMEM operands, so a case-suite x hyperparameter
+    product is ONE dispatch; padded-miner masks are not supported
+    batched (suites must share one real miner count — heterogeneous
+    suites use the XLA batch engine).
+
     Returns a dict of per-epoch outputs shaped like the XLA engine's scan
-    ys (normalized dividends `[E, V]`, plus bonds `[E, V, M]` /
-    incentives `[E, M]` / consensus `[E, M]` per the save flags) plus
-    `final_bonds [V, M]`. The dividend-per-1000-tao conversion is left to
-    the caller (it needs the raw per-epoch stakes, which the caller
-    already holds).
+    ys (normalized dividends `[(Bb,) E, V]`, plus bonds
+    `[(Bb,) E, V, M]` / incentives / consensus per the save flags) plus
+    `final_bonds [(Bb,) V, M]`. The dividend-per-1000-tao conversion is
+    left to the caller (it needs the raw per-epoch stakes, which the
+    caller already holds).
     """
     if reset_mode is None:
         reset_mode = ResetMode.NONE
@@ -1229,7 +1274,12 @@ def fused_case_scan(
     # M < 2^14 miners) — beyond that the XLA f64 path is the only
     # faithful engine.
     rust64 = mode is BondsMode.EMA_RUST and bool(jax.config.jax_enable_x64)
-    E, V, M = W.shape
+    if W.ndim == 4:
+        Bb, E, V, M = W.shape
+        lead: tuple[int, ...] = (Bb,)
+    else:
+        E, V, M = W.shape
+        lead = ()
     if mxu and not exact_mxu_support_covers(V):
         raise ValueError(
             f"the exact MXU stake split covers V <= 2^14 validators, got "
@@ -1237,8 +1287,10 @@ def fused_case_scan(
         )
     if E < 1:
         raise ValueError("fused scan requires at least one epoch")
-    if S.shape != (E, V):
-        raise ValueError(f"stakes must be [E, V] = {(E, V)}, got {S.shape}")
+    if S.shape != lead + (E, V):
+        raise ValueError(
+            f"stakes must be {lead + (E, V)}, got {S.shape}"
+        )
     dtype = W.dtype
     iters = int(math.ceil(math.log2(precision)))
     if rust64 and (M << iters) >= 2**31:
@@ -1254,15 +1306,22 @@ def fused_case_scan(
     resident = _case_scan_resident_bytes(W.shape, mode, save_bonds)
     if resident * 3 > _VMEM_LIMIT:
         raise ValueError(
-            f"[{V}, {M}] too large for the VMEM-resident fused case scan "
-            f"(~{resident // 2**20} MiB live); use the XLA path"
+            f"{list(lead) + [V, M]} too large for the VMEM-resident fused "
+            f"case scan (~{resident // 2**20} MiB live); use the XLA path"
         )
-    padded = (Vp, Mp) != (V, M)
+    # Epoch-major layout for the per-epoch BlockSpec stream: the batch
+    # (if any) rides between the epoch index and the [Vp, Mp] block.
+    W_em = jnp.moveaxis(W, -3, 0) if lead else W  # [E, (Bb,) V, M]
+    S_em = jnp.moveaxis(jnp.asarray(S, dtype), -2, 0) if lead else jnp.asarray(S, dtype)
     W_p = (
-        jnp.zeros((E, Vp, Mp), dtype).at[:, :V, :M].set(W) if padded else W
+        jnp.zeros((E,) + lead + (Vp, Mp), dtype)
+        .at[..., :V, :M]
+        .set(W_em)
     )
-    S_p = jnp.zeros((E, Vp, 1), dtype).at[:, :V, 0].set(
-        jnp.asarray(S, dtype)
+    S_p = (
+        jnp.zeros((E,) + lead + (Vp, 1), dtype)
+        .at[..., :V, 0]
+        .set(S_em)
     )
     if liquid_alpha:
         # The traced-scalar logit branch of liquid_alpha_rate — the one
@@ -1274,25 +1333,29 @@ def fused_case_scan(
         logit_num = jnp.log(1.0 / ah - 1.0) - logit_low
     else:
         al = ah = logit_low = logit_num = jnp.zeros((), dtype)
-    scal = jnp.stack(
-        [
-            jnp.asarray(kappa, dtype),
-            jnp.asarray(bond_penalty, dtype),
-            jnp.asarray(bond_alpha, dtype),
-            jnp.asarray(capacity_alpha, dtype),
-            jnp.asarray(decay_rate, dtype),
-            logit_low,
-            logit_num,
-            al,
-            ah,
-        ]
-    )
-    rst = jnp.stack(
-        [
-            jnp.asarray(reset_index, jnp.int32),
-            jnp.asarray(reset_epoch, jnp.int32),
-        ]
-    )
+    hp_vals = [
+        jnp.asarray(kappa, dtype),
+        jnp.asarray(bond_penalty, dtype),
+        jnp.asarray(bond_alpha, dtype),
+        jnp.asarray(capacity_alpha, dtype),
+        jnp.asarray(decay_rate, dtype),
+        logit_low,
+        logit_num,
+        al,
+        ah,
+    ]
+    hp_operand, per_hp = _pack_hp(hp_vals, lead, dtype)
+    # Reset metadata: SMEM scalars unbatched; [Bb, 1, LANES] int32 VMEM
+    # vectors (broadcast as needed) when batched.
+    ri_v = jnp.asarray(reset_index, jnp.int32)
+    re_v = jnp.asarray(reset_epoch, jnp.int32)
+    per_rst = bool(lead)
+    if per_rst:
+        rst = jnp.zeros(lead + (1, _LANES), jnp.int32)
+        rst = rst.at[:, 0, 0].set(jnp.broadcast_to(ri_v, lead))
+        rst = rst.at[:, 0, 1].set(jnp.broadcast_to(re_v, lead))
+    else:
+        rst = jnp.stack([ri_v, re_v])
 
     per_epoch = lambda shape: pl.BlockSpec(  # noqa: E731
         (1,) + shape,
@@ -1303,27 +1366,27 @@ def fused_case_scan(
         shape, lambda e: tuple(0 for _ in shape), memory_space=pltpu.VMEM
     )
 
-    out_specs = [per_epoch((Vp, 1)), fixed((Vp, Mp))]
+    out_specs = [per_epoch(lead + (Vp, 1)), fixed(lead + (Vp, Mp))]
     out_shape = [
-        jax.ShapeDtypeStruct((E, Vp, 1), dtype),
-        jax.ShapeDtypeStruct((Vp, Mp), dtype),
+        jax.ShapeDtypeStruct((E,) + lead + (Vp, 1), dtype),
+        jax.ShapeDtypeStruct(lead + (Vp, Mp), dtype),
     ]
     if save_bonds:
-        out_specs.append(per_epoch((Vp, Mp)))
-        out_shape.append(jax.ShapeDtypeStruct((E, Vp, Mp), dtype))
+        out_specs.append(per_epoch(lead + (Vp, Mp)))
+        out_shape.append(jax.ShapeDtypeStruct((E,) + lead + (Vp, Mp), dtype))
     if save_incentives:
-        out_specs.append(per_epoch((1, Mp)))
-        out_shape.append(jax.ShapeDtypeStruct((E, 1, Mp), dtype))
+        out_specs.append(per_epoch(lead + (1, Mp)))
+        out_shape.append(jax.ShapeDtypeStruct((E,) + lead + (1, Mp), dtype))
     if save_consensus:
-        out_specs.append(per_epoch((1, Mp)))
-        out_shape.append(jax.ShapeDtypeStruct((E, 1, Mp), dtype))
+        out_specs.append(per_epoch(lead + (1, Mp)))
+        out_shape.append(jax.ShapeDtypeStruct((E,) + lead + (1, Mp), dtype))
 
     scratch = [
-        pltpu.VMEM((Vp, Mp), dtype),
-        pltpu.VMEM((1, Mp), dtype),
+        pltpu.VMEM(lead + (Vp, Mp), dtype),
+        pltpu.VMEM(lead + (1, Mp), dtype),
     ]
     if mode is BondsMode.EMA_PREV:
-        scratch.append(pltpu.VMEM((Vp, Mp), dtype))
+        scratch.append(pltpu.VMEM(lead + (Vp, Mp), dtype))
 
     res = pl.pallas_call(
         functools.partial(
@@ -1343,13 +1406,19 @@ def fused_case_scan(
                 override_consensus_low,
             ),
             rust64=rust64,
+            per_scenario_hp=per_hp,
+            per_scenario_rst=per_rst,
         ),
         grid=(E,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            per_epoch((Vp, 1)),
-            per_epoch((Vp, Mp)),
+            fixed(lead + (1, _LANES))
+            if per_hp
+            else pl.BlockSpec(memory_space=pltpu.SMEM),
+            fixed(lead + (1, _LANES))
+            if per_rst
+            else pl.BlockSpec(memory_space=pltpu.SMEM),
+            per_epoch(lead + (Vp, 1)),
+            per_epoch(lead + (Vp, Mp)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -1361,19 +1430,25 @@ def fused_case_scan(
             vmem_limit_bytes=_VMEM_LIMIT,
             dimension_semantics=("arbitrary",),
         ),
-    )(scal, rst, S_p, W_p)
+    )(hp_operand, rst, S_p, W_p)
 
     res = list(res)
+    dn = res.pop(0)  # [E, (Bb,) Vp, 1]
+    if lead:
+        dn = jnp.moveaxis(dn, 0, 1)  # [Bb, E, Vp, 1]
     out = {
-        "dividends_normalized": res.pop(0)[:, :V, 0],
-        "final_bonds": res.pop(0)[:V, :M],
+        "dividends_normalized": dn[..., :V, 0],
+        "final_bonds": res.pop(0)[..., :V, :M],
     }
     if save_bonds:
-        out["bonds"] = res.pop(0)[:, :V, :M]
+        b = res.pop(0)
+        out["bonds"] = (jnp.moveaxis(b, 0, 1) if lead else b)[..., :V, :M]
     if save_incentives:
-        out["incentives"] = res.pop(0)[:, 0, :M]
+        i = res.pop(0)
+        out["incentives"] = (jnp.moveaxis(i, 0, 1) if lead else i)[..., 0, :M]
     if save_consensus:
-        out["consensus"] = res.pop(0)[:, 0, :M]
+        c = res.pop(0)
+        out["consensus"] = (jnp.moveaxis(c, 0, 1) if lead else c)[..., 0, :M]
     return out
 
 

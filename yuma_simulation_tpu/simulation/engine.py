@@ -232,9 +232,9 @@ def _simulate_scan(
     ),
 )
 def _simulate_case_fused(
-    weights: jnp.ndarray,  # [E, V, M]
-    stakes: jnp.ndarray,  # [E, V]
-    reset_index: jnp.ndarray,
+    weights: jnp.ndarray,  # [E, V, M] or batched [B, E, V, M]
+    stakes: jnp.ndarray,  # [E, V] or [B, E, V]
+    reset_index: jnp.ndarray,  # scalar, or [B] when batched
     reset_epoch: jnp.ndarray,
     config: YumaConfig,
     spec: VariantSpec,

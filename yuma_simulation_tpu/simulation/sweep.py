@@ -94,7 +94,13 @@ def stack_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
 
 @partial(
     jax.jit,
-    static_argnames=("spec", "save_bonds", "save_incentives", "consensus_impl"),
+    static_argnames=(
+        "spec",
+        "save_bonds",
+        "save_incentives",
+        "consensus_impl",
+        "epoch_impl",
+    ),
 )
 def simulate_batch(
     weights: jnp.ndarray,  # [B, E, V, M]
@@ -107,8 +113,80 @@ def simulate_batch(
     save_incentives: bool = False,
     consensus_impl: str = "bisect",
     miner_mask: Optional[jnp.ndarray] = None,  # [B, M] for padded suites
+    epoch_impl: str = "xla",
 ):
-    """One `vmap` over the scenario axis; shared (unbatched) config."""
+    """A scenario suite in one computation.
+
+    `epoch_impl`: "xla" (default — one `vmap` over the scenario axis;
+    shared unbatched config; the engine the golden-pinned reporting
+    paths use), "fused_scan" / "fused_scan_mxu" (the BATCHED fused case
+    scan: the whole suite advances one epoch per Pallas grid step,
+    per-scenario resets ride a VMEM operand — heterogeneous
+    `miner_mask` suites are not supported there), or "auto" (the fused
+    MXU path when eligible on this backend and `miner_mask is None`,
+    else the XLA vmap).
+    """
+    if epoch_impl == "auto":
+        from yuma_simulation_tpu.ops.pallas_epoch import (
+            exact_mxu_support_covers,
+            fused_case_scan_eligible,
+        )
+
+        # Measured crossover (v5e, r4): per-grid-step work below ~2^19
+        # cells is faster on the XLA vmap (the fused scan pays a
+        # per-epoch grid-step overhead the tiny built-in suite never
+        # amortizes — 131 vs 177 ms for the 9x14 case matrix), while at
+        # 2 x 256x4096 the fused scan is ~1.5x faster.
+        B = weights.shape[0]
+        cells = B * weights.shape[-2] * weights.shape[-1]
+        if (
+            miner_mask is None
+            and consensus_impl in ("auto", "bisect")
+            and weights.shape[1] >= 1
+            and cells >= 2**19
+            and fused_case_scan_eligible(
+                weights.shape, spec.bonds_mode, config, weights.dtype,
+                save_bonds,
+            )
+        ):
+            epoch_impl = (
+                "fused_scan_mxu"
+                if exact_mxu_support_covers(weights.shape[-2])
+                else "fused_scan"
+            )
+        else:
+            epoch_impl = "xla"
+    if epoch_impl in ("fused_scan", "fused_scan_mxu"):
+        if miner_mask is not None:
+            raise ValueError(
+                "the batched fused case scan has no per-scenario miner "
+                "masks; heterogeneous suites use epoch_impl='xla'"
+            )
+        if consensus_impl not in ("auto", "bisect"):
+            raise ValueError(
+                "the fused case scan computes consensus by bisection; "
+                f"consensus_impl={consensus_impl!r} requires "
+                "epoch_impl='xla'"
+            )
+        from yuma_simulation_tpu.simulation.engine import _simulate_case_fused
+
+        return _simulate_case_fused(
+            weights,
+            stakes,
+            reset_index,
+            reset_epoch,
+            config,
+            spec,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=False,
+            mxu=epoch_impl == "fused_scan_mxu",
+        )
+    if epoch_impl != "xla":
+        raise ValueError(
+            f"unknown epoch_impl {epoch_impl!r} for simulate_batch; "
+            "expected 'auto', 'xla', 'fused_scan' or 'fused_scan_mxu'"
+        )
     fn = lambda W, S, ri, re, mm: _simulate_scan(  # noqa: E731
         W,
         S,
