@@ -185,3 +185,60 @@ def test_simulate_batch_fused_suite_matches_xla():
                 np.asarray(ys_m[k]), np.asarray(ys_f[k]),
                 err_msg=f"{version}: {k} (mxu bitwise)",
             )
+
+
+def test_simulate_batch_case_x_beta_product_one_dispatch():
+    """A (case x beta) product suite with batched config leaves: the
+    reference's beta sweep over the whole suite as ONE batched
+    computation per engine — fused (per-scenario hp vectors in the
+    kernel) vs the XLA vmap-over-config oracle."""
+    import jax
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.models.variants import variant_for_version
+    from yuma_simulation_tpu.scenarios import get_cases
+    from yuma_simulation_tpu.simulation.sweep import (
+        simulate_batch,
+        stack_scenarios,
+    )
+
+    # Cases 11-13 are the beta-sensitive rows of the golden surface
+    # (clipping actually occurs there; most cases never clip, so their
+    # Yuma-1 dividends are identical across all betas).
+    cases = get_cases()[10:14]
+    betas = [0.0, 0.99]
+    W, S, ri, re = stack_scenarios(cases)
+    B = len(cases) * len(betas)
+    Wp = jnp.tile(W, (len(betas), 1, 1, 1))
+    Sp = jnp.tile(S, (len(betas), 1, 1))
+    rip = jnp.tile(ri, (len(betas),))
+    rep = jnp.tile(re, (len(betas),))
+    # batched config: bond_penalty varies per scenario, everything else
+    # broadcast to [B]
+    base = YumaConfig()
+    cfgs = jax.tree.map(
+        lambda leaf: jnp.broadcast_to(jnp.float32(leaf), (B,)), base
+    )
+    beta_vec = jnp.asarray(np.repeat(np.float32(betas), len(cases)))
+    from dataclasses import replace as dc_replace
+
+    cfgs = YumaConfig(
+        simulation=dc_replace(cfgs.simulation, bond_penalty=beta_vec),
+        yuma_params=cfgs.yuma_params,
+    )
+    spec = variant_for_version("Yuma 1 (paper)")
+    ys_x = simulate_batch(Wp, Sp, rip, rep, cfgs, spec, save_bonds=True)
+    ys_f = simulate_batch(
+        Wp, Sp, rip, rep, cfgs, spec, save_bonds=True,
+        epoch_impl="fused_scan",
+    )
+    # beta must actually matter across the product (non-vacuity)
+    assert not np.allclose(
+        np.asarray(ys_x["dividends"][0]),
+        np.asarray(ys_x["dividends"][len(cases)]),
+    )
+    for k in ys_x:
+        np.testing.assert_allclose(
+            np.asarray(ys_f[k]), np.asarray(ys_x[k]),
+            atol=2e-6, rtol=1e-5, err_msg=k,
+        )

@@ -104,6 +104,21 @@ def fused_hparams(config: YumaConfig) -> dict:
     )
 
 
+def config_is_batched(config) -> bool:
+    """Whether any float leaf of the config pytree carries a leading
+    batch axis (a config_grid grid). One shared predicate — the engines
+    must agree on what counts as batched."""
+    return any(jnp.ndim(leaf) > 0 for leaf in jax.tree.leaves(config))
+
+
+def config_vmap_axes(config):
+    """Per-leaf vmap in_axes for a possibly partially-batched config:
+    batched leaves map over axis 0, scalar leaves broadcast. (The fused
+    kernels broadcast scalars the same way via _pack_hp, so both engines
+    accept mixed configs.)"""
+    return jax.tree.map(lambda l: 0 if jnp.ndim(l) else None, config)
+
+
 def _apply_reset(B, C_prev, epoch, reset_index, reset_epoch, reset_mode, M):
     """Zero the reset miner's bond column when the variant's rule fires
     (reference simulation_utils.py:62-88). `reset_epoch < 0` disables.
@@ -266,11 +281,20 @@ def _simulate_case_fused(
         save_consensus=save_consensus,
         **fused_hparams(config),
     )
-    ys = {
-        "dividends": _dividends_per_1k(
+    if config_is_batched(config):
+        # Batched [B] config leaves (a grid aligned with the scenario
+        # axis): the kernel consumed them as per-scenario vectors; the
+        # per-1000-tao conversion maps them the same way (scalar leaves
+        # broadcast).
+        dividends = jax.vmap(
+            lambda d, s, c: _dividends_per_1k(d, s, c, dtype),
+            in_axes=(0, 0, config_vmap_axes(config)),
+        )(res["dividends_normalized"], stakes, config)
+    else:
+        dividends = _dividends_per_1k(
             res["dividends_normalized"], stakes, config, dtype
         )
-    }
+    ys = {"dividends": dividends}
     for key in ("bonds", "incentives", "consensus"):
         if key in res:
             ys[key] = res[key]
@@ -291,6 +315,13 @@ def simulate(
     mesh: Optional[Mesh] = None,
 ) -> SimulationResult:
     """Simulate one scenario under one named version; returns host arrays.
+
+    Memory note: `save_bonds`/`save_incentives` default True to mirror
+    the reference driver's outputs, which materializes `[E, V, M]`
+    per-epoch bonds on device AND fetches them to host. Fine at the
+    suite's E=40; at long epoch counts prefer `save_bonds=False` (or
+    the `simulate_constant`/`simulate_scaled` throughput paths, which
+    accumulate totals in-carry and keep HBM flat).
 
     `epoch_impl`:
       - "auto" (default): run the whole epoch loop as a single Pallas
@@ -692,7 +723,7 @@ def simulate_scaled_batch(
     from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
 
     consensus_impl = resolve_consensus_impl(consensus_impl, *W.shape[-2:])
-    batched_cfg = any(jnp.ndim(leaf) > 0 for leaf in jax.tree.leaves(config))
+    batched_cfg = config_is_batched(config)
     if epoch_impl == "auto":
         from yuma_simulation_tpu.ops.pallas_epoch import (
             exact_mxu_support_covers,
@@ -722,7 +753,8 @@ def simulate_scaled_batch(
         )
         if batched_cfg:
             totals = jax.vmap(
-                lambda d, s, c: _dividends_per_1k(d, s, c, W.dtype)
+                lambda d, s, c: _dividends_per_1k(d, s, c, W.dtype),
+                in_axes=(0, 0, config_vmap_axes(config)),
             )(D_tot, S, config)
         else:
             totals = _dividends_per_1k(D_tot, S, config, W.dtype)
@@ -739,7 +771,8 @@ def simulate_scaled_batch(
             lambda w, s, c: simulate_scaled(
                 w, s, scales, c, spec,
                 consensus_impl=consensus_impl, epoch_impl="xla",
-            )
+            ),
+            in_axes=(0, 0, config_vmap_axes(config)),
         )(W, S, config)
     return jax.vmap(
         lambda w, s: simulate_scaled(

@@ -27,7 +27,12 @@ from yuma_simulation_tpu.models.config import (
 )
 from yuma_simulation_tpu.models.variants import VariantSpec, variant_for_version
 from yuma_simulation_tpu.scenarios.base import Scenario
-from yuma_simulation_tpu.simulation.engine import _simulate_scan, simulate_constant
+from yuma_simulation_tpu.simulation.engine import (
+    _simulate_scan,
+    config_is_batched,
+    config_vmap_axes,
+    simulate_constant,
+)
 
 
 def _reset_metadata(scenarios: Sequence[Scenario]):
@@ -118,14 +123,20 @@ def simulate_batch(
     """A scenario suite in one computation.
 
     `epoch_impl`: "xla" (default — one `vmap` over the scenario axis;
-    shared unbatched config; the engine the golden-pinned reporting
-    paths use), "fused_scan" / "fused_scan_mxu" (the BATCHED fused case
-    scan: the whole suite advances one epoch per Pallas grid step,
-    per-scenario resets ride a VMEM operand — heterogeneous
-    `miner_mask` suites are not supported there), or "auto" (the fused
-    MXU path when eligible on this backend and `miner_mask is None`,
-    else the XLA vmap).
+    the engine the golden-pinned reporting paths use), "fused_scan" /
+    "fused_scan_mxu" (the BATCHED fused case scan: the whole suite
+    advances one epoch per Pallas grid step, per-scenario resets ride a
+    VMEM operand — heterogeneous `miner_mask` suites are not supported
+    there), or "auto" (the fused MXU path when eligible on this backend
+    and `miner_mask is None`, else the XLA vmap).
+
+    `config` may carry batched `[B]` float leaves (a
+    :func:`config_grid` grid aligned with the scenario axis — e.g. a
+    (case x beta) product suite): the fused path ships them to the
+    kernel as per-scenario hyperparameter vectors and the XLA path
+    vmaps over them.
     """
+    batched_cfg = config_is_batched(config)
     if epoch_impl == "auto":
         from yuma_simulation_tpu.ops.pallas_epoch import (
             exact_mxu_support_covers,
@@ -187,12 +198,12 @@ def simulate_batch(
             f"unknown epoch_impl {epoch_impl!r} for simulate_batch; "
             "expected 'auto', 'xla', 'fused_scan' or 'fused_scan_mxu'"
         )
-    fn = lambda W, S, ri, re, mm: _simulate_scan(  # noqa: E731
+    fn = lambda W, S, ri, re, mm, cfg: _simulate_scan(  # noqa: E731
         W,
         S,
         ri,
         re,
-        config,
+        cfg,
         spec,
         save_bonds=save_bonds,
         save_incentives=save_incentives,
@@ -200,11 +211,15 @@ def simulate_batch(
         consensus_impl=consensus_impl,
         miner_mask=mm,
     )
+    cfg_ax = config_vmap_axes(config) if batched_cfg else None
     if miner_mask is None:
-        return jax.vmap(lambda W, S, ri, re: fn(W, S, ri, re, None))(
-            weights, stakes, reset_index, reset_epoch
-        )
-    return jax.vmap(fn)(weights, stakes, reset_index, reset_epoch, miner_mask)
+        return jax.vmap(
+            lambda W, S, ri, re, cfg: fn(W, S, ri, re, None, cfg),
+            in_axes=(0, 0, 0, 0, cfg_ax),
+        )(weights, stakes, reset_index, reset_epoch, config)
+    return jax.vmap(fn, in_axes=(0, 0, 0, 0, 0, cfg_ax))(
+        weights, stakes, reset_index, reset_epoch, miner_mask, config
+    )
 
 
 def sweep_hyperparams(
