@@ -1076,6 +1076,8 @@ def _fused_case_scan_kernel(
     rust64: bool = False,
     per_scenario_hp: bool = False,
     per_scenario_rst: bool = False,
+    has_carry: bool = False,
+    return_carry: bool = False,
 ):
     """One grid step = one epoch of the reference's REAL workload: this
     epoch's weight block `[1, (Bb,) Vp, Mp]` and stake block
@@ -1088,15 +1090,34 @@ def _fused_case_scan_kernel(
     per-scenario hyperparameters / reset metadata carried as
     `[Bb, 1, LANES]` VMEM operands replacing the SMEM scalars (the
     `per_scenario_*` flags). scal/rst layouts are documented in
-    :func:`fused_case_scan`."""
+    :func:`fused_case_scan`.
+
+    Chunked streaming (`has_carry`/`return_carry` + the `off` epoch-offset
+    scalar): grid step `e` simulates GLOBAL epoch `e + off`, the scratch
+    state is seeded from carry operands instead of zeros at local step 0,
+    and the final consensus / previous-weights state is emitted alongside
+    `final_bonds` so a host driver can thread `[E_chunk, V, M]` slabs
+    through repeated dispatches with bitwise-identical results to one
+    monolithic scan (engine.simulate_streamed)."""
     refs = list(refs)
     hp_or_scal_ref = refs.pop(0)
     rst_ref = refs.pop(0)
+    off_ref = refs.pop(0)
+    if has_carry:
+        cb_ref = refs.pop(0)
+        cc_ref = refs.pop(0)
+        cwp_ref = refs.pop(0) if mode is BondsMode.EMA_PREV else None
     s_ref, w_ref, dn_ref, bfin_ref = refs[:4]
     outs = refs[4:]
     bonds_ref = outs.pop(0) if save_bonds else None
     inc_ref = outs.pop(0) if save_incentives else None
     cons_ref = outs.pop(0) if save_consensus else None
+    cfin_ref = outs.pop(0) if return_carry else None
+    wpfin_ref = (
+        outs.pop(0)
+        if return_carry and mode is BondsMode.EMA_PREV
+        else None
+    )
     b_scr = outs.pop(0)
     cprev_scr = outs.pop(0)
     wprev_scr = outs.pop(0) if mode is BondsMode.EMA_PREV else None
@@ -1113,14 +1134,21 @@ def _fused_case_scan_kernel(
             return hp_or_scal_ref[i]
 
     e = pl.program_id(0)
-    first = e == 0
+    eg = e + off_ref[0]  # global epoch index across chunks
+    first = eg == 0
 
-    @pl.when(first)
+    @pl.when(e == 0)
     def _init():
-        b_scr[...] = jnp.zeros_like(b_scr)
-        cprev_scr[...] = jnp.zeros_like(cprev_scr)
-        if wprev_scr is not None:
-            wprev_scr[...] = jnp.zeros_like(wprev_scr)
+        if has_carry:
+            b_scr[...] = cb_ref[...]
+            cprev_scr[...] = cc_ref[...]
+            if wprev_scr is not None:
+                wprev_scr[...] = cwp_ref[...]
+        else:
+            b_scr[...] = jnp.zeros_like(b_scr)
+            cprev_scr[...] = jnp.zeros_like(cprev_scr)
+            if wprev_scr is not None:
+                wprev_scr[...] = jnp.zeros_like(wprev_scr)
 
     Vp, Mp = b_scr.shape[-2:]
     W = w_ref[...].reshape(b_scr.shape)
@@ -1143,7 +1171,7 @@ def _fused_case_scan_kernel(
             ri = rst_ref[0]
             r_epoch = rst_ref[1]
         colm = lax.broadcasted_iota(jnp.int32, (1, Mp), 1)
-        do = (e == r_epoch) & (e > 0) & (ri >= 0)
+        do = (eg == r_epoch) & (eg > 0) & (ri >= 0)
         if reset_mode is ResetMode.CONDITIONAL:
             idx = jnp.clip(ri, 0, m_real - 1)
             prev_c = jnp.sum(
@@ -1192,6 +1220,21 @@ def _fused_case_scan_kernel(
     @pl.when(e == num_epochs - 1)
     def _emit():
         bfin_ref[...] = b_scr[...]
+        if cfin_ref is not None:
+            cfin_ref[...] = cprev_scr[...]
+        if wpfin_ref is not None:
+            wpfin_ref[...] = wprev_scr[...]
+
+
+@functools.lru_cache(maxsize=None)
+def _case_scan_kernel_cached(**params):
+    """Memoized kernel closure: repeated `fused_case_scan` call sites
+    with identical static params (e.g. the unrolled chunk chain of
+    `engine.simulate_generated`) must share ONE kernel-function identity
+    — a fresh `functools.partial` per call site defeats the lowering
+    cache and re-runs the minutes-scale remote Mosaic compile once per
+    chunk instance."""
+    return functools.partial(_fused_case_scan_kernel, **params)
 
 
 @functools.partial(
@@ -1208,6 +1251,7 @@ def _fused_case_scan_kernel(
         "save_bonds",
         "save_incentives",
         "save_consensus",
+        "return_carry",
     ),
 )
 def fused_case_scan(
@@ -1233,6 +1277,9 @@ def fused_case_scan(
     save_bonds: bool = True,
     save_incentives: bool = True,
     save_consensus: bool = False,
+    carry: dict | None = None,
+    epoch_offset=0,
+    return_carry: bool = False,
     interpret: bool | None = None,
 ):
     """The reference's ACTUAL epoch loop — genuinely different weights
@@ -1255,6 +1302,17 @@ def fused_case_scan(
     product is ONE dispatch; padded-miner masks are not supported
     batched (suites must share one real miner count — heterogeneous
     suites use the XLA batch engine).
+
+    Chunked streaming (the r4 verdict's top item — true-weights runs
+    whose `[E, V, M]` stack exceeds HBM): `carry` seeds the in-kernel
+    state from a previous chunk's final state (`{"bonds": [(Bb,) V, M],
+    "consensus": [(Bb,) M][, "w_prev": [(Bb,) V, M]]}`, the w_prev key
+    required exactly for EMA_PREV), `epoch_offset` (traced int32) is the
+    global index of this chunk's first epoch (reset rules and the
+    first-epoch bond adoption key off the global index), and
+    `return_carry=True` emits `final_consensus` (+ `final_w_prev` for
+    EMA_PREV) so the host driver (`engine.simulate_streamed`) can thread
+    chunks with bitwise-identical results to one monolithic scan.
 
     Returns a dict of per-epoch outputs shaped like the XLA engine's scan
     ys (normalized dividends `[(Bb,) E, V]`, plus bonds
@@ -1311,17 +1369,25 @@ def fused_case_scan(
         )
     # Epoch-major layout for the per-epoch BlockSpec stream: the batch
     # (if any) rides between the epoch index and the [Vp, Mp] block.
+    # Tile-aligned shapes skip the zero-init + set copy entirely — the
+    # padded materialization is a full extra HBM pass over the largest
+    # array on the hot streaming path (advisor r4 finding).
+    padded = (Vp, Mp) != (V, M)
     W_em = jnp.moveaxis(W, -3, 0) if lead else W  # [E, (Bb,) V, M]
     S_em = jnp.moveaxis(jnp.asarray(S, dtype), -2, 0) if lead else jnp.asarray(S, dtype)
     W_p = (
         jnp.zeros((E,) + lead + (Vp, Mp), dtype)
         .at[..., :V, :M]
         .set(W_em)
+        if padded
+        else W_em
     )
     S_p = (
         jnp.zeros((E,) + lead + (Vp, 1), dtype)
         .at[..., :V, 0]
         .set(S_em)
+        if Vp != V
+        else S_em[..., None]
     )
     if liquid_alpha:
         # The traced-scalar logit branch of liquid_alpha_rate — the one
@@ -1356,6 +1422,43 @@ def fused_case_scan(
         rst = rst.at[:, 0, 1].set(jnp.broadcast_to(re_v, lead))
     else:
         rst = jnp.stack([ri_v, re_v])
+    off = jnp.asarray(epoch_offset, jnp.int32).reshape(1)
+
+    has_carry = carry is not None
+    carry_ops: list = []
+    if has_carry:
+        need = {"bonds", "consensus"} | (
+            {"w_prev"} if mode is BondsMode.EMA_PREV else set()
+        )
+        if set(carry) != need:
+            raise ValueError(
+                f"carry must have exactly keys {sorted(need)} for "
+                f"mode {mode}, got {sorted(carry)}"
+            )
+
+        def pad_vm(x):
+            x = jnp.asarray(x, dtype)
+            if x.shape != lead + (V, M):
+                raise ValueError(
+                    f"carry matrix must be {lead + (V, M)}, got {x.shape}"
+                )
+            if not padded:
+                return x
+            return jnp.zeros(lead + (Vp, Mp), dtype).at[..., :V, :M].set(x)
+
+        cc = jnp.asarray(carry["consensus"], dtype)
+        if cc.shape != lead + (M,):
+            raise ValueError(
+                f"carry consensus must be {lead + (M,)}, got {cc.shape}"
+            )
+        cc_p = (
+            jnp.zeros(lead + (1, Mp), dtype).at[..., 0, :M].set(cc)
+            if Mp != M
+            else cc[..., None, :]
+        )
+        carry_ops = [pad_vm(carry["bonds"]), cc_p]
+        if mode is BondsMode.EMA_PREV:
+            carry_ops.append(pad_vm(carry["w_prev"]))
 
     per_epoch = lambda shape: pl.BlockSpec(  # noqa: E731
         (1,) + shape,
@@ -1380,6 +1483,12 @@ def fused_case_scan(
     if save_consensus:
         out_specs.append(per_epoch(lead + (1, Mp)))
         out_shape.append(jax.ShapeDtypeStruct((E,) + lead + (1, Mp), dtype))
+    if return_carry:
+        out_specs.append(fixed(lead + (1, Mp)))
+        out_shape.append(jax.ShapeDtypeStruct(lead + (1, Mp), dtype))
+        if mode is BondsMode.EMA_PREV:
+            out_specs.append(fixed(lead + (Vp, Mp)))
+            out_shape.append(jax.ShapeDtypeStruct(lead + (Vp, Mp), dtype))
 
     scratch = [
         pltpu.VMEM(lead + (Vp, Mp), dtype),
@@ -1389,8 +1498,7 @@ def fused_case_scan(
         scratch.append(pltpu.VMEM(lead + (Vp, Mp), dtype))
 
     res = pl.pallas_call(
-        functools.partial(
-            _fused_case_scan_kernel,
+        _case_scan_kernel_cached(
             iters=iters,
             mode=mode,
             mxu=mxu,
@@ -1408,6 +1516,8 @@ def fused_case_scan(
             rust64=rust64,
             per_scenario_hp=per_hp,
             per_scenario_rst=per_rst,
+            has_carry=has_carry,
+            return_carry=return_carry,
         ),
         grid=(E,),
         in_specs=[
@@ -1417,6 +1527,10 @@ def fused_case_scan(
             fixed(lead + (1, _LANES))
             if per_rst
             else pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ]
+        + [fixed(op.shape) for op in carry_ops]
+        + [
             per_epoch(lead + (Vp, 1)),
             per_epoch(lead + (Vp, Mp)),
         ],
@@ -1430,7 +1544,7 @@ def fused_case_scan(
             vmem_limit_bytes=_VMEM_LIMIT,
             dimension_semantics=("arbitrary",),
         ),
-    )(hp_operand, rst, S_p, W_p)
+    )(hp_operand, rst, off, *carry_ops, S_p, W_p)
 
     res = list(res)
     dn = res.pop(0)  # [E, (Bb,) Vp, 1]
@@ -1449,6 +1563,10 @@ def fused_case_scan(
     if save_consensus:
         c = res.pop(0)
         out["consensus"] = (jnp.moveaxis(c, 0, 1) if lead else c)[..., 0, :M]
+    if return_carry:
+        out["final_consensus"] = res.pop(0)[..., 0, :M]
+        if mode is BondsMode.EMA_PREV:
+            out["final_w_prev"] = res.pop(0)[..., :V, :M]
     return out
 
 

@@ -5,6 +5,8 @@ from yuma_simulation_tpu.simulation.engine import (  # noqa: F401
     run_simulation,
     simulate,
     simulate_constant,
+    simulate_generated,
+    simulate_streamed,
 )
 from yuma_simulation_tpu.simulation.sweep import (  # noqa: F401
     config_grid,
