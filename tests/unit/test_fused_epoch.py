@@ -564,3 +564,51 @@ def test_fused_scan_liquid_matches_xla(version, params):
     np.testing.assert_allclose(
         np.asarray(t_scan), np.asarray(t_xla), rtol=2e-5
     )
+
+
+def test_ema_prev_recompute_variant_bitwise():
+    """r4 verdict item 3: the EMA_PREV scan can re-derive the previous
+    epoch's normalized weights from `W * scales[e-1]` instead of keeping
+    the scratch mat — the two variants must be BITWISE identical (the
+    same multiply+normalize on the same inputs)."""
+    import yuma_simulation_tpu.ops.pallas_epoch as pe
+    from yuma_simulation_tpu.models.epoch import BondsMode
+
+    V, M, E = 8, 24, 12
+    rng = np.random.default_rng(5)
+    W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    S_n = jnp.asarray(rng.random(V) + 0.01, jnp.float32)
+    S_n = S_n / S_n.sum()
+    scales = jnp.asarray(1.0 + 1e-4 * rng.random(E), jnp.float32)
+
+    b1, d1 = pe.fused_ema_scan(W, S_n, scales, mode=BondsMode.EMA_PREV)
+    b2, d2 = pe.fused_ema_scan(
+        W, S_n, scales, mode=BondsMode.EMA_PREV, recompute_prev=True
+    )
+    np.testing.assert_array_equal(np.asarray(b1), np.asarray(b2))
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+
+def test_vmem_budget_model_pins_measured_boundaries():
+    """The measured v5e VMEM admission model (compiled/failed boundaries
+    observed on chip, r5): the scaled scan's EMA_PREV spellings fit
+    through B=5 at 256x4096 and fail at B=6; the streamed case scan's
+    chip-filling B=4 configs (4 mats incl. the EMA_PREV scratch) are
+    admitted, save_bonds at that batch is not. The old `resident * 3 <=
+    110 MiB` rule rejected every B=4 256x4096 case-scan config."""
+    import yuma_simulation_tpu.ops.pallas_epoch as pe
+    from yuma_simulation_tpu.models.epoch import BondsMode
+
+    def unit(B):
+        return pe._unit_bytes((B, 256, 4096))
+
+    prev = BondsMode.EMA_PREV
+    assert pe._fits_vmem(unit(4), pe._scan_mats(prev, False))
+    assert pe._fits_vmem(unit(5), pe._scan_mats(prev, False))  # on-chip OK
+    assert not pe._fits_vmem(unit(6), pe._scan_mats(prev, True))  # on-chip fail
+    # The streamed case scan at the chip-filling batch (measured on
+    # chip: the 4-mat EMA_PREV config compiles, B=6 does not).
+    assert pe._fits_vmem(unit(4), pe._case_scan_mats(prev, False))
+    assert not pe._fits_vmem(unit(6), pe._case_scan_mats(prev, False))
+    assert not pe._fits_vmem(unit(4), pe._case_scan_mats(prev, True))
+    assert pe._fits_vmem(unit(4), pe._case_scan_mats(BondsMode.EMA, False))
