@@ -24,6 +24,7 @@ entering float32 arithmetic.
 from __future__ import annotations
 
 import enum
+import math
 from typing import Optional
 
 import jax
@@ -37,7 +38,11 @@ from yuma_simulation_tpu.ops.consensus import (
     stake_weighted_median_sorted,
 )
 from yuma_simulation_tpu.ops.liquid import liquid_alpha_rate
-from yuma_simulation_tpu.ops.normalize import normalize_stake, normalize_weight_rows
+from yuma_simulation_tpu.ops.normalize import (
+    miner_sum,
+    normalize_stake,
+    normalize_weight_rows,
+)
 
 MAXINT = float(2**64 - 1)
 
@@ -203,6 +208,9 @@ def yuma_epoch(
         sum_dtype=jnp.float64 if rust64 else None,
         out_dtype=dtype,
         miner_mask=miner_mask,
+        # The f32 normalizing sum runs exactly on the dyadic grid ints
+        # (order-independent — identical on any miner mesh).
+        grid_bits=int(math.ceil(math.log2(config.consensus_precision))),
     )
 
     # Clip, rank, incentive, trust.
@@ -211,9 +219,11 @@ def yuma_epoch(
     )
     W_clipped = jnp.minimum(clip_base, C)
     R = jnp.einsum("v,vm->m", S_n, W_clipped, precision=precision_config)
-    incentive = jnp.nan_to_num(R / R.sum())
+    # Miner-axis reductions use the partition-invariant miner_sum
+    # spelling (ops/normalize.py): bitwise identical on any miner mesh.
+    incentive = jnp.nan_to_num(R / miner_sum(R))
     T = jnp.nan_to_num(R / P)
-    T_v = W_clipped.sum(axis=-1) / W_n.sum(axis=-1)
+    T_v = miner_sum(W_clipped) / miner_sum(W_n)
 
     out = {
         "weight": W_n,
@@ -252,7 +262,7 @@ def yuma_epoch(
             first_epoch,
             renormalize=bonds_mode is BondsMode.EMA_RUST,
         )
-        D = (B_ema * incentive).sum(axis=-1)
+        D = miner_sum(B_ema * incentive)
         out.update(
             server_trust=T,
             validator_trust=T_v,
@@ -266,13 +276,13 @@ def yuma_epoch(
     elif bonds_mode is BondsMode.CAPACITY:
         B_prev = jnp.zeros_like(W_n) if B_old is None else B_old
         B = capacity_bonds_update(B_prev, W_n, S_n, config)
-        D = (B * incentive).sum(axis=-1)
+        D = miner_sum(B * incentive)
         out.update(server_trust=T, validator_trust=T_v, validator_bonds=B)
 
     elif bonds_mode is BondsMode.RELATIVE:
         B_prev = jnp.zeros_like(W_n) if B_old is None else B_old
         B = relative_bonds_update(B_prev, W_n, _rate_vm(bond_alpha, W_n))
-        D = S_n * (B * incentive).sum(axis=-1)
+        D = S_n * miner_sum(B * incentive)
         out["validator_bonds"] = B
 
     else:  # pragma: no cover

@@ -91,6 +91,34 @@ def support_rounded(support_int: jnp.ndarray, dtype) -> jnp.ndarray:
     return support_int.astype(dtype) * scale
 
 
+def dyadic_grid_fits_int32(count: int, grid_bits: int) -> bool:
+    """Whether `count` dyadic grid values `k * 2^-grid_bits` (k <=
+    2^grid_bits) can be summed exactly in int32 — the shared guard of
+    every exact-quantization denominator site."""
+    return (count << grid_bits) < 2**31
+
+
+def dyadic_grid_denom(C: jnp.ndarray, grid_bits: int) -> jnp.ndarray:
+    """EXACT last-axis sum of dyadic grid values `k * 2^-grid_bits`,
+    rounded once to `C.dtype` — the `_rust64_quantize` trick generalized
+    (r4 verdict item 2). The integer sum is order-independent, so a
+    miner-sharded psum and a single-device reduce produce the identical
+    denominator; and whenever naive f32 partial sums would stay below
+    2^24 (count <= 2^(24 - grid_bits)) the result is bitwise the naive
+    sum. One shared spelling — quantize_u16, the fused Pallas kernels
+    and any future engine must all call this, or the cross-engine
+    bitwise consensus contract drifts. Callers guard with
+    :func:`dyadic_grid_fits_int32` on the REAL (unpadded) value count
+    (padded columns are zeroed and contribute k = 0).
+    """
+    k = jnp.round(C * jnp.asarray(float(2**grid_bits), C.dtype))
+    K = jnp.sum(  # dtype pinned: x64 would promote i32 sums to i64,
+        # which Mosaic cannot lower
+        k.astype(jnp.int32), axis=-1, keepdims=True, dtype=jnp.int32
+    )
+    return K.astype(C.dtype) * jnp.asarray(2.0**-grid_bits, C.dtype)
+
+
 #: Above this many `V x M` cells the sorted closed form's XLA program hits
 #: pathological remote-compile times (minutes to hours at >= 512x8192 on
 #: the remote-tunnel TPU runtime, vs seconds for bisection at every rung —
@@ -183,6 +211,7 @@ def quantize_u16(
     sum_dtype: Optional[jnp.dtype] = None,
     out_dtype: jnp.dtype = jnp.float32,
     miner_mask: Optional[jnp.ndarray] = None,
+    grid_bits: Optional[int] = None,
 ) -> jnp.ndarray:
     """Sum-normalize C and truncate onto the u16 grid.
 
@@ -191,6 +220,21 @@ def quantize_u16(
     dtype of the normalizing division — the Yuma-0 variant performs it in
     float64 (yumas.py:81) while all others use float32; both end up float32
     after the integer division, which `out_dtype` reproduces.
+
+    `grid_bits` (the engines pass `ceil(log2(consensus_precision))`)
+    declares that every C value is a dyadic grid point `k * 2^-grid_bits`
+    — true for all three consensus engines, whose outputs are bisection
+    grid values. The f32 normalizing sum is then computed EXACTLY as an
+    int32 sum of the `k` (the `_rust64_quantize` trick generalized, r4
+    verdict item 2), rounded once to f32: order-independent by
+    construction, so a miner-sharded psum and the single-device reduce
+    cannot disagree. For `M <= 2^(31 - grid_bits - ...)`, i.e. whenever
+    the naive f32 partial sums stay below 2^24 (M <= 128 at the default
+    17-bit grid — every built-in case), the exact sum is bitwise the
+    naive sum, so golden surfaces are unchanged. The f64 path needs no
+    treatment: an f64 sum of u17-grid dyadics is already exact in any
+    order (K < 2^53). Falls back to the naive sum when the int32 bound
+    `M * 2^grid_bits < 2^31` fails.
 
     `miner_mask` (`[..., M]`, 1 = real miner, 0 = padding) zeroes padded
     columns *before* the sum so padding cannot perturb the grid of real
@@ -201,7 +245,15 @@ def quantize_u16(
         C = jnp.where(miner_mask.astype(bool), C, jnp.zeros_like(C))
     if sum_dtype is not None:
         C = C.astype(sum_dtype)
-    scaled = C / C.sum(axis=-1, keepdims=True) * 65_535
+    if (
+        grid_bits is not None
+        and sum_dtype is None
+        and dyadic_grid_fits_int32(C.shape[-1], grid_bits)
+    ):
+        denom = dyadic_grid_denom(C, grid_bits)
+    else:
+        denom = C.sum(axis=-1, keepdims=True)
+    scaled = C / denom * 65_535
     return scaled.astype(jnp.int32).astype(out_dtype) / 65_535
 
 
@@ -220,7 +272,11 @@ def consensus_weights(
         W, S, kappa, precision, precision_config=precision_config
     )
     return quantize_u16(
-        C, sum_dtype=sum_dtype, out_dtype=W.dtype, miner_mask=miner_mask
+        C,
+        sum_dtype=sum_dtype,
+        out_dtype=W.dtype,
+        miner_mask=miner_mask,
+        grid_bits=_bisection_iterations(precision),
     )
 
 

@@ -86,6 +86,8 @@ from jax.experimental.pallas import tpu as pltpu
 from yuma_simulation_tpu.models.epoch import _EMA_MODES, MAXINT, BondsMode
 from yuma_simulation_tpu.models.variants import ResetMode
 from yuma_simulation_tpu.ops.consensus import (
+    dyadic_grid_denom as _dyadic_grid_denom,
+    dyadic_grid_fits_int32 as _dyadic_grid_fits_int32,
     support_fixed_stakes as _support_fixed_stakes,
     support_rounded as _support_rounded,
 )
@@ -532,7 +534,17 @@ def _epoch_math(
     if rust64:
         C = _rust64_quantize(c_hi, W.dtype, iters)
     else:
-        C = c_hi / jnp.sum(c_hi, axis=-1, keepdims=True) * 65535.0
+        # Exact integer quantization sum on the dyadic grid — the ONE
+        # shared spelling (ops/consensus.py::dyadic_grid_denom), bitwise
+        # the XLA engines' quantize_u16(grid_bits=...) denominator. The
+        # guard uses the REAL miner count (padded columns were zeroed
+        # above and contribute k = 0), so the gate matches the XLA
+        # engine's for the same subnet.
+        if _dyadic_grid_fits_int32(m_real, iters):
+            denom = _dyadic_grid_denom(c_hi, iters)
+        else:
+            denom = jnp.sum(c_hi, axis=-1, keepdims=True)
+        C = c_hi / denom * 65535.0
         C = C.astype(jnp.int32).astype(W.dtype) / 65535.0
 
     if clip_prev is not None:

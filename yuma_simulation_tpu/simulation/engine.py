@@ -41,7 +41,7 @@ from yuma_simulation_tpu.models.variants import (
     VariantSpec,
     variant_for_version,
 )
-from yuma_simulation_tpu.ops.normalize import normalize_weight_rows
+from yuma_simulation_tpu.ops.normalize import miner_sum, normalize_weight_rows
 from yuma_simulation_tpu.scenarios.base import Scenario
 
 
@@ -1321,10 +1321,13 @@ def _simulate_constant_hoisted(
         rate = jnp.asarray(config.bond_alpha, dtype)
 
     def dividends_of(B):
+        # Same partition-invariant miner-axis spelling as the full
+        # kernel (ops/normalize.py::miner_sum) — keeps hoisted == full
+        # and sharded == unsharded bitwise.
         if spec.bonds_mode is BondsMode.RELATIVE:
-            D = S_n * (B * incentive).sum(axis=-1)
+            D = S_n * miner_sum(B * incentive)
         else:
-            D = (B * incentive).sum(axis=-1)
+            D = miner_sum(B * incentive)
         D_n = D / (D.sum() + 1e-6)
         return _dividends_per_1k(D_n, S, config, dtype)
 
