@@ -73,10 +73,95 @@ def test_montecarlo_sharded(mesh8):
     np.testing.assert_array_equal(got, again)
 
 
-def test_montecarlo_batch_indivisible_raises(mesh8):
-    with pytest.raises(ValueError, match="divide"):
+def test_montecarlo_batch_pads_and_trims(mesh8):
+    # r4 verdict weak item 6: one batch contract for both entry points —
+    # indivisible scenario counts are padded up and trimmed, matching
+    # simulate_batch_sharded, not raised on.
+    got13 = montecarlo_total_dividends(
+        jax.random.key(0), 13, 4, 4, 8, "Yuma 1 (paper)", mesh=mesh8
+    )
+    assert got13.shape == (13, 4)
+    got16 = montecarlo_total_dividends(
+        jax.random.key(0), 16, 4, 4, 8, "Yuma 1 (paper)", mesh=mesh8
+    )
+    np.testing.assert_array_equal(got13, got16[:13])
+
+
+@pytest.mark.parametrize(
+    "version", ["Yuma 1 (paper)", "Yuma 2 (Adrian-Fish)"],
+    ids=["yuma1", "yuma2"],
+)
+def test_montecarlo_per_epoch_weights_matches_engine_oracle(mesh8, version):
+    """r4 verdict item 4: the epoch-VARYING Monte-Carlo (fresh
+    perturbation every epoch, generated on device inside the shard) must
+    reproduce, scenario by scenario, the engine's XLA scan run on the
+    identical host-materialized `[E, V, M]` stack — same key discipline
+    (`fold_in(scenario_key, epoch)`), same full per-epoch kernel."""
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.scenarios.base import Scenario
+    from yuma_simulation_tpu.simulation.engine import simulate
+
+    E, V, M = 6, 4, 16
+    rng = np.random.default_rng(9)
+    base_W = jnp.asarray(rng.random((V, M)), jnp.float32)
+    base_S = jnp.asarray(rng.random(V) + 0.1, jnp.float32)
+    pert = 0.05
+    key = jax.random.key(3)
+    got = montecarlo_total_dividends(
+        key, 16, E, V, M, version, mesh=mesh8,
+        base_weights=base_W, base_stakes=base_S, perturbation=pert,
+        weights_mode="per_epoch", consensus_impl="bisect",
+    )
+    assert got.shape == (16, V) and np.isfinite(got).all()
+    # Oracle for the first scenario of the first two shards: rebuild the
+    # per-epoch weights with the same fold_in discipline and run the
+    # monolithic engine.
+    shard_keys = jax.random.split(key, 8)
+    for shard in (0, 1):
+        k = jax.random.split(shard_keys[shard], 2)[0]
+        W_e = np.stack(
+            [
+                np.asarray(
+                    jax.nn.relu(
+                        base_W
+                        + pert
+                        * jax.random.normal(
+                            jax.random.fold_in(k, e), (V, M), jnp.float32
+                        )
+                    )
+                )
+                for e in range(E)
+            ]
+        )
+        scen = Scenario(
+            name="oracle",
+            validators=[f"v{i}" for i in range(V)],
+            base_validator="v0",
+            weights=W_e,
+            stakes=np.broadcast_to(np.asarray(base_S), (E, V)).copy(),
+            num_epochs=E,
+        )
+        res = simulate(
+            scen, version, epoch_impl="xla", consensus_impl="bisect",
+            save_bonds=False, save_incentives=False,
+        )
+        np.testing.assert_array_equal(
+            got[shard * 2], res.dividends.sum(axis=0),
+            err_msg=f"{version} shard {shard}",
+        )
+
+
+def test_montecarlo_per_epoch_rejects_hoisted(mesh8):
+    with pytest.raises(ValueError, match="hoistable"):
         montecarlo_total_dividends(
-            jax.random.key(0), 13, 4, 4, 8, "Yuma 1 (paper)", mesh=mesh8
+            jax.random.key(0), 16, 4, 4, 8, "Yuma 1 (paper)", mesh=mesh8,
+            weights_mode="per_epoch", epoch_impl="hoisted",
+        )
+    with pytest.raises(ValueError, match="weights_mode"):
+        montecarlo_total_dividends(
+            jax.random.key(0), 16, 4, 4, 8, "Yuma 1 (paper)", mesh=mesh8,
+            weights_mode="sometimes",
         )
 
 
