@@ -4,35 +4,54 @@ The full-precision golden surface (test_parity_golden.py) bounds every
 value to <1.5e-6, but the artifact the reference actually ships is the
 `%.6f`-rendered CSV — and a deviation of a few 1e-7 can flip a rendered
 6th decimal on a knife-edge cell. This test renders the framework's CSVs
-byte-for-byte as the CLI does and classifies every differing cell
-against the reference-rendered goldens via the same logic as
-`tools/csv_byte_parity.py` (which writes the CSV_BYTE_PARITY.json
-artifact): a differing cell must be a one-unit 6th-decimal rounding of
-a <1.5e-6 full-precision deviation, nothing else.
+byte-for-byte as the CLI does and holds them to TWO gates:
+
+1. class: every differing cell must be a one-unit 6th-decimal rounding
+   of a <1.5e-6 full-precision deviation (same logic as
+   `tools/csv_byte_parity.py`, which writes CSV_BYTE_PARITY.json);
+2. pin (r4 verdict item 8): the exact differing-cell list — case,
+   column, both rendered strings — must equal the golden list captured
+   in `tests/golden/csv_diff_cells.json`. A cell newly differing, a
+   cell newly agreeing, or a changed rendered value all fail, so silent
+   drift WITHIN the rounding class is impossible. Regenerate the pin
+   with `python tools/csv_byte_parity.py --pin
+   tests/golden/csv_diff_cells.json` after an intentional numerics
+   change, and say why in the commit.
 """
+
+import json
+import os
 
 import pytest
 
-from tools.csv_byte_parity import BETAS, classify_beta
+from tests.conftest import GOLDEN_DIR
+from tools.csv_byte_parity import BETAS, classify_beta, pin_key
+
+_PIN_PATH = os.path.join(GOLDEN_DIR, "csv_diff_cells.json")
 
 
 @pytest.mark.parametrize("beta", BETAS)
-def test_rendered_csv_within_rounding_class(beta):
+def test_rendered_csv_cells_pinned_exactly(beta):
+    with open(_PIN_PATH) as f:
+        pinned = json.load(f)[beta]
     res = classify_beta(beta)
-    if res["byte_identical"]:
-        return
     diffs = res["differing_cells"]
-    # The comparison must not be vacuous: the header and case labels must
-    # have matched (classify_beta asserts), and differing cells exist.
-    assert diffs, "files differ but no cell-level diffs found"
+    if not res["byte_identical"]:
+        # The comparison must not be vacuous: the header and case labels
+        # must have matched (classify_beta asserts), and cells exist.
+        assert diffs, "files differ but no cell-level diffs found"
     bad = [d for d in diffs if not d["is_sixth_decimal_rounding"]]
     assert not bad, (
         f"beta={beta}: {len(bad)} differing cells are NOT one-unit "
         f"6th-decimal roundings of <1.5e-6 deviations: {bad[:5]}"
     )
-    # Knife-edge flips are a small minority of the surface; a majority
-    # differing would mean a real numerical regression even if each cell
-    # individually stayed in class.
-    assert len(diffs) < 0.25 * res["cells_total"], (
-        f"beta={beta}: {len(diffs)}/{res['cells_total']} cells differ"
+    got = sorted(pin_key(d) for d in diffs)
+    appeared = sorted(set(got) - set(pinned))
+    vanished = sorted(set(pinned) - set(got))
+    assert got == pinned, (
+        f"beta={beta}: rendered-byte diff drifted from the pinned list "
+        f"(tests/golden/csv_diff_cells.json): {len(appeared)} new "
+        f"differing cells {appeared[:4]}, {len(vanished)} cells now "
+        f"agree {vanished[:4]}. If the numerics change was intentional, "
+        "regenerate the pin with tools/csv_byte_parity.py --pin."
     )

@@ -98,6 +98,8 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    *,
+    initialization_timeout: Optional[int] = None,
 ) -> None:
     """Join a multi-host JAX run (the NCCL/MPI-init analogue).
 
@@ -105,14 +107,28 @@ def initialize_distributed(
     backend (any `jax.devices()` / array op initializes local-only XLA and
     makes later distributed init fail). Arguments default to the standard
     JAX env-var autodetection (GKE / Cloud TPU metadata).
+
+    Failure semantics: with ALL arguments defaulted (autodetection), a
+    failed init degrades to a single-process run with a debug log — the
+    laptop/CI case. With an EXPLICIT coordinator the caller has declared
+    the run distributed, so a peer that never joins (crashed before the
+    barrier, wrong address, ...) raises within `initialization_timeout`
+    seconds instead of silently simulating 1/N of the workload as if it
+    were the whole job (failure-detection contract, pinned by
+    tests/unit/test_distributed_multiprocess.py).
     """
     if jax.distributed.is_initialized():
         return
+    explicit = coordinator_address is not None
+    kwargs = {}
+    if initialization_timeout is not None:
+        kwargs["initialization_timeout"] = initialization_timeout
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            **kwargs,
         )
         logger.info(
             "distributed: process %d/%d, %d local / %d global devices",
@@ -122,4 +138,11 @@ def initialize_distributed(
             jax.device_count(),
         )
     except (RuntimeError, ValueError) as e:
+        if explicit:
+            raise RuntimeError(
+                f"distributed join failed for explicit coordinator "
+                f"{coordinator_address} (process {process_id}/"
+                f"{num_processes}); refusing to degrade to a "
+                "single-process run"
+            ) from e
         logger.debug("single-process run (distributed init skipped: %s)", e)

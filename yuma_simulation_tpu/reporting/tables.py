@@ -57,20 +57,30 @@ def generate_total_dividends_table(
     cases: Sequence[Scenario],
     yuma_versions: list[tuple[str, YumaParams]],
     simulation_hyperparameters: SimulationHyperparameters,
+    *,
+    dtype=None,
+    epoch_impl: str = "auto",
 ) -> pd.DataFrame:
     """Per-case total dividends across versions, standardized to
     "Validator A/B/C" columns (reference simulation_utils.py:319-381).
 
     All cases share the [40, 3, 2] shape, so each version is one batched
-    scan over the stacked suite.
+    scan over the stacked suite. `dtype`/`epoch_impl` exist for the f64
+    oracle experiment (tools/csv_byte_parity.py), which computes this
+    exact surface in float64 through the XLA engine — parameterizing
+    here keeps the oracle the SAME computation as the shipped artifact.
     """
+    import jax.numpy as jnp
+
     for case in cases:
         if len(case.validators) != 3:
             raise ValueError(
                 f"Case '{case.name}' does not have exactly 3 validators."
             )
 
-    W, S, ri, re = stack_scenarios(cases)
+    W, S, ri, re = stack_scenarios(
+        cases, jnp.float32 if dtype is None else dtype
+    )
     rows: list[dict[str, object]] = [{"Case": case.name} for case in cases]
     columns = ["Case"]
 
@@ -79,7 +89,7 @@ def generate_total_dividends_table(
             simulation=simulation_hyperparameters, yuma_params=yuma_params
         )
         spec = variant_for_version(yuma_version)
-        ys = simulate_batch(W, S, ri, re, config, spec)
+        ys = simulate_batch(W, S, ri, re, config, spec, epoch_impl=epoch_impl)
         # Reference totals are Python-float sums of per-epoch float32
         # values; summing in float64 on host matches to well below 1e-6.
         totals = np.asarray(ys["dividends"], np.float64).sum(axis=1)  # [B, V]
