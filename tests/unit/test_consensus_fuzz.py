@@ -194,3 +194,40 @@ def test_support_exactly_kappa_is_not_above():
     # to the smallest grid point above 0.6 for miner 0... support at
     # c >= 0.6 is 0 -> also not above; the whole interval descends to 2^-17.
     assert a[0] == np.float32(GRID)
+
+
+@pytest.mark.parametrize("V", [512, 2048])
+def test_large_v_near_ties_stay_engine_consistent(V):
+    """Advisor r4: the canonical fixed-point support rounds each stake
+    onto a 2^-30 grid before the exact sum, so the decision can differ
+    from a sequentially-accumulated f32 sum by up to ~V * 2^-31 at
+    knife-edge ties — a window widest at large V. Fuzz exactly that
+    regime: many validators, stake subsets engineered near kappa, and
+    require all three engines to stay BITWISE consistent with each
+    other (the canonical contract; reference-semantics equivalence at
+    the tie itself is pinned by the small hand cases above)."""
+    rng = np.random.default_rng(V)
+    for trial in range(4):
+        # Random stakes; one miner column supported by a random subset
+        # whose stake mass lands within a few ulps of kappa = 0.5.
+        S = rng.random(V).astype(np.float32) + 0.01
+        S = S / S.sum()
+        order = rng.permutation(V)
+        csum = np.cumsum(S[order])
+        k = int(np.searchsorted(csum, 0.5))
+        subset = order[: k + 1]
+        W = rng.random((V, 8)).astype(np.float32)
+        # Miner 0: the subset puts weight above 0.7, everyone else
+        # below, so support at c in (0.3, 0.7) is the subset's stake
+        # mass — a near-kappa knife edge.
+        W[:, 0] = 0.1
+        W[subset, 0] = 0.9
+        Wj = jnp.asarray(W / W.sum(axis=-1, keepdims=True))
+        Sj = jnp.asarray(S)
+        a = np.asarray(stake_weighted_median(Wj, Sj, 0.5))
+        b = np.asarray(stake_weighted_median_sorted(Wj, Sj, 0.5))
+        p = np.asarray(
+            stake_weighted_median_pallas(Wj, Sj, 0.5, interpret=True)
+        )
+        np.testing.assert_array_equal(a, b, err_msg=f"V={V} trial {trial}")
+        np.testing.assert_array_equal(a, p, err_msg=f"V={V} trial {trial}")

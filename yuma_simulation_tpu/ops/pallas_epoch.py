@@ -756,10 +756,14 @@ def fused_scan_eligible(shape, mode: BondsMode, config, dtype=None) -> bool:
         mode is BondsMode.EMA_RUST
         and jax.config.jax_enable_x64
         and (shape[-1] << math.ceil(math.log2(config.consensus_precision)))
-        >= 2**31
+        >= 2**23
     ):
-        # The f64-quantize emulation's exact int32 column sum overflows;
-        # only the XLA f64 path is faithful there.
+        # Parity-mode auto stays on the exactly-faithful XLA f64 path
+        # wherever the double-single emulation's u16 cells could even in
+        # principle flip vs f64 (boundary flips need the quantization
+        # sum K >~ 2^23; K <= M * 2^iters bounds it conservatively —
+        # advisor r4). The fused paths remain explicit opt-in up to
+        # their int32 bound (M * 2^iters < 2^31, enforced in-kernel).
         return False
     if jax.default_backend() != "tpu":
         return False
@@ -1158,10 +1162,13 @@ def fused_case_scan_eligible(
         mode is BondsMode.EMA_RUST
         and jax.config.jax_enable_x64
         and (shape[-1] << math.ceil(math.log2(config.consensus_precision)))
-        >= 2**31
+        >= 2**23
     ):
-        # The f64-quantize emulation's exact int32 column sum overflows;
-        # only the XLA f64 path is faithful there.
+        # Parity-mode auto stays on the exactly-faithful XLA f64 path
+        # wherever the double-single emulation could even in principle
+        # flip a u16 cell vs f64 (K >~ 2^23; bounded by M * 2^iters —
+        # advisor r4). Explicit fused_scan* opt-in still works up to
+        # the in-kernel int32 bound.
         return False
     if jax.default_backend() != "tpu":
         return False

@@ -38,12 +38,20 @@ def time_best(
     actually execute (e.g. whole passes of a fixed-length inner scan, or
     a Monte-Carlo shard count), so `n / best` never over-counts.
     """
+    if max_n < granularity:
+        # No grid multiple fits under the cap; silently timing one
+        # granularity quantum would exceed a bound the caller may use as
+        # a hard resource limit (e.g. a shard count).
+        raise ValueError(
+            f"max_n={max_n} < granularity={granularity}: no timeable "
+            "work count satisfies both the divisibility contract and "
+            "the cap"
+        )
+
     def on_grid(x: int) -> int:
         # Cap at the largest grid multiple <= max_n so the result both
-        # honors the divisibility contract and never exceeds the cap
-        # (when max_n < granularity no such multiple exists; the floor of
-        # one granularity quantum is the least-wrong answer).
-        cap = max(max_n // granularity, 1) * granularity
+        # honors the divisibility contract and never exceeds the cap.
+        cap = (max_n // granularity) * granularity
         return min(cap, max(granularity, x // granularity * granularity))
 
     n = on_grid(n)  # the caller's n must honor the divisibility contract too
