@@ -42,6 +42,20 @@ def miner_sum(x: jnp.ndarray, keepdims: bool = False) -> jnp.ndarray:
     Small or non-8-divisible miner counts (every built-in case is M=2)
     keep the plain reduce, so all golden/CSV parity surfaces are
     bit-for-bit unchanged.
+
+    Spelling note: the blocks come from a RESHAPE and the partials from
+    one `[.., 8, M/8]` reduce. Two faster spellings were measured and
+    REJECTED because they break the partition-invariance this function
+    exists for (r5, CPU-mesh probes): plain `x.sum(-1)` is the baseline
+    order-dependence; strided slice-reduces (with or without
+    `optimization_barrier` around each partial) are ~30-40% faster on
+    the hoisted microbench because the elementwise producer fuses into
+    each block, but XLA's simplifier/partitioner re-associates them —
+    the 2-shard mesh drifted from the unsharded run by one ulp of the
+    total. The reshape costs a producer materialization (~69k vs 90k
+    plain eps on the hoisted-shape microbench) and is the only spelling
+    measured bitwise across 1, 2 and 8 shards; the flagship fused
+    kernels are unaffected (they keep their in-kernel reduces).
     """
     M = x.shape[-1]
     if M % SUM_BLOCKS or M < 2 * SUM_BLOCKS:
