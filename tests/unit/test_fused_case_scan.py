@@ -564,7 +564,17 @@ def test_fused_case_scan_fuzz_vs_xla(seed, E, V, M, version, liquid):
     """Shape/seed fuzz of the DEFAULT TPU path (`epoch_impl="auto"` ->
     fused_case_scan) against the XLA engine: sparse weights (zero rows
     and zero columns included), duplicate values, reset metadata — the
-    structures the golden cases don't randomize over."""
+    structures the golden cases don't randomize over.
+
+    Consensus tolerance: since r5 the XLA engine's row normalization
+    uses the partition-invariant miner_sum spelling at M % 8 == 0 while
+    the fused kernel keeps its plain in-kernel reduce (DESIGN.md
+    "Bitwise miner-axis sharding", residual class) — a knife-edge W_n
+    ulp can shift one bisection outcome by exactly one u16 grid step.
+    Observed exactly once across this battery (seed 26, M=64, 1/384
+    cells). Differing consensus cells must BE that class: one grid
+    step, on a handful of cells; anything larger or more widespread
+    fails."""
     rng = np.random.default_rng(seed)
     W = rng.random((E, V, M)).astype(np.float32)
     W[W < 0.3] = 0.0  # sparse, with whole-zero rows/columns likely
@@ -581,10 +591,29 @@ def test_fused_case_scan_fuzz_vs_xla(seed, E, V, M, version, liquid):
     ys_x = _simulate_scan(Wj, Sj, ri, re, cfg, spec, save_consensus=True)
     ys_f = _simulate_case_fused(Wj, Sj, ri, re, cfg, spec, save_consensus=True)
     assert ys_x.keys() == ys_f.keys()
+    grid = 1.0 / 65535.0
+    # Knife-edge class bounds: a flipped consensus cell moves exactly
+    # one grid step; its knock-on through the rank contraction bounds
+    # the incentive shift at ~2 grid steps (same rationale as the old
+    # r4 sharded tolerances).
+    edge_bounds = {"consensus": grid, "incentives": 2 * grid}
     for k in ys_x:
+        a, b = np.asarray(ys_f[k]), np.asarray(ys_x[k])
+        if k in edge_bounds and M % 8 == 0 and M >= 16:
+            diff = np.abs(a - b)
+            flipped = diff > 3e-6
+            assert flipped.mean() <= 0.01, (
+                f"{version} seed={seed}: {flipped.sum()}/{flipped.size} "
+                f"{k} cells differ — more than the knife-edge class"
+            )
+            assert diff.max() <= edge_bounds[k] * 1.0000001, (
+                f"{version} seed={seed}: {k} deviation "
+                f"{diff.max()} exceeds the knife-edge bound"
+            )
+            continue
         np.testing.assert_allclose(
-            np.asarray(ys_f[k]),
-            np.asarray(ys_x[k]),
+            a,
+            b,
             atol=3e-6,
             rtol=2e-5,
             err_msg=f"{version} seed={seed} shape=({E},{V},{M}): {k}",
