@@ -32,6 +32,8 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import lax
 
+from yuma_simulation_tpu.ops.normalize import miner_sum
+
 
 def _bisection_iterations(precision: int) -> int:
     # Halving [0,1] k times gives interval width 2^-k; the loop stops once
@@ -250,9 +252,21 @@ def quantize_u16(
         and sum_dtype is None
         and dyadic_grid_fits_int32(C.shape[-1], grid_bits)
     ):
+        # The int32 bound must hold for the worst case statically, so
+        # the gate uses the (possibly padded) shape width even though
+        # masked columns contribute k = 0 — a subnet padded past the
+        # bound therefore falls back while its unpadded run would not
+        # (conservative, never unsafe; heterogeneous padded suites run
+        # the XLA engine only, so no cross-engine pairing exists there).
         denom = dyadic_grid_denom(C, grid_bits)
     else:
-        denom = C.sum(axis=-1, keepdims=True)
+        # Partition-invariant fallback: beyond the int32 bound the sum
+        # still must not depend on a miner mesh's psum order, so it
+        # uses the blocked miner_sum spelling rather than a plain
+        # backend-ordered reduce (bitwise the plain sum at M < 16,
+        # which includes every golden case; f64 sums of dyadics are
+        # exact in any order so the rust64 path is unaffected).
+        denom = miner_sum(C, keepdims=True)
     scaled = C / denom * 65_535
     return scaled.astype(jnp.int32).astype(out_dtype) / 65_535
 

@@ -717,7 +717,15 @@ def _scan_mats(mode: BondsMode, recompute_prev: bool = False) -> int:
     2-resident+1-extra-temporary count says it should, while the
     5-scenario scratch spelling compiles)."""
     if mode is BondsMode.EMA_PREV:
-        return 3
+        # Same effective total either way — which is WHY the auto
+        # fallback in fused_ema_scan never fires on this model: the
+        # scratch spelling holds 3 resident mats, the recompute
+        # spelling 2 resident plus 1 extra live temporary for the
+        # W * scales[e-1] derivation (both boundaries measured on
+        # chip). Spelled out so a future budget/temporary refinement
+        # flows through instead of silently diverging from
+        # fused_scan_eligible.
+        return (2 + 1) if recompute_prev else 3
     return 2
 
 
@@ -1120,15 +1128,28 @@ def fused_ema_scan(
     return B_final[..., :V, :M], D_tot[..., :V, 0]
 
 
-def _case_scan_mats(mode: BondsMode, save_bonds: bool) -> int:
+def _case_scan_mats(
+    mode: BondsMode, save_bonds: bool, streaming: bool = False
+) -> int:
     """Resident mats of the streamed case scan: the bond scratch, two
     pipelined per-epoch W blocks, the EMA_PREV weight scratch, and (when
-    per-epoch bonds are emitted) two pipelined output blocks."""
+    per-epoch bonds are emitted) two pipelined output blocks.
+    `streaming` adds the chunk-carry residency (`carry=.../
+    return_carry=True`, engine.simulate_streamed): the carry-bonds
+    input is whole-grid resident, and EMA_PREV additionally carries the
+    previous-weights mat in AND emits it out (the consensus rows are
+    [1, Mp]-sized — noise). Without this the admission model under-
+    counts streamed EMA_PREV by three units and Mosaic aborts at
+    dispatch on exactly the beyond-HBM path."""
     mats = 3  # B scratch + double-buffered W blocks
     if mode is BondsMode.EMA_PREV:
         mats += 1
     if save_bonds:
         mats += 2
+    if streaming:
+        mats += 1  # carry bonds input
+        if mode is BondsMode.EMA_PREV:
+            mats += 2  # carry w_prev input + final_w_prev output
     return mats
 
 
@@ -1145,7 +1166,12 @@ def _case_scan_resident_bytes(
 
 
 def fused_case_scan_eligible(
-    shape, mode: BondsMode, config, dtype=None, save_bonds: bool = True
+    shape,
+    mode: BondsMode,
+    config,
+    dtype=None,
+    save_bonds: bool = True,
+    streaming: bool = False,
 ) -> bool:
     """Whether :func:`fused_case_scan` can run this workload — the
     `epoch_impl="auto"` predicate of :func:`..simulation.engine.simulate`:
@@ -1174,7 +1200,7 @@ def fused_case_scan_eligible(
         return False
     Bb = shape[0] if len(shape) == 4 else 1
     unit = _unit_bytes(shape[-2:]) * Bb
-    return _fits_vmem(unit, _case_scan_mats(mode, save_bonds))
+    return _fits_vmem(unit, _case_scan_mats(mode, save_bonds, streaming))
 
 
 def _fused_case_scan_kernel(
@@ -1480,7 +1506,9 @@ def fused_case_scan(
     Vp, Mp = _round_up(V, _SUBLANES), _round_up(M, _LANES)
     if not _fits_vmem(
         _unit_bytes(W.shape[-2:]) * (Bb if lead else 1),
-        _case_scan_mats(mode, save_bonds),
+        _case_scan_mats(
+            mode, save_bonds, streaming=carry is not None or return_carry
+        ),
     ):
         resident = _case_scan_resident_bytes(W.shape, mode, save_bonds)
         raise ValueError(

@@ -63,8 +63,25 @@ def _miner_shardings(mesh: Mesh):
     (SURVEY.md §5): the bisection/sort consensus is per-miner and stays
     shard-local; only the row-normalization sums, consensus-sum divide,
     liquid-alpha quantile sort and dividend reductions cross shards.
+
+    The bitwise sharded == unsharded contract rests on the blocked
+    `miner_sum` spelling, whose 8 fixed blocks are shard-local only
+    when the miner-axis size divides SUM_BLOCKS — a larger mesh would
+    silently reintroduce order-dependent cross-shard combines, so it
+    is rejected here (use up to 8 miner shards; scale the rest of the
+    pod on the data axis).
     """
+    from yuma_simulation_tpu.ops.normalize import SUM_BLOCKS
+
     axis = mesh.axis_names[-1]
+    shards = mesh.shape[axis]
+    if SUM_BLOCKS % shards:
+        raise ValueError(
+            f"miner-axis sharding supports mesh sizes dividing "
+            f"{SUM_BLOCKS} (got {shards}): the partition-invariant "
+            "miner_sum blocks must be shard-local for the bitwise "
+            "sharded==unsharded contract"
+        )
     vm = NamedSharding(mesh, PartitionSpec(None, axis))
     m = NamedSharding(mesh, PartitionSpec(axis))
     return vm, m
@@ -113,6 +130,7 @@ def _resolve_case_engine(
     dtype,
     save_bonds: bool,
     mesh: Optional[Mesh] = None,
+    streaming: bool = False,
 ) -> tuple[str, str]:
     """The ONE engine/consensus resolution for the case-scan entry points
     (`simulate`, `simulate_streamed`, `simulate_generated`): "auto"
@@ -139,7 +157,8 @@ def _resolve_case_engine(
             and consensus_impl in ("auto", "bisect")
             and shape[0] >= 1
             and fused_case_scan_eligible(
-                shape, spec.bonds_mode, config, dtype, save_bonds
+                shape, spec.bonds_mode, config, dtype, save_bonds,
+                streaming=streaming,
             )
         ):
             # Since r4 the MXU scan's consensus support is EXACT (the
@@ -466,7 +485,11 @@ def simulate(
     stack is processed in `[chunk, V, M]` slabs through the chunked
     drivers (:func:`simulate_streamed`) with the carry threaded between
     dispatches — bitwise-identical results with only one chunk of
-    weights resident on device at a time (single-chip only).
+    weights resident on device at a time (single-chip only). Compile
+    note: the chunk length is a static kernel parameter, so a run
+    compiles at most TWO programs (the full-size chunks and one
+    trailing remainder when `E % max_resident_epochs != 0`); pick a
+    divisor of E to compile one.
 
     `epoch_impl`:
       - "auto" (default): run the whole epoch loop as a single Pallas
@@ -711,7 +734,7 @@ def simulate_streamed(
             # the monolithic run.
             impl, xla_consensus = _resolve_case_engine(
                 epoch_impl, consensus_impl, Wc.shape, spec, config, dtype,
-                save_bonds,
+                save_bonds, streaming=True,
             )
             # A zeros carry is bitwise the kernels' own epoch-0 init, and
             # keeps chunk 0 on the SAME compiled program as every later
@@ -867,7 +890,8 @@ def simulate_generated(
     spec = variant_for_version(yuma_version)
     W0, _ = jax.eval_shape(gen_fn, jnp.int32(0))
     impl, consensus_impl = _resolve_case_engine(
-        epoch_impl, consensus_impl, W0.shape, spec, config, W0.dtype, False
+        epoch_impl, consensus_impl, W0.shape, spec, config, W0.dtype, False,
+        streaming=True,
     )
     D, B = _simulate_generated_run(
         config, gen_fn, spec, num_chunks, impl, consensus_impl
