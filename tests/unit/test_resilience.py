@@ -1,0 +1,473 @@
+"""Resilience layer: error taxonomy, engine-degradation ladder, and
+numerical quarantine — every recovery path provoked deterministically on
+CPU via the fault-injection hooks (ISSUE 1 acceptance criteria: a forced
+fused-engine OOM retries and completes on the XLA engine with identical
+results to a clean XLA run; an injected NaN at epoch k quarantines only
+that case while the rest of the batch matches a clean run bitwise)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.models.config import YumaConfig, YumaParams
+from yuma_simulation_tpu.models.variants import variant_for_version
+from yuma_simulation_tpu.resilience import (
+    ENGINE_LADDER,
+    EngineCompileError,
+    EngineLadderExhausted,
+    EngineResourceExhausted,
+    FaultPlan,
+    NaNFault,
+    RetryPolicy,
+    build_quarantine_report,
+    classify_failure,
+    inject_faults,
+    ladder_from,
+)
+from yuma_simulation_tpu.resilience.retry import run_ladder
+from yuma_simulation_tpu.scenarios import create_case, get_cases
+from yuma_simulation_tpu.simulation.engine import simulate, simulate_streamed
+from yuma_simulation_tpu.simulation.sweep import (
+    config_grid,
+    simulate_batch,
+    stack_scenarios,
+    sweep_hyperparams,
+)
+
+VERSION = "Yuma 1 (paper)"
+POLICY = RetryPolicy(max_attempts_per_rung=1, backoff_base=0.0)
+
+
+# ---------------------------------------------------------------- taxonomy
+
+
+def test_classify_failure_maps_messages_to_types():
+    assert isinstance(
+        classify_failure(RuntimeError("RESOURCE_EXHAUSTED: out of memory")),
+        EngineResourceExhausted,
+    )
+    assert isinstance(
+        classify_failure(RuntimeError("ran out of memory while allocating")),
+        EngineResourceExhausted,
+    )
+    assert isinstance(
+        classify_failure(RuntimeError("INTERNAL: Mosaic failed to compile")),
+        EngineCompileError,
+    )
+    # already-typed failures pass through unchanged
+    err = EngineResourceExhausted("x")
+    assert classify_failure(err) is err
+    # caller errors are NOT engine failures: never demoted on
+    assert classify_failure(ValueError("RESOURCE_EXHAUSTED-ish")) is None
+    assert classify_failure(RuntimeError("some unrelated crash")) is None
+
+
+def test_ladder_from_rungs():
+    assert ladder_from("fused_scan_mxu") == ENGINE_LADDER
+    assert ladder_from("fused_scan") == ("fused_scan", "xla")
+    assert ladder_from("xla") == ("xla",)
+    # unknown engines retry in place, never demote across semantics
+    assert ladder_from("hoisted") == ("hoisted",)
+
+
+def test_retry_policy_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts_per_rung=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter=1.5)
+    with pytest.raises(ValueError):
+        RetryPolicy(backoff_factor=0.5)
+
+
+def test_run_ladder_exhaustion_carries_records():
+    def always_oom(rung):
+        raise EngineResourceExhausted(f"no memory on {rung}")
+
+    with pytest.raises(EngineLadderExhausted) as exc:
+        run_ladder(always_oom, "fused_scan", POLICY)
+    records = exc.value.records
+    assert [r.from_engine for r in records] == ["fused_scan"]
+    assert records[0].to_engine == "xla"
+
+
+def test_run_ladder_propagates_caller_errors():
+    calls = []
+
+    def bad_request(rung):
+        calls.append(rung)
+        raise ValueError("caller mistake")
+
+    with pytest.raises(ValueError, match="caller mistake"):
+        run_ladder(bad_request, "fused_scan", POLICY)
+    assert calls == ["fused_scan"]  # no retry, no demotion
+
+
+# ----------------------------------------------------- ladder: fused OOM
+
+
+@pytest.mark.faultinject
+def test_forced_fused_oom_aborts_without_policy():
+    case = create_case("Case 2")
+    with inject_faults(FaultPlan(fused_oom_dispatches=1)):
+        with pytest.raises(EngineResourceExhausted):
+            simulate(
+                case, VERSION, epoch_impl="fused_scan",
+                save_bonds=False, save_incentives=False,
+            )
+
+
+@pytest.mark.faultinject
+def test_fused_oom_demotes_to_xla_bitwise():
+    """Acceptance rung 1: a forced fused-engine OOM retries and completes
+    on the XLA engine with results identical to a clean XLA run."""
+    case = create_case("Case 2")
+    ref = simulate(
+        case, VERSION, epoch_impl="xla",
+        save_bonds=False, save_incentives=False,
+    )
+    with inject_faults(FaultPlan(fused_oom_dispatches=1)):
+        got = simulate(
+            case, VERSION, epoch_impl="fused_scan", retry_policy=POLICY,
+            save_bonds=False, save_incentives=False,
+        )
+    np.testing.assert_array_equal(got.dividends, ref.dividends)
+    assert got.demotions is not None and len(got.demotions) == 1
+    rec = got.demotions[0]
+    assert rec.from_engine == "fused_scan" and rec.to_engine == "xla"
+    assert rec.error_type == "EngineResourceExhausted"
+
+
+@pytest.mark.faultinject
+def test_fused_oom_retries_same_rung_then_succeeds():
+    """A transient failure clears within the rung's retry budget: no
+    demotion, and the fused engine's own (interpret-mode) result."""
+    case = create_case("Case 2")
+    clean = simulate(
+        case, VERSION, epoch_impl="fused_scan",
+        save_bonds=False, save_incentives=False,
+    )
+    with inject_faults(FaultPlan(fused_oom_dispatches=1)):
+        got = simulate(
+            case, VERSION, epoch_impl="fused_scan",
+            retry_policy=RetryPolicy(max_attempts_per_rung=2, backoff_base=0.0),
+            save_bonds=False, save_incentives=False,
+        )
+    assert got.demotions is None
+    np.testing.assert_array_equal(got.dividends, clean.dividends)
+
+
+@pytest.mark.faultinject
+def test_batch_fused_oom_demotes_to_xla_bitwise():
+    cases = get_cases()[:3]
+    spec = variant_for_version(VERSION)
+    cfg = YumaConfig()
+    W, S, ri, re = stack_scenarios(cases)
+    ref = simulate_batch(W, S, ri, re, cfg, spec, epoch_impl="xla")
+    with inject_faults(FaultPlan(fused_oom_dispatches=1)):
+        got = simulate_batch(
+            W, S, ri, re, cfg, spec,
+            epoch_impl="fused_scan", retry_policy=POLICY,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(got["dividends"]), np.asarray(ref["dividends"])
+    )
+
+
+# ------------------------------------------------------ ladder: streamed
+
+
+def _chunks(case, split):
+    W = np.asarray(case.weights)
+    S = np.asarray(case.stakes)
+    out, lo = [], 0
+    for n in split:
+        out.append((W[lo:lo + n], S[lo:lo + n]))
+        lo += n
+    return out
+
+
+@pytest.mark.faultinject
+def test_streamed_fused_oom_demotes_and_restarts_bitwise():
+    """The whole stream restarts on the demoted rung (engines are never
+    mixed mid-stream), and matches the clean XLA streamed run bitwise."""
+    case = create_case("Case 2")
+    chunks = _chunks(case, [20, 20])
+    ref = simulate_streamed(list(chunks), VERSION, epoch_impl="xla")
+    with inject_faults(FaultPlan(fused_oom_dispatches=1)):
+        got = simulate_streamed(
+            list(chunks), VERSION, epoch_impl="fused_scan",
+            retry_policy=POLICY,
+        )
+    np.testing.assert_array_equal(got.dividends, ref.dividends)
+    assert got.demotions[0].from_engine == "fused_scan"
+
+
+@pytest.mark.faultinject
+def test_streamed_generator_first_chunk_failure_replays():
+    """A one-shot generator CAN be replayed when the failure hits the
+    first dispatch: the chunk in hand is re-fed ahead of the untouched
+    remainder."""
+    case = create_case("Case 2")
+    chunks = _chunks(case, [20, 20])
+    ref = simulate_streamed(list(chunks), VERSION, epoch_impl="xla")
+    with inject_faults(FaultPlan(fused_oom_dispatches=1)):
+        got = simulate_streamed(
+            (c for c in chunks), VERSION, epoch_impl="fused_scan",
+            retry_policy=POLICY,
+        )
+    np.testing.assert_array_equal(got.dividends, ref.dividends)
+
+
+@pytest.mark.faultinject
+def test_streamed_generator_midstream_failure_is_explained():
+    """Past the first chunk a one-shot generator cannot be replayed; the
+    error says to pass a re-iterable sequence instead of demoting onto a
+    half-consumed stream."""
+    case = create_case("Case 2")
+    chunks = _chunks(case, [10, 10, 10, 10])
+    with inject_faults(FaultPlan(fused_oom_dispatches=1, fused_oom_skip=2)):
+        with pytest.raises(ValueError, match="re-iterable"):
+            simulate_streamed(
+                (c for c in chunks), VERSION, epoch_impl="fused_scan",
+                retry_policy=POLICY,
+            )
+
+
+@pytest.mark.faultinject
+def test_max_resident_epochs_midstream_failure_restarts():
+    """simulate(max_resident_epochs=...) owns the full arrays, so its
+    chunk stream is re-iterable and a failure past chunk 0 still demotes
+    and restarts instead of aborting."""
+    case = create_case("Case 2")
+    ref = simulate(
+        case, VERSION, epoch_impl="xla",
+        save_bonds=False, save_incentives=False,
+    )
+    with inject_faults(FaultPlan(fused_oom_dispatches=1, fused_oom_skip=1)):
+        got = simulate(
+            case, VERSION, epoch_impl="fused_scan",
+            max_resident_epochs=10, retry_policy=POLICY,
+            save_bonds=False, save_incentives=False,
+        )
+    np.testing.assert_array_equal(got.dividends, ref.dividends)
+    assert got.demotions[0].to_engine == "xla"
+
+
+@pytest.mark.faultinject
+def test_streamed_midstream_failure_restarts_reiterable():
+    """The same mid-stream failure IS recoverable from a re-iterable
+    sequence: full restart on the demoted rung, bitwise clean result."""
+    case = create_case("Case 2")
+    chunks = _chunks(case, [10, 10, 10, 10])
+    ref = simulate_streamed(list(chunks), VERSION, epoch_impl="xla")
+    with inject_faults(FaultPlan(fused_oom_dispatches=1, fused_oom_skip=2)):
+        got = simulate_streamed(
+            list(chunks), VERSION, epoch_impl="fused_scan",
+            retry_policy=POLICY,
+        )
+    np.testing.assert_array_equal(got.dividends, ref.dividends)
+
+
+def test_streamed_rejects_non_bool_save_flags():
+    case = create_case("Case 2")
+    chunks = _chunks(case, [20, 20])
+    for kw in ("save_bonds", "save_incentives", "save_consensus"):
+        with pytest.raises(ValueError, match="True or False"):
+            simulate_streamed(list(chunks), VERSION, **{kw: "auto"})
+
+
+# -------------------------------------------------------------- quarantine
+
+
+@pytest.mark.faultinject
+def test_nan_at_epoch_k_quarantines_only_that_case():
+    """Acceptance rung 2: an injected NaN at epoch k quarantines only
+    that case (masked from epoch k on, with (case, epoch, tensor)
+    provenance) while the rest of the batch matches a clean run
+    bitwise."""
+    cases = get_cases()[:3]
+    spec = variant_for_version(VERSION)
+    cfg = YumaConfig()
+    W, S, ri, re = stack_scenarios(cases)
+    clean = simulate_batch(W, S, ri, re, cfg, spec)
+    k = 2
+    with inject_faults(FaultPlan(nan=NaNFault(epoch=k, case=1))):
+        got = simulate_batch(W, S, ri, re, cfg, spec, quarantine=True)
+    report = build_quarantine_report(got["quarantine"])
+    assert report.quarantined_cases == (1,)
+    assert report.entries[0].epoch == k
+    assert report.entries[0].tensor == "dividends"
+    assert list(report.healthy_mask()) == [True, False, True]
+    d = np.asarray(got["dividends"])
+    dc = np.asarray(clean["dividends"])
+    # healthy lanes: bitwise the clean (unguarded!) run
+    np.testing.assert_array_equal(d[0], dc[0])
+    np.testing.assert_array_equal(d[2], dc[2])
+    # quarantined lane: valid partial results before k, zero-masked after
+    np.testing.assert_array_equal(d[1, :k], dc[1, :k])
+    assert (d[1, k:] == 0).all()
+    assert np.isfinite(d).all()
+
+
+@pytest.mark.faultinject
+def test_nan_without_quarantine_contaminates():
+    """The contrast the quarantine exists for: unguarded, the injected
+    NaN reaches the output stream."""
+    cases = get_cases()[:3]
+    spec = variant_for_version(VERSION)
+    W, S, ri, re = stack_scenarios(cases)
+    with inject_faults(FaultPlan(nan=NaNFault(epoch=2, case=1))):
+        got = simulate_batch(W, S, ri, re, YumaConfig(), spec)
+    assert not np.isfinite(np.asarray(got["dividends"])[1]).all()
+
+
+def test_quarantine_guard_is_value_neutral_for_healthy_batches():
+    cases = get_cases()[:3]
+    spec = variant_for_version(VERSION)
+    W, S, ri, re = stack_scenarios(cases)
+    plain = simulate_batch(W, S, ri, re, YumaConfig(), spec, save_bonds=True)
+    guarded = simulate_batch(
+        W, S, ri, re, YumaConfig(), spec, save_bonds=True, quarantine=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain["dividends"]), np.asarray(guarded["dividends"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain["bonds"]), np.asarray(guarded["bonds"])
+    )
+    report = build_quarantine_report(guarded["quarantine"])
+    assert not report and report.quarantined_cases == ()
+
+
+def test_quarantine_rejects_fused_engine():
+    cases = get_cases()[:2]
+    spec = variant_for_version(VERSION)
+    W, S, ri, re = stack_scenarios(cases)
+    with pytest.raises(ValueError, match="quarantine"):
+        simulate_batch(
+            W, S, ri, re, YumaConfig(), spec,
+            epoch_impl="fused_scan", quarantine=True,
+        )
+
+
+def test_config_grid_nan_lane_quarantined():
+    """A genuinely propagating NaN (a non-finite hyperparameter in a
+    config_grid lane — the kernel is NaN-sanitizing on its array inputs,
+    so hyperparameters are where real sweeps blow up): quarantined with
+    provenance, other grid points bitwise the clean sweep."""
+    case = create_case("Case 2")
+    configs, _ = config_grid(bond_alpha=[0.1, float("nan"), 0.3])
+    ys = sweep_hyperparams(case, VERSION, configs, quarantine=True)
+    report = build_quarantine_report(ys["quarantine"])
+    assert report.quarantined_cases == (1,)
+    # the EMA recurrence first applies the rate at (global) epoch 1
+    assert report.entries[0].epoch == 1
+    clean_cfgs, _ = config_grid(bond_alpha=[0.1, 0.2, 0.3])
+    clean = sweep_hyperparams(case, VERSION, clean_cfgs)
+    np.testing.assert_array_equal(
+        np.asarray(ys["dividends"])[0], np.asarray(clean["dividends"])[0]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ys["dividends"])[2], np.asarray(clean["dividends"])[2]
+    )
+    assert np.isfinite(np.asarray(ys["dividends"])).all()
+
+
+@pytest.mark.faultinject
+def test_simulate_single_scenario_nan_fault_unguarded():
+    """simulate() threads the poison operand too (case=None targets the
+    sole scenario): the NaN lands exactly at the chosen epoch's
+    dividends row and nowhere else (the injection is output-level, so
+    the carry stays clean)."""
+    case = create_case("Case 2")
+    with inject_faults(FaultPlan(nan=NaNFault(epoch=3))):
+        got = simulate(
+            case, VERSION, epoch_impl="xla",
+            save_bonds=False, save_incentives=False,
+        )
+    finite_rows = np.isfinite(got.dividends).all(axis=1)
+    assert not finite_rows[3]
+    assert finite_rows[np.arange(len(finite_rows)) != 3].all()
+
+
+# ------------------------------------------------------------- satellites
+
+
+def test_miner_sharding_rejects_degraded_miner_counts():
+    """ADVICE r5 medium: a multi-miner-shard mesh over an M where
+    miner_sum degrades to a plain reduce must be rejected, not silently
+    stripped of the bitwise sharded==unsharded contract."""
+    from yuma_simulation_tpu.parallel.mesh import make_mesh
+    from yuma_simulation_tpu.scenarios.synthetic import (
+        random_subnet_scenario,
+    )
+
+    mesh = make_mesh(data=4, model=2)
+    for bad_m in (20, 8):  # 20 % 8 != 0; 8 < 2*SUM_BLOCKS
+        scen = random_subnet_scenario(
+            7, num_validators=4, num_miners=bad_m, num_epochs=4
+        )
+        with pytest.raises(ValueError, match="miner"):
+            simulate(scen, VERSION, mesh=mesh)
+    # a single miner shard imposes no M constraint
+    flat = make_mesh(data=8, model=1)
+    scen = random_subnet_scenario(
+        7, num_validators=4, num_miners=20, num_epochs=4
+    )
+    res = simulate(scen, VERSION, mesh=flat)
+    assert np.isfinite(res.dividends).all()
+
+
+def test_fused_eligibility_gated_on_int32_dyadic_bound(monkeypatch):
+    """ADVICE r5 low: beyond the int32 dyadic-quantization bound
+    (M * 2^grid_bits >= 2^31, i.e. M >= 16384 at the default precision)
+    the fused and XLA quantize fallbacks may differ by one ulp, so auto
+    must never pair them: eligibility is off there even where VMEM
+    admission would still pass."""
+    from yuma_simulation_tpu.models.epoch import BondsMode
+    from yuma_simulation_tpu.ops import pallas_epoch
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    cfg = YumaConfig()
+    ok = (4, 4, 8192)
+    too_wide = (4, 4, 16384)
+    assert pallas_epoch.fused_case_scan_eligible(
+        ok, BondsMode.EMA, cfg, jnp.float32, False
+    )
+    assert not pallas_epoch.fused_case_scan_eligible(
+        too_wide, BondsMode.EMA, cfg, jnp.float32, False
+    )
+    assert pallas_epoch.fused_scan_eligible(
+        ok[1:], BondsMode.EMA, cfg, jnp.float32
+    )
+    assert not pallas_epoch.fused_scan_eligible(
+        too_wide[1:], BondsMode.EMA, cfg, jnp.float32
+    )
+
+
+def test_log_event_format(caplog):
+    import logging
+
+    from yuma_simulation_tpu.utils.logging import log_event
+
+    logger = logging.getLogger("yuma_simulation_tpu.test_log_event")
+    with caplog.at_level(logging.WARNING, logger=logger.name):
+        log_event(logger, "engine_demoted", from_engine="a", to_engine="b")
+    assert "event=engine_demoted from_engine=a to_engine=b" in caplog.text
+
+
+def test_inject_faults_rejects_nesting():
+    with inject_faults(FaultPlan()):
+        with pytest.raises(RuntimeError, match="armed"):
+            with inject_faults(FaultPlan()):
+                pass
+
+
+def test_simulate_batch_rejects_unknown_epoch_impl():
+    cases = get_cases()[:2]
+    spec = variant_for_version(VERSION)
+    W, S, ri, re = stack_scenarios(cases)
+    with pytest.raises(ValueError, match="unknown epoch_impl"):
+        simulate_batch(W, S, ri, re, YumaConfig(), spec, epoch_impl="fast")

@@ -773,6 +773,18 @@ def fused_scan_eligible(shape, mode: BondsMode, config, dtype=None) -> bool:
         # advisor r4). The fused paths remain explicit opt-in up to
         # their int32 bound (M * 2^iters < 2^31, enforced in-kernel).
         return False
+    if not _dyadic_grid_fits_int32(
+        shape[-1], math.ceil(math.log2(config.consensus_precision))
+    ):
+        # Beyond the int32 exact-quantization bound the fused kernel's
+        # denominator fallback is a plain in-kernel jnp.sum while the
+        # XLA engine's quantize_u16 falls back to the blocked miner_sum
+        # spelling — for M divisible by 8 the two can differ by one ulp
+        # and flip a u16 cell, exactly where the fused scan could still
+        # be VMEM-eligible (advisor r5 low). auto never pairs the two
+        # fallbacks; explicit fused_scan* opt-in still works up to the
+        # in-kernel int32 bound with the documented one-ulp caveat.
+        return False
     if jax.default_backend() != "tpu":
         return False
     # The EMA_PREV recompute variant (prev weights re-derived from
@@ -1195,6 +1207,14 @@ def fused_case_scan_eligible(
         # flip a u16 cell vs f64 (K >~ 2^23; bounded by M * 2^iters —
         # advisor r4). Explicit fused_scan* opt-in still works up to
         # the in-kernel int32 bound.
+        return False
+    if not _dyadic_grid_fits_int32(
+        shape[-1], math.ceil(math.log2(config.consensus_precision))
+    ):
+        # Same fallback-pairing gate as fused_scan_eligible (advisor r5
+        # low): beyond the int32 bound the fused quantize fallback
+        # (plain jnp.sum) and the XLA fallback (blocked miner_sum) can
+        # drift one ulp, so auto must not pair them.
         return False
     if jax.default_backend() != "tpu":
         return False

@@ -45,7 +45,9 @@ def _pad_batch(n: int, shards: int) -> int:
 
 @partial(
     jax.jit,
-    static_argnames=("spec", "mesh", "save_bonds", "consensus_impl"),
+    static_argnames=(
+        "spec", "mesh", "save_bonds", "consensus_impl", "quarantine"
+    ),
 )
 def _sharded_batch_scan(
     weights,  # [B, E, V, M] sharded over B
@@ -57,11 +59,13 @@ def _sharded_batch_scan(
     mesh: Mesh,
     save_bonds: bool = False,
     consensus_impl: str = "bisect",
+    quarantine: bool = False,
 ):
     def local_batch(W, S, ri, re):
         # Per-shard slice of the scenario batch; the vmap'd scan comes from
         # the one shared batched entry point so sharded and unsharded paths
-        # cannot drift.
+        # cannot drift. The quarantine guard is per-lane state, so it
+        # shards over the scenario axis like every other output.
         return simulate_batch(
             W,
             S,
@@ -72,6 +76,7 @@ def _sharded_batch_scan(
             save_bonds=save_bonds,
             save_incentives=False,
             consensus_impl=consensus_impl,
+            quarantine=quarantine,
         )
 
     # check_vma=False: the bisection fori_loop seeds its carry from
@@ -94,6 +99,7 @@ def simulate_batch_sharded(
     *,
     mesh: Mesh,
     save_bonds: bool = False,
+    quarantine: bool = False,
     dtype=jnp.float32,
 ):
     """Run a scenario suite sharded over the mesh's data axis.
@@ -103,6 +109,14 @@ def simulate_batch_sharded(
     inputs with a `NamedSharding` so each host only materializes its
     shard, and returns per-epoch dividends `[B, E, V]` (plus bonds if
     requested) as numpy.
+
+    `quarantine=True` arms the per-lane non-finite guard
+    (:mod:`..resilience.guards`) inside every shard — at pod scale this
+    is the difference between one poisoned scenario NaN'ing an
+    8192-lane study and that scenario being masked with `(case, epoch,
+    tensor)` provenance: the returned dict gains a `"quarantine"`
+    report (a :class:`..resilience.guards.QuarantineReport` over the
+    un-padded batch).
     """
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
@@ -119,9 +133,19 @@ def simulate_batch_sharded(
     re = jax.device_put(re, sharding)
 
     ys = _sharded_batch_scan(
-        W, S, ri, re, config, spec, mesh, save_bonds=save_bonds
+        W, S, ri, re, config, spec, mesh,
+        save_bonds=save_bonds, quarantine=quarantine,
     )
+    qstate = ys.pop("quarantine", None)
     out = {k: np.asarray(v)[:n] for k, v in ys.items()}
+    if qstate is not None:
+        from yuma_simulation_tpu.resilience.guards import (
+            build_quarantine_report,
+        )
+
+        out["quarantine"] = build_quarantine_report(
+            {k: np.asarray(v)[:n] for k, v in qstate.items()}
+        )
     return out
 
 

@@ -1,0 +1,49 @@
+"""Resilience layer: typed failures, the engine-degradation ladder,
+numerical quarantine, and deterministic fault injection.
+
+Production-scale sweeps are exactly the workload where one bad case in a
+ten-thousand-lane batch, one VMEM-starved fused dispatch, or one torn
+checkpoint chunk must not take down everything else. This package holds
+the pieces the simulation and sweep layers wire together:
+
+- :mod:`.errors` — the typed failure taxonomy + :func:`classify_failure`;
+- :mod:`.retry` — :class:`RetryPolicy` and the explicit engine ladder
+  (fused_scan_mxu -> fused_scan -> xla) with jittered bounded retry;
+- :mod:`.guards` — the opt-in `jnp.isfinite` quarantine folded into the
+  scan carry, plus the host-side :class:`QuarantineReport`;
+- :mod:`.faults` — test-only deterministic fault hooks so every ladder
+  rung and recovery path runs in CPU CI.
+
+See README.md "Failure semantics & recovery" for the operator-facing
+contract.
+"""
+
+from yuma_simulation_tpu.resilience.errors import (  # noqa: F401
+    CheckpointCorruptionError,
+    EngineCompileError,
+    EngineFailure,
+    EngineLadderExhausted,
+    EngineResourceExhausted,
+    NonFiniteOutputError,
+    ResilienceError,
+    classify_failure,
+)
+from yuma_simulation_tpu.resilience.faults import (  # noqa: F401
+    FaultPlan,
+    NaNFault,
+    inject_faults,
+)
+from yuma_simulation_tpu.resilience.guards import (  # noqa: F401
+    QuarantineEntry,
+    QuarantineReport,
+    assert_all_finite,
+    build_quarantine_report,
+)
+from yuma_simulation_tpu.resilience.retry import (  # noqa: F401
+    ENGINE_LADDER,
+    DemotionRecord,
+    RetryPolicy,
+    default_retry_policy,
+    ladder_from,
+    run_ladder,
+)

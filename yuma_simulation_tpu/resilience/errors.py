@@ -1,0 +1,106 @@
+"""Typed failure taxonomy for the resilience layer.
+
+The engines raise (or surface from XLA/Mosaic) a zoo of stringly-typed
+errors: `jaxlib.xla_extension.XlaRuntimeError` with a
+`RESOURCE_EXHAUSTED` status for VMEM/HBM OOM, Mosaic lowering aborts for
+kernel-compile failures, plain `ValueError` for caller mistakes. The
+degradation ladder (:mod:`.retry`) must distinguish "this engine cannot
+run this workload here" (demote a rung and retry) from "the caller's
+request is wrong" (raise immediately) — so every failure the ladder may
+act on is classified into one of the typed exceptions below via
+:func:`classify_failure` before any policy decision is made.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ResilienceError(RuntimeError):
+    """Base class of every typed failure the resilience layer raises."""
+
+
+class EngineFailure(ResilienceError):
+    """An engine could not produce a result for an otherwise-valid
+    request (compile failure, resource exhaustion). Retryable: the
+    ladder may demote to a lower rung."""
+
+
+class EngineCompileError(EngineFailure):
+    """The engine's program failed to compile (Mosaic lowering abort,
+    XLA compile failure)."""
+
+
+class EngineResourceExhausted(EngineFailure):
+    """The engine ran out of device resources (VMEM scratch, HBM,
+    RESOURCE_EXHAUSTED at dispatch)."""
+
+
+class EngineLadderExhausted(EngineFailure):
+    """Every rung of the degradation ladder failed. Carries the
+    per-demotion records so the caller can see the full walk."""
+
+    def __init__(self, message: str, records=()):
+        super().__init__(message)
+        self.records = tuple(records)
+
+
+class NonFiniteOutputError(ResilienceError):
+    """An engine output contained NaN/Inf and no quarantine was armed to
+    contain it (see :mod:`.guards`)."""
+
+
+class CheckpointCorruptionError(ResilienceError):
+    """A checkpoint chunk failed its checksum (or could not be decoded)
+    and re-execution did not heal it."""
+
+
+#: Substrings that identify a resource-exhaustion failure in the raw
+#: message of an XLA/Mosaic error. Checked case-insensitively.
+_RESOURCE_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "ran out of memory",
+    "vmem limit",
+    "exceeds available vmem",
+    "scoped vmem",
+    "allocation failure",
+)
+
+#: Substrings that identify a kernel/program compile failure.
+_COMPILE_MARKERS = (
+    "mosaic failed",
+    "mosaic lowering",
+    "internal: mosaic",
+    "failed to compile",
+    "compilation failure",
+    "unsupported lowering",
+    "xla compilation",
+)
+
+
+def classify_failure(exc: BaseException) -> Optional[EngineFailure]:
+    """Map a raw exception onto the engine-failure taxonomy.
+
+    Returns an :class:`EngineFailure` (the exception itself if already
+    typed, else a new typed wrapper chaining `exc`) when the failure is
+    one the degradation ladder may act on, or ``None`` for everything
+    else — caller errors (`ValueError`/`TypeError`), keyboard
+    interrupts, and unrecognized runtime errors must propagate untouched
+    rather than silently trigger an engine demotion.
+    """
+    if isinstance(exc, EngineFailure):
+        return exc
+    if isinstance(exc, (ValueError, TypeError, KeyboardInterrupt)):
+        return None
+    msg = str(exc).lower()
+    if any(marker in msg for marker in _RESOURCE_MARKERS):
+        err = EngineResourceExhausted(str(exc))
+        err.__cause__ = exc
+        return err
+    if any(marker in msg for marker in _COMPILE_MARKERS):
+        err = EngineCompileError(str(exc))
+        err.__cause__ = exc
+        return err
+    return None

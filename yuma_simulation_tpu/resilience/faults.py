@@ -1,0 +1,203 @@
+"""Deterministic fault injection — test-only hooks for the resilience CI.
+
+Every recovery path in this layer (engine demotion, lane quarantine,
+checkpoint requeue) exists because of failures a CPU test run cannot
+naturally provoke: Mosaic VMEM exhaustion needs a real chip, NaNs need
+pathological inputs, torn checkpoint chunks need a crash at the wrong
+instant. These hooks let a test provoke each one *on purpose and
+deterministically*, so every ladder rung runs in CI under
+`JAX_PLATFORMS=cpu`:
+
+- `FaultPlan.fused_oom_dispatches=N` — the first N fused-engine
+  dispatches raise a simulated :class:`..errors.EngineResourceExhausted`
+  before the kernel is entered;
+- `FaultPlan.nan` — lane `case`'s per-epoch dividends are overwritten
+  with NaN at epoch `epoch`, INSIDE the scan step (a traced select the
+  engines thread through as a poison operand), so the quarantine carry
+  sees the failure exactly where a real numerical blow-up would appear.
+  The injection is at the step's outputs rather than its inputs by
+  necessity: the consensus kernel is reference-faithfully
+  NaN-sanitizing (`nan_to_num` on every bond normalization, `where`
+  guards on every divide), so corrupted input weights/stakes are
+  swallowed before they can reach an output — verified empirically; a
+  genuinely propagating NaN needs a non-finite *hyperparameter*, which
+  the quarantine tests also cover via a NaN config-grid lane;
+- `FaultPlan.truncate_chunks` / `corrupt_chunks` — a just-published
+  checkpoint chunk file is truncated / bit-flipped ONCE (simulating
+  disk corruption between runs), so resume-time checksum verification
+  and requeue are exercised end to end.
+
+The hooks are consulted at host level by the engines and
+`CheckpointedSweep`; with no plan armed (the production state) each is
+a single `is None` check. Arm a plan only via the
+:func:`inject_faults` context manager — it is process-global and
+test-only by design, never part of a production configuration.
+
+Hooks are INERT while a call is being jax-traced
+(:func:`_tracing_now`): a hook firing at trace time would bake the
+armed plan (or its absence) into the persistent jit cache of whatever
+outer program is being traced — e.g. the sharded `shard_map` batch —
+so a later call with the opposite arming state would silently reuse
+the wrong executable. Fault injection therefore targets the host-level
+entry points only, which is where every resilience test drives it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+from yuma_simulation_tpu.resilience.errors import EngineResourceExhausted
+from yuma_simulation_tpu.utils.logging import log_event
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class NaNFault:
+    """Poison scenario lane `case`'s dividends at epoch `epoch` (global
+    epoch index). `case=None` targets a single-scenario run — or every
+    lane of a batch."""
+
+    epoch: int
+    case: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A declarative set of faults to inject. Immutable; the mutable
+    firing state (dispatch counters, one-shot chunk marks) lives in the
+    :class:`_FaultState` the context manager creates."""
+
+    nan: Optional[NaNFault] = None
+    fused_oom_dispatches: int = 0
+    #: fused dispatches to let through before the failures start —
+    #: targets a mid-stream chunk rather than the first dispatch.
+    fused_oom_skip: int = 0
+    #: chunk index -> bytes to KEEP of the published file (truncation).
+    truncate_chunks: dict = dataclasses.field(default_factory=dict)
+    #: chunk indices whose published file gets one byte flipped.
+    corrupt_chunks: tuple = ()
+
+
+class _FaultState:
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.fused_dispatches_seen = 0
+        self.fused_dispatches_failed = 0
+        self.mangled_chunks: set = set()
+
+
+_ACTIVE: Optional[_FaultState] = None
+
+
+def _tracing_now() -> bool:
+    """Whether we are inside a jax trace (jit/vmap/shard_map body).
+    Fault hooks are inert there — see the module docstring."""
+    try:
+        from jax import core
+
+        return not core.trace_state_clean()
+    except Exception:
+        # Fail CLOSED (pretend we are tracing, hooks inert): if a jax
+        # upgrade moves trace_state_clean, the safe failure mode is a
+        # fault test that visibly stops firing — not an armed plan
+        # baked into a production jit cache.
+        return True
+
+
+@contextlib.contextmanager
+def inject_faults(plan: FaultPlan):
+    """Arm `plan` for the duration of the `with` block. Nesting is
+    rejected — overlapping plans would make the injected failures
+    order-dependent, which defeats the point."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("a FaultPlan is already armed; nesting not supported")
+    _ACTIVE = _FaultState(plan)
+    try:
+        yield _ACTIVE
+    finally:
+        _ACTIVE = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE.plan if _ACTIVE is not None else None
+
+
+def maybe_fail_fused_dispatch() -> None:
+    """Engine hook: called immediately before each fused-Pallas dispatch.
+    Raises a simulated resource-exhaustion for the plan's first N calls."""
+    state = _ACTIVE
+    if state is None or state.plan.fused_oom_dispatches <= 0:
+        return
+    if _tracing_now():
+        return
+    state.fused_dispatches_seen += 1
+    if (
+        state.fused_dispatches_seen > state.plan.fused_oom_skip
+        and state.fused_dispatches_failed < state.plan.fused_oom_dispatches
+    ):
+        state.fused_dispatches_failed += 1
+        log_event(
+            logger,
+            "fault_injected",
+            kind="fused_oom",
+            dispatch=state.fused_dispatches_failed,
+        )
+        raise EngineResourceExhausted(
+            "injected fault: simulated RESOURCE_EXHAUSTED on fused dispatch "
+            f"{state.fused_dispatches_failed}/{state.plan.fused_oom_dispatches}"
+        )
+
+
+def active_nan_fault() -> Optional[NaNFault]:
+    """Engine hook: the armed plan's NaN fault, or None. The engines
+    translate it into a per-lane poison-epoch operand threaded into the
+    XLA scan (`-1` = healthy lane), logging one `event=fault_injected`
+    record when armed."""
+    state = _ACTIVE
+    if state is None or state.plan.nan is None:
+        return None
+    if _tracing_now():
+        return None
+    f = state.plan.nan
+    log_event(
+        logger, "fault_injected", kind="nan",
+        case="all" if f.case is None else f.case, epoch=f.epoch,
+    )
+    return f
+
+
+def mangle_chunk_file(path, chunk_index: int) -> None:
+    """Checkpoint hook: called after a chunk is published (written,
+    checksummed, renamed). Truncates or bit-flips the file ONCE per
+    chunk per armed plan — modeling corruption that happens between the
+    publish and a later read, which is exactly what the checksum
+    manifest exists to catch."""
+    state = _ACTIVE
+    if state is None or chunk_index in state.mangled_chunks:
+        return
+    plan = state.plan
+    if chunk_index in plan.truncate_chunks:
+        keep = plan.truncate_chunks[chunk_index]
+        state.mangled_chunks.add(chunk_index)
+        data = path.read_bytes()
+        path.write_bytes(data[:keep])
+        log_event(
+            logger, "fault_injected", kind="truncate_chunk",
+            chunk=chunk_index, kept_bytes=keep,
+        )
+    elif chunk_index in plan.corrupt_chunks:
+        state.mangled_chunks.add(chunk_index)
+        with open(path, "r+b") as f:
+            f.seek(-1, os.SEEK_END)
+            last = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([last[0] ^ 0xFF]))
+        log_event(
+            logger, "fault_injected", kind="corrupt_chunk", chunk=chunk_index
+        )

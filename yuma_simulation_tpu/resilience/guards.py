@@ -1,0 +1,145 @@
+"""Numerical quarantine: lane-local non-finite containment for batches.
+
+A NaN born in one scenario/config lane of a `vmap` batch contaminates
+nothing else *numerically* (lanes are independent), but it contaminates
+everything else *operationally*: `total_dividends_batch`-style reducers
+sum over the batch, streamed accumulators carry it forward, and the
+operator learns only that "the sweep produced NaN" with no idea which of
+ten thousand lanes — or which epoch — went bad.
+
+The quarantine is an opt-in health check folded into the scan carry
+(`guard_nonfinite` on the XLA scan engine): each epoch, the step's
+outputs are `jnp.isfinite`-checked; the first failure latches a
+per-lane `(first_bad_epoch, tensor_code)` provenance record into the
+carry, and from that epoch on the lane's carry and per-epoch outputs
+are masked to zero — the lane is *quarantined*, the rest of the batch
+is bit-for-bit what a clean run produces (for healthy lanes every guard
+op is `where(False, 0, x)`, i.e. the identity on the same values).
+Batched drivers return the partial results plus the per-lane state;
+:func:`build_quarantine_report` turns that state into a host-side
+report of `(case, epoch, tensor)` entries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from yuma_simulation_tpu.resilience.errors import NonFiniteOutputError
+
+#: Tensor names in `tensor_code` order — the priority order the per-epoch
+#: check walks (a NaN usually poisons several tensors at once; the code
+#: records the first in this order so reports are deterministic).
+QUARANTINE_TENSORS = ("dividends", "bonds", "consensus", "w_prev", "incentives")
+
+
+def quarantine_init() -> dict:
+    """The per-lane quarantine carry at epoch 0: healthy, no provenance."""
+    return {
+        "bad": jnp.zeros((), bool),
+        "first_bad_epoch": jnp.full((), -1, jnp.int32),
+        "tensor_code": jnp.full((), -1, jnp.int32),
+    }
+
+
+def quarantine_step(qstate: dict, epoch, tensors: Sequence[tuple]):
+    """Fold one epoch's health check into the quarantine carry.
+
+    `tensors` is a sequence of `(code, array)` with `code` indexing
+    :data:`QUARANTINE_TENSORS`. Returns `(new_qstate, mask)` where
+    `mask(x)` zeroes `x` iff the lane is (now) quarantined — the
+    identity, bitwise, for healthy lanes.
+    """
+    finite = [jnp.all(jnp.isfinite(t)) for _, t in tensors]
+    bad_now = ~jnp.all(jnp.stack(finite))
+    code = jnp.full((), -1, jnp.int32)
+    for (c, _), ok in reversed(list(zip(tensors, finite))):
+        code = jnp.where(ok, code, jnp.int32(c))
+    newly = bad_now & ~qstate["bad"]
+    new_qstate = {
+        "bad": qstate["bad"] | bad_now,
+        "first_bad_epoch": jnp.where(
+            newly, jnp.asarray(epoch, jnp.int32), qstate["first_bad_epoch"]
+        ),
+        "tensor_code": jnp.where(newly, code, qstate["tensor_code"]),
+    }
+
+    def mask(x):
+        return jnp.where(new_qstate["bad"], jnp.zeros_like(x), x)
+
+    return new_qstate, mask
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantineEntry:
+    """One quarantined lane: which case, from which epoch, and the first
+    tensor observed non-finite."""
+
+    case: int
+    epoch: int
+    tensor: str
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantineReport:
+    """Host-side view of a batch's quarantine state.
+
+    `entries` lists quarantined lanes only; `num_cases` is the full
+    batch width so `healthy_mask()` can be used to select the valid
+    rows of the partial results."""
+
+    entries: tuple
+    num_cases: int
+
+    @property
+    def quarantined_cases(self) -> tuple:
+        return tuple(e.case for e in self.entries)
+
+    def healthy_mask(self) -> np.ndarray:
+        mask = np.ones(self.num_cases, bool)
+        for e in self.entries:
+            mask[e.case] = False
+        return mask
+
+    def __bool__(self) -> bool:  # truthy iff anything was quarantined
+        return bool(self.entries)
+
+
+def build_quarantine_report(qstate) -> QuarantineReport:
+    """Convert the device-side per-lane quarantine state (the
+    `"quarantine"` entry of a guarded batch's outputs — scalar per lane,
+    `[B]` after vmap) into a :class:`QuarantineReport`."""
+    bad = np.atleast_1d(np.asarray(qstate["bad"]))
+    first = np.atleast_1d(np.asarray(qstate["first_bad_epoch"]))
+    codes = np.atleast_1d(np.asarray(qstate["tensor_code"]))
+    entries = tuple(
+        QuarantineEntry(
+            case=int(i),
+            epoch=int(first[i]),
+            tensor=(
+                QUARANTINE_TENSORS[int(codes[i])]
+                if 0 <= int(codes[i]) < len(QUARANTINE_TENSORS)
+                else "unknown"
+            ),
+        )
+        for i in np.flatnonzero(bad)
+    )
+    return QuarantineReport(entries=entries, num_cases=int(bad.shape[0]))
+
+
+def assert_all_finite(tree, context: str = "") -> None:
+    """Host-side strict check: raise :class:`NonFiniteOutputError` naming
+    the first non-finite leaf. For callers who want abort-on-NaN rather
+    than quarantine (single-scenario runs, golden pipelines)."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+            where = jax.tree_util.keystr(path)
+            raise NonFiniteOutputError(
+                f"non-finite values in {where}"
+                + (f" ({context})" if context else "")
+            )

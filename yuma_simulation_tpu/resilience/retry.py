@@ -1,0 +1,175 @@
+"""The engine-degradation ladder: bounded retry with explicit demotion.
+
+Engine selection today is resolve-once (`_resolve_case_engine`): "auto"
+picks the flagship fused Pallas case scan when eligible, and a failure
+at compile or dispatch time aborts the whole run. The ladder makes the
+fallback explicit and bounded instead: each case-scan engine has a fixed
+set of strictly-less-demanding rungs below it
+
+    fused_scan_mxu  ->  fused_scan  ->  xla
+
+and a classified engine failure (:func:`..errors.classify_failure`) on
+one rung retries on the same rung up to `max_attempts_per_rung` times
+(jittered exponential backoff — transient VMEM pressure from a
+co-resident program does clear) before *demoting* one rung, emitting a
+structured log record per demotion. Caller errors are never retried.
+The bottom rung is the XLA scan, which has no device-resource
+preconditions; if it too fails, :class:`..errors.EngineLadderExhausted`
+carries the full demotion history.
+
+The ladder deliberately lives OUTSIDE jit: rung choice is a host-side
+control decision (each rung is its own compiled program), so retrying
+costs nothing on the happy path — one predicate check per call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Optional, Sequence
+
+from yuma_simulation_tpu.resilience.errors import (
+    EngineLadderExhausted,
+    classify_failure,
+)
+from yuma_simulation_tpu.utils.logging import log_event
+
+logger = logging.getLogger(__name__)
+
+#: The full case-scan ladder, most- to least-demanding. An explicitly
+#: requested engine starts at its own rung and may only walk DOWN —
+#: demotion must never silently upgrade a run onto an engine the caller
+#: did not ask for.
+ENGINE_LADDER = ("fused_scan_mxu", "fused_scan", "xla")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-retry knobs for the degradation ladder.
+
+    `max_attempts_per_rung` attempts run on each rung before demotion;
+    sleeps between attempts follow `backoff_base * backoff_factor**k`
+    with `+/- jitter` fractional noise. `seed=None` (the default) draws
+    the jitter PRNG from OS entropy per ladder run, so N replicas that
+    fail a shared device simultaneously spread their retries instead of
+    redispatching in lockstep; pass an explicit seed only for
+    reproducible tests.
+    """
+
+    max_attempts_per_rung: int = 2
+    backoff_base: float = 0.05
+    backoff_factor: float = 2.0
+    jitter: float = 0.25
+    seed: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if self.max_attempts_per_rung < 1:
+            raise ValueError("max_attempts_per_rung must be >= 1")
+        if self.backoff_base < 0 or self.backoff_factor < 1:
+            raise ValueError("backoff_base >= 0 and backoff_factor >= 1 required")
+        if not 0 <= self.jitter < 1:
+            raise ValueError("jitter must be in [0, 1)")
+
+    def backoff_seconds(self, attempt: int, rng: random.Random) -> float:
+        """Sleep before retry number `attempt` (0-based) on a rung."""
+        base = self.backoff_base * self.backoff_factor**attempt
+        return base * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
+
+
+def default_retry_policy() -> RetryPolicy:
+    """The production default: two attempts per rung, 50 ms base backoff."""
+    return RetryPolicy()
+
+
+@dataclasses.dataclass(frozen=True)
+class DemotionRecord:
+    """One structured record per ladder demotion (also logged via
+    :func:`..utils.logging.log_event` as `event=engine_demoted`)."""
+
+    from_engine: str
+    to_engine: str
+    attempts: int  # attempts spent on `from_engine` before demoting
+    error_type: str
+    message: str
+
+
+def ladder_from(engine: str) -> tuple:
+    """The rungs at and below `engine`, in demotion order. Unknown
+    engines (e.g. the throughput paths' "fused"/"hoisted") get a
+    single-rung ladder: retry in place, never demote onto a path with
+    different output semantics."""
+    if engine in ENGINE_LADDER:
+        return ENGINE_LADDER[ENGINE_LADDER.index(engine):]
+    return (engine,)
+
+
+def run_ladder(
+    dispatch: Callable[[str], object],
+    engine: str,
+    policy: RetryPolicy,
+    *,
+    rungs: Optional[Sequence[str]] = None,
+    label: str = "",
+):
+    """Run `dispatch(rung)` down the ladder starting at `engine`.
+
+    Returns `(result, engine_used, demotions)` where `demotions` is the
+    list of :class:`DemotionRecord` accumulated on the way down (empty
+    on the happy path). Non-engine failures propagate immediately;
+    exhausting the ladder raises :class:`EngineLadderExhausted` chaining
+    the last rung's failure.
+    """
+    rungs = tuple(rungs) if rungs is not None else ladder_from(engine)
+    rng = random.Random(policy.seed)
+    demotions: list = []
+    last_failure: Optional[BaseException] = None
+    for rung_idx, rung in enumerate(rungs):
+        last_failure = None
+        for attempt in range(policy.max_attempts_per_rung):
+            try:
+                return dispatch(rung), rung, demotions
+            except BaseException as exc:  # noqa: BLE001 — classified below
+                typed = classify_failure(exc)
+                if typed is None:
+                    raise
+                last_failure = typed
+                retries_left = policy.max_attempts_per_rung - attempt - 1
+                if retries_left:
+                    delay = policy.backoff_seconds(attempt, rng)
+                    log_event(
+                        logger,
+                        "engine_retry",
+                        level=logging.INFO,
+                        label=label,
+                        engine=rung,
+                        attempt=attempt + 1,
+                        backoff_s=f"{delay:.3f}",
+                        error=type(typed).__name__,
+                    )
+                    if delay > 0:
+                        time.sleep(delay)
+        if rung_idx + 1 < len(rungs):
+            record = DemotionRecord(
+                from_engine=rung,
+                to_engine=rungs[rung_idx + 1],
+                attempts=policy.max_attempts_per_rung,
+                error_type=type(last_failure).__name__,
+                message=str(last_failure),
+            )
+            demotions.append(record)
+            log_event(
+                logger,
+                "engine_demoted",
+                label=label,
+                from_engine=record.from_engine,
+                to_engine=record.to_engine,
+                attempts=record.attempts,
+                error=record.error_type,
+            )
+    raise EngineLadderExhausted(
+        f"every engine rung failed ({' -> '.join(rungs)}); "
+        f"last: {last_failure}",
+        records=demotions,
+    ) from last_failure

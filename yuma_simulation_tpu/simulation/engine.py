@@ -53,9 +53,13 @@ class SimulationResult:
     bonds: Optional[np.ndarray]  # [E, V, M] post-epoch bond state
     incentives: Optional[np.ndarray]  # [E, M] server incentive
     consensus: Optional[np.ndarray]  # [E, M] quantized consensus
+    #: Engine-ladder demotions taken to produce this result (None when
+    #: the run completed on the first-choice engine or no retry policy
+    #: was armed) — tuple of resilience.retry.DemotionRecord.
+    demotions: Optional[tuple] = None
 
 
-def _miner_shardings(mesh: Mesh):
+def _miner_shardings(mesh: Mesh, num_miners: int):
     """`([V, M], [M])` NamedShardings with the miner axis over the mesh's
     last axis (the ``model`` axis of :func:`..parallel.mesh.make_mesh`).
 
@@ -69,7 +73,13 @@ def _miner_shardings(mesh: Mesh):
     when the miner-axis size divides SUM_BLOCKS — a larger mesh would
     silently reintroduce order-dependent cross-shard combines, so it
     is rejected here (use up to 8 miner shards; scale the rest of the
-    pod on the data axis).
+    pod on the data axis). The same contract also requires the blocked
+    spelling to actually ENGAGE: `miner_sum` degrades to a plain
+    backend-ordered reduce when `M % SUM_BLOCKS != 0` or
+    `M < 2 * SUM_BLOCKS`, so a multi-shard mesh over such a miner count
+    (e.g. M=20 on 2 shards) would silently lose the bitwise guarantee —
+    rejected here too (advisor r5 medium): pad the miner axis to a
+    multiple of SUM_BLOCKS, or run that subnet unsharded.
     """
     from yuma_simulation_tpu.ops.normalize import SUM_BLOCKS
 
@@ -81,6 +91,17 @@ def _miner_shardings(mesh: Mesh):
             f"{SUM_BLOCKS} (got {shards}): the partition-invariant "
             "miner_sum blocks must be shard-local for the bitwise "
             "sharded==unsharded contract"
+        )
+    if shards > 1 and (
+        num_miners % SUM_BLOCKS or num_miners < 2 * SUM_BLOCKS
+    ):
+        raise ValueError(
+            f"miner-axis sharding over {shards} shards requires a miner "
+            f"count that is a multiple of {SUM_BLOCKS} and at least "
+            f"{2 * SUM_BLOCKS} (got M={num_miners}): below that, "
+            "miner_sum's blocked partition-invariant spelling degrades "
+            "to a plain reduce and the bitwise sharded==unsharded "
+            "contract is lost — pad the miner axis or run unsharded"
         )
     vm = NamedSharding(mesh, PartitionSpec(None, axis))
     m = NamedSharding(mesh, PartitionSpec(axis))
@@ -249,6 +270,7 @@ def _apply_reset(B, C_prev, epoch, reset_index, reset_epoch, reset_mode, M):
         "consensus_impl",
         "mesh",
         "return_carry",
+        "guard_nonfinite",
     ),
 )
 def _simulate_scan(
@@ -267,13 +289,45 @@ def _simulate_scan(
     carry: Optional[dict] = None,  # chunked streaming: previous chunk's state
     epoch_offset=0,  # traced int32: global index of this chunk's epoch 0
     return_carry: bool = False,
+    guard_nonfinite: bool = False,
+    nan_fault_epoch: Optional[jnp.ndarray] = None,  # i32 scalar, -1 = off
 ):
+    """`guard_nonfinite` folds the resilience layer's numerical
+    quarantine (:mod:`..resilience.guards`) into the scan carry: each
+    epoch's outputs are isfinite-checked, the first failure latches
+    `(first_bad_epoch, tensor_code)` provenance, and from that epoch on
+    every output of this scenario is masked to zero. In a vmapped batch
+    the state is per-lane, so one poisoned case quarantines alone while
+    healthy lanes stay bit-for-bit identical to an unguarded run (the
+    guard ops are `where(False, 0, x)` there). The final state rides the
+    returned ys as `ys["quarantine"]`.
+
+    `nan_fault_epoch` is the resilience layer's deterministic fault
+    operand (:func:`..resilience.faults.active_nan_fault`): a traced
+    int32 scalar (per lane under vmap) that, when >= 0, overwrites this
+    lane's dividends with NaN at that global epoch — value-neutral
+    (`where(False, nan, x)`) everywhere else. Armed only by
+    fault-injection tests; production dispatches pass None and trace
+    the exact pre-resilience program."""
+    if guard_nonfinite and (carry is not None or return_carry):
+        raise ValueError(
+            "guard_nonfinite does not compose with chunked streaming "
+            "carries; run the quarantine on monolithic scans"
+        )
+    from yuma_simulation_tpu.resilience.guards import (
+        quarantine_init,
+        quarantine_step,
+    )
+
     E, V, M = weights.shape
     dtype = weights.dtype
-    shardings = None if mesh is None else _miner_shardings(mesh)
+    shardings = None if mesh is None else _miner_shardings(mesh, M)
 
     def step(carry, xs):
-        B, W_prev, C_prev = carry
+        if guard_nonfinite:
+            B, W_prev, C_prev, qstate = carry
+        else:
+            B, W_prev, C_prev = carry
         W, S, epoch = xs
         first = epoch == 0
         if shardings is not None:
@@ -325,13 +379,50 @@ def _simulate_scan(
             res["validator_reward_normalized"], S, config, dtype
         )
 
+        if nan_fault_epoch is not None:
+            dividends = jnp.where(
+                epoch == nan_fault_epoch,
+                jnp.asarray(float("nan"), dtype),
+                dividends,
+            )
+
+        if guard_nonfinite:
+            # Priority-ordered health check (codes index
+            # guards.QUARANTINE_TENSORS); the mask zeroes this lane's
+            # carry AND outputs from the first bad epoch on, so the NaN
+            # neither propagates nor reaches the caller's reductions.
+            # The incentive stream is checked only when it is actually
+            # emitted: internally it feeds dividends (already checked),
+            # and the kernel sanitizes it — but the guard's contract is
+            # "every emitted output is isfinite-checked", not "trust the
+            # kernel's internals".
+            checks = [
+                (0, dividends),
+                (1, B_next),
+                (2, C_next),
+                (3, W_prev_next),
+            ]
+            if save_incentives:
+                checks.append((4, res["server_incentive"]))
+            qstate, qmask = quarantine_step(qstate, epoch, checks)
+            dividends = qmask(dividends)
+            B_next = qmask(B_next)
+            W_prev_next = qmask(W_prev_next)
+            C_next = qmask(C_next)
+
         ys = {"dividends": dividends}
         if save_bonds:
             ys["bonds"] = B_next
         if save_incentives:
-            ys["incentives"] = res["server_incentive"]
+            ys["incentives"] = (
+                qmask(res["server_incentive"])
+                if guard_nonfinite
+                else res["server_incentive"]
+            )
         if save_consensus:
             ys["consensus"] = C_next
+        if guard_nonfinite:
+            return (B_next, W_prev_next, C_next, qstate), ys
         return (B_next, W_prev_next, C_next), ys
 
     if carry is None:
@@ -346,12 +437,16 @@ def _simulate_scan(
             jnp.asarray(carry.get("w_prev", jnp.zeros((V, M), dtype)), dtype),
             jnp.asarray(carry["consensus"], dtype),
         )
+    if guard_nonfinite:
+        carry0 = carry0 + (quarantine_init(),)
     xs = (
         weights,
         stakes,
         jnp.arange(E, dtype=jnp.int32) + jnp.asarray(epoch_offset, jnp.int32),
     )
     carry_f, ys = lax.scan(step, carry0, xs)
+    if guard_nonfinite:
+        ys["quarantine"] = carry_f[3]
     if not return_carry:
         return ys
     carry_out = {"bonds": carry_f[0], "consensus": carry_f[2]}
@@ -472,8 +567,18 @@ def simulate(
     dtype=jnp.float32,
     mesh: Optional[Mesh] = None,
     max_resident_epochs: Optional[int] = None,
+    retry_policy=None,
 ) -> SimulationResult:
     """Simulate one scenario under one named version; returns host arrays.
+
+    `retry_policy` (a :class:`..resilience.retry.RetryPolicy`, default
+    None = fail fast exactly as before): arm the engine-degradation
+    ladder. A classified engine failure (VMEM/RESOURCE_EXHAUSTED,
+    Mosaic/XLA compile abort) retries on the same engine with jittered
+    backoff, then demotes one rung — fused_scan_mxu -> fused_scan ->
+    xla — logging one structured `event=engine_demoted` record per step;
+    the demotion history is returned on `SimulationResult.demotions`.
+    Caller errors (bad impl names, shape mistakes) are never retried.
 
     Memory note: `save_bonds`/`save_incentives` default "auto": True (the
     reference driver's outputs, simulation_utils.py:109-112) while the
@@ -541,7 +646,7 @@ def simulate(
                 "be combined with a miner-sharding mesh"
             )
 
-        def chunks():
+        def chunk_gen():
             for lo in range(0, E_, max_resident_epochs):
                 hi = min(lo + max_resident_epochs, E_)
                 yield (
@@ -550,7 +655,11 @@ def simulate(
                 )
 
         return simulate_streamed(
-            chunks(),
+            # Re-iterable (not a one-shot generator): the full arrays
+            # live on the scenario, so an engine demotion under
+            # retry_policy can restart the stream from chunk 0 no
+            # matter which chunk the failure surfaced at.
+            _ReiterableChunks(chunk_gen),
             yuma_version,
             config,
             reset_bonds_index=scenario.reset_bonds_index,
@@ -561,7 +670,10 @@ def simulate(
             consensus_impl=consensus_impl,
             epoch_impl=epoch_impl,
             dtype=dtype,
+            retry_policy=retry_policy,
         )
+    from yuma_simulation_tpu.resilience import faults
+
     weights = jnp.asarray(scenario.weights, dtype)
     stakes = jnp.asarray(scenario.stakes, dtype)
     reset_index = jnp.asarray(
@@ -577,48 +689,86 @@ def simulate(
     # shape-gated sorted/bisect default (the two are bitwise twins —
     # tests/unit/test_consensus_fuzz.py — so this is purely a
     # compile/runtime-cost choice, ops/consensus.py).
+    consensus_req = consensus_impl
     epoch_impl, consensus_impl = _resolve_case_engine(
         epoch_impl, consensus_impl, weights.shape, spec, config, dtype,
         save_bonds, mesh,
     )
-    if epoch_impl in ("fused_scan", "fused_scan_mxu"):
-        ys = _simulate_case_fused(
-            weights,
-            stakes,
-            reset_index,
-            reset_epoch,
-            config,
-            spec,
-            save_bonds=save_bonds,
-            save_incentives=save_incentives,
-            save_consensus=save_consensus,
-            mxu=epoch_impl == "fused_scan_mxu",
-        )
-    else:
-        if mesh is not None:
-            axis = mesh.axis_names[-1]
-            weights = jax.device_put(
-                weights, NamedSharding(mesh, PartitionSpec(None, None, axis))
+
+    def _dispatch(rung: str):
+        if rung in ("fused_scan", "fused_scan_mxu"):
+            faults.maybe_fail_fused_dispatch()
+            out = _simulate_case_fused(
+                weights,
+                stakes,
+                reset_index,
+                reset_epoch,
+                config,
+                spec,
+                save_bonds=save_bonds,
+                save_incentives=save_incentives,
+                save_consensus=save_consensus,
+                mxu=rung == "fused_scan_mxu",
             )
-        ys = _simulate_scan(
-            weights,
-            stakes,
-            reset_index,
-            reset_epoch,
-            config,
-            spec,
-            save_bonds=save_bonds,
-            save_incentives=save_incentives,
-            save_consensus=save_consensus,
-            consensus_impl=consensus_impl,
-            mesh=mesh,
+        else:
+            cons = consensus_impl
+            if rung != epoch_impl:
+                # Demoted off a fused rung: the fused resolution left the
+                # consensus request untouched ("auto"/"bisect"); resolve
+                # it for the XLA engine exactly as a direct request would.
+                from yuma_simulation_tpu.ops.consensus import (
+                    resolve_consensus_impl,
+                )
+
+                cons = resolve_consensus_impl(consensus_req, V_, M_)
+            W = weights
+            if mesh is not None:
+                axis = mesh.axis_names[-1]
+                W = jax.device_put(
+                    W, NamedSharding(mesh, PartitionSpec(None, None, axis))
+                )
+            nf = faults.active_nan_fault()
+            out = _simulate_scan(
+                W,
+                stakes,
+                reset_index,
+                reset_epoch,
+                config,
+                spec,
+                save_bonds=save_bonds,
+                save_incentives=save_incentives,
+                save_consensus=save_consensus,
+                consensus_impl=cons,
+                mesh=mesh,
+                nan_fault_epoch=(
+                    None
+                    if nf is None or nf.case is not None
+                    else jnp.asarray(nf.epoch, jnp.int32)
+                ),
+            )
+        if retry_policy is not None:
+            # Surface async dispatch failures (device OOM) inside the
+            # ladder's try, not at some later host fetch.
+            out = jax.block_until_ready(out)
+        return out
+
+    demotions = None
+    if retry_policy is None:
+        ys = _dispatch(epoch_impl)
+    else:
+        from yuma_simulation_tpu.resilience.retry import run_ladder
+
+        ys, _, records = run_ladder(
+            _dispatch, epoch_impl, retry_policy, label=yuma_version
         )
+        demotions = tuple(records) or None
     ys = jax.device_get(ys)
     return SimulationResult(
         dividends=ys["dividends"],
         bonds=ys.get("bonds"),
         incentives=ys.get("incentives"),
         consensus=ys.get("consensus"),
+        demotions=demotions,
     )
 
 
@@ -658,6 +808,7 @@ def simulate_streamed(
     consensus_impl: str = "bisect",
     epoch_impl: str = "auto",
     dtype=jnp.float32,
+    retry_policy=None,
 ) -> SimulationResult:
     """Chunked epoch streaming: true-per-epoch-weights runs beyond HBM.
 
@@ -687,9 +838,223 @@ def simulate_streamed(
     pinned: mixing engines across chunks would break bitwise equality
     with the monolithic run (fused vs XLA agree only to reduction-order
     rounding).
+
+    `save_bonds`/`save_incentives`/`save_consensus` must be real bools:
+    the `"auto"` resolution of :func:`simulate` is sized against the
+    whole run's output stream, and a lazy chunk stream's total length is
+    unknown here — a string flag would otherwise be treated as truthy
+    and silently materialize the full `[E, V, M]` history the streaming
+    path exists to avoid (advisor r5).
+
+    `retry_policy` arms the engine-degradation ladder around the WHOLE
+    stream: the engine is pinned per attempt, so a demotion restarts the
+    stream from chunk 0 on the lower rung (never mixes engines
+    mid-stream). A one-shot generator can only be replayed when the
+    failure hit the first chunk (the chunk in hand is re-fed); past
+    that, pass a re-iterable sequence to make demotion possible —
+    otherwise a typed ValueError explains exactly that.
     """
+    for name, flag in (
+        ("save_bonds", save_bonds),
+        ("save_incentives", save_incentives),
+        ("save_consensus", save_consensus),
+    ):
+        if not isinstance(flag, bool):
+            raise ValueError(
+                f"simulate_streamed {name} must be True or False, got "
+                f"{flag!r}: the total stream length is unknown up front, "
+                "so 'auto' cannot be sized here (resolve it against the "
+                "full shape via simulate(), or pass an explicit bool)"
+            )
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
+    if retry_policy is not None:
+        return _simulate_streamed_ladder(
+            chunks,
+            yuma_version,
+            config,
+            reset_bonds_index=reset_bonds_index,
+            reset_bonds_epoch=reset_bonds_epoch,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=save_consensus,
+            consensus_impl=consensus_impl,
+            epoch_impl=epoch_impl,
+            dtype=dtype,
+            retry_policy=retry_policy,
+        )
+    return _simulate_streamed_attempt(
+        iter(chunks),
+        yuma_version,
+        config,
+        spec,
+        reset_bonds_index=reset_bonds_index,
+        reset_bonds_epoch=reset_bonds_epoch,
+        save_bonds=save_bonds,
+        save_incentives=save_incentives,
+        save_consensus=save_consensus,
+        consensus_impl=consensus_impl,
+        epoch_impl=epoch_impl,
+        dtype=dtype,
+    )
+
+
+class _ReiterableChunks:
+    """A chunk stream that can be iterated from the start any number of
+    times — `iter()` invokes the factory afresh. What the streamed
+    ladder needs to restart on a demoted engine rung regardless of
+    where in the stream the failure surfaced."""
+
+    def __init__(self, make_iter):
+        self._make_iter = make_iter
+
+    def __iter__(self):
+        return iter(self._make_iter())
+
+
+class _CountingIter:
+    """Iterator wrapper that counts consumed chunks and holds the most
+    recent one, so a failed first-chunk dispatch can be replayed on a
+    lower engine rung without re-materializing the stream."""
+
+    def __init__(self, it):
+        self._it = it
+        self.consumed = 0
+        self.last = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = next(self._it)
+        self.consumed += 1
+        self.last = item
+        return item
+
+
+def _simulate_streamed_ladder(
+    chunks,
+    yuma_version: str,
+    config: YumaConfig,
+    *,
+    reset_bonds_index,
+    reset_bonds_epoch,
+    save_bonds: bool,
+    save_incentives: bool,
+    save_consensus: bool,
+    consensus_impl: str,
+    epoch_impl: str,
+    dtype,
+    retry_policy,
+):
+    """The degradation ladder around a whole chunk stream (see
+    :func:`simulate_streamed`): peek the first chunk to resolve the
+    starting rung, then run each attempt with the engine PINNED; on a
+    classified engine failure restart the stream on the next rung."""
+    import itertools
+
+    from yuma_simulation_tpu.resilience.retry import ladder_from, run_ladder
+
+    spec = variant_for_version(yuma_version)
+    it = iter(chunks)
+    try:
+        first = next(it)
+    except StopIteration:
+        raise ValueError("simulate_streamed received no chunks") from None
+    # Shape-only peek: jnp.asarray here would pin a duplicate
+    # chunk-sized device buffer for the whole ladder run — an extra
+    # [E_chunk, V, M] slab exactly on the path meant to survive
+    # RESOURCE_EXHAUSTED.
+    shape0 = np.shape(first[0])
+    if len(shape0) != 3:
+        raise ValueError(
+            f"streamed chunks must be [E_chunk, V, M], got {shape0}"
+        )
+    impl0, _ = _resolve_case_engine(
+        epoch_impl, consensus_impl, shape0, spec, config, dtype,
+        save_bonds, streaming=True,
+    )
+    # Anything that is not its own iterator (lists, tuples, re-iterable
+    # chunk factories like simulate()'s slab slicer) can restart from
+    # chunk 0; a one-shot generator cannot.
+    import collections.abc
+
+    reiterable = not isinstance(chunks, collections.abc.Iterator)
+    state = {"it": itertools.chain([first], it)}
+
+    def _dispatch(rung: str):
+        tracker = _CountingIter(state["it"])
+        try:
+            return _simulate_streamed_attempt(
+                tracker,
+                yuma_version,
+                config,
+                spec,
+                reset_bonds_index=reset_bonds_index,
+                reset_bonds_epoch=reset_bonds_epoch,
+                save_bonds=save_bonds,
+                save_incentives=save_incentives,
+                save_consensus=save_consensus,
+                consensus_impl=consensus_impl,
+                epoch_impl=rung,
+                dtype=dtype,
+                block_per_chunk=True,
+            )
+        except BaseException as exc:
+            from yuma_simulation_tpu.resilience.errors import classify_failure
+
+            if classify_failure(exc) is None:
+                raise  # caller error: no replay bookkeeping needed
+            if reiterable:
+                state["it"] = iter(chunks)
+            elif tracker.consumed <= 1:
+                # Only the chunk in hand was consumed; re-feed it ahead
+                # of the untouched remainder of the generator.
+                held = [tracker.last] if tracker.last is not None else []
+                state["it"] = itertools.chain(held, tracker._it)
+            else:
+                raise ValueError(
+                    "engine demotion needs to restart the stream from "
+                    f"chunk 0, but {tracker.consumed} chunks of a "
+                    "one-shot generator were already consumed — pass a "
+                    "re-iterable sequence (list/tuple) of chunks to use "
+                    "retry_policy with simulate_streamed"
+                ) from exc
+            raise
+
+    result, _, records = run_ladder(
+        _dispatch,
+        impl0,
+        retry_policy,
+        rungs=ladder_from(impl0),
+        label=f"streamed:{yuma_version}",
+    )
+    result.demotions = tuple(records) or None
+    return result
+
+
+def _simulate_streamed_attempt(
+    chunks,
+    yuma_version: str,
+    config: YumaConfig,
+    spec: VariantSpec,
+    *,
+    reset_bonds_index,
+    reset_bonds_epoch,
+    save_bonds: bool,
+    save_incentives: bool,
+    save_consensus: bool,
+    consensus_impl: str,
+    epoch_impl: str,
+    dtype,
+    block_per_chunk: bool = False,
+) -> SimulationResult:
+    """One engine-pinned pass over the stream — the pre-resilience body
+    of :func:`simulate_streamed`. `block_per_chunk` (ladder mode) waits
+    out each chunk's dispatch so device failures surface at the chunk
+    that caused them, inside the attempt's try."""
+    from yuma_simulation_tpu.resilience import faults
+
     ri = jnp.asarray(
         -1 if reset_bonds_index is None else reset_bonds_index, jnp.int32
     )
@@ -742,6 +1107,7 @@ def simulate_streamed(
             # kernel variant for no numerical difference).
             carry = zero_carry(spec, Wc.shape[-2], Wc.shape[-1], dtype)
         if impl in ("fused_scan", "fused_scan_mxu"):
+            faults.maybe_fail_fused_dispatch()
             ys, carry = _simulate_case_fused(
                 Wc,
                 Sc,
@@ -773,6 +1139,8 @@ def simulate_streamed(
                 epoch_offset=offset,
                 return_carry=True,
             )
+        if block_per_chunk:
+            ys, carry = jax.block_until_ready((ys, carry))
         offset += Wc.shape[0]
         for k in host:
             try:
@@ -1246,7 +1614,7 @@ def simulate_constant(
         )
     V, M = W.shape
     dtype = W.dtype
-    shardings = None if mesh is None else _miner_shardings(mesh)
+    shardings = None if mesh is None else _miner_shardings(mesh, M)
     if shardings is not None:
         W = lax.with_sharding_constraint(W, shardings[0])
 
@@ -1316,7 +1684,7 @@ def _simulate_constant_hoisted(
     if num_epochs < 1:
         raise ValueError("hoist_invariant path requires num_epochs >= 1")
     dtype = W.dtype
-    shardings = None if mesh is None else _miner_shardings(mesh)
+    shardings = None if mesh is None else _miner_shardings(mesh, W.shape[-1])
     if shardings is not None:
         W = lax.with_sharding_constraint(W, shardings[0])
 

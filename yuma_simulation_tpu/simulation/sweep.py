@@ -104,9 +104,53 @@ def stack_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
         "save_bonds",
         "save_incentives",
         "consensus_impl",
-        "epoch_impl",
+        "guard_nonfinite",
     ),
 )
+def _simulate_batch_xla(
+    weights,
+    stakes,
+    reset_index,
+    reset_epoch,
+    config,
+    spec,
+    save_bonds: bool,
+    save_incentives: bool,
+    consensus_impl: str,
+    miner_mask=None,
+    guard_nonfinite: bool = False,
+    nan_fault_epochs=None,  # [B] i32, -1 = healthy lane (fault injection)
+):
+    """The XLA rung of :func:`simulate_batch`: one `vmap` of the scan
+    engine over the scenario axis (and batched config leaves), with the
+    resilience knobs threaded per lane."""
+    batched_cfg = config_is_batched(config)
+    fn = lambda W, S, ri, re, mm, nf, cfg: _simulate_scan(  # noqa: E731
+        W,
+        S,
+        ri,
+        re,
+        cfg,
+        spec,
+        save_bonds=save_bonds,
+        save_incentives=save_incentives,
+        save_consensus=False,
+        consensus_impl=consensus_impl,
+        miner_mask=mm,
+        guard_nonfinite=guard_nonfinite,
+        nan_fault_epoch=nf,
+    )
+    cfg_ax = config_vmap_axes(config) if batched_cfg else None
+    mm_ax = None if miner_mask is None else 0
+    nf_ax = None if nan_fault_epochs is None else 0
+    return jax.vmap(
+        fn, in_axes=(0, 0, 0, 0, mm_ax, nf_ax, cfg_ax)
+    )(
+        weights, stakes, reset_index, reset_epoch, miner_mask,
+        nan_fault_epochs, config,
+    )
+
+
 def simulate_batch(
     weights: jnp.ndarray,  # [B, E, V, M]
     stakes: jnp.ndarray,  # [B, E, V]
@@ -119,6 +163,8 @@ def simulate_batch(
     consensus_impl: str = "bisect",
     miner_mask: Optional[jnp.ndarray] = None,  # [B, M] for padded suites
     epoch_impl: str = "xla",
+    quarantine: bool = False,
+    retry_policy=None,
 ):
     """A scenario suite in one computation.
 
@@ -135,8 +181,36 @@ def simulate_batch(
     (case x beta) product suite): the fused path ships them to the
     kernel as per-scenario hyperparameter vectors and the XLA path
     vmaps over them.
+
+    `quarantine=True` folds the resilience layer's per-lane non-finite
+    guard into the scan carry (XLA engine only — "auto" then resolves
+    to "xla"): a lane whose outputs go NaN/Inf at epoch k is masked to
+    zero from that epoch on and recorded in the returned
+    `ys["quarantine"]` state (`{bad[B], first_bad_epoch[B],
+    tensor_code[B]}` — feed it to
+    :func:`..resilience.guards.build_quarantine_report`), while healthy
+    lanes stay bit-for-bit identical to an unguarded run. Without it a
+    single poisoned lane NaN-contaminates every batch-axis reduction
+    downstream.
+
+    `retry_policy` (a :class:`..resilience.retry.RetryPolicy`) arms the
+    engine-degradation ladder: classified engine failures on a fused
+    rung retry with backoff, then demote toward "xla", logging one
+    `event=engine_demoted` record per step (records are log-only here —
+    the ys dict stays a pure array pytree).
+
+    This wrapper is trace-safe with the default knobs (the sharded
+    `shard_map` path calls it inside jit): resilience hooks reduce to
+    `is None` checks when unarmed.
     """
-    batched_cfg = config_is_batched(config)
+    from yuma_simulation_tpu.resilience import faults
+
+    if quarantine and epoch_impl in ("fused_scan", "fused_scan_mxu"):
+        raise ValueError(
+            "quarantine rides the XLA scan carry; the fused case scan "
+            "cannot host it — use epoch_impl='xla' (or 'auto', which "
+            "resolves to 'xla' under quarantine)"
+        )
     if epoch_impl == "auto":
         from yuma_simulation_tpu.ops.pallas_epoch import (
             exact_mxu_support_covers,
@@ -154,7 +228,8 @@ def simulate_batch(
         # whenever it is eligible, and the production chart/CSV paths
         # ride it too (r4 verdict item 6).
         if (
-            miner_mask is None
+            not quarantine
+            and miner_mask is None
             and consensus_impl in ("auto", "bisect")
             and weights.shape[1] >= 1
             and fused_case_scan_eligible(
@@ -181,47 +256,78 @@ def simulate_batch(
                 f"consensus_impl={consensus_impl!r} requires "
                 "epoch_impl='xla'"
             )
-        from yuma_simulation_tpu.simulation.engine import _simulate_case_fused
-
-        return _simulate_case_fused(
-            weights,
-            stakes,
-            reset_index,
-            reset_epoch,
-            config,
-            spec,
-            save_bonds=save_bonds,
-            save_incentives=save_incentives,
-            save_consensus=False,
-            mxu=epoch_impl == "fused_scan_mxu",
-        )
-    if epoch_impl != "xla":
+    elif epoch_impl != "xla":
         raise ValueError(
             f"unknown epoch_impl {epoch_impl!r} for simulate_batch; "
             "expected 'auto', 'xla', 'fused_scan' or 'fused_scan_mxu'"
         )
-    fn = lambda W, S, ri, re, mm, cfg: _simulate_scan(  # noqa: E731
-        W,
-        S,
-        ri,
-        re,
-        cfg,
-        spec,
-        save_bonds=save_bonds,
-        save_incentives=save_incentives,
-        save_consensus=False,
-        consensus_impl=consensus_impl,
-        miner_mask=mm,
+
+    def _dispatch(rung: str):
+        if rung in ("fused_scan", "fused_scan_mxu"):
+            faults.maybe_fail_fused_dispatch()
+            from yuma_simulation_tpu.simulation.engine import (
+                _simulate_case_fused,
+            )
+
+            out = _simulate_case_fused(
+                weights,
+                stakes,
+                reset_index,
+                reset_epoch,
+                config,
+                spec,
+                save_bonds=save_bonds,
+                save_incentives=save_incentives,
+                save_consensus=False,
+                mxu=rung == "fused_scan_mxu",
+            )
+        else:
+            cons = consensus_impl
+            if cons == "auto":
+                # An "auto" request (always the case when demoted off a
+                # fused rung, whose checks admit only auto/bisect):
+                # resolve for the XLA engine exactly as simulate() does.
+                from yuma_simulation_tpu.ops.consensus import (
+                    resolve_consensus_impl,
+                )
+
+                cons = resolve_consensus_impl(cons, *weights.shape[-2:])
+            nf = faults.active_nan_fault()
+            nf_epochs = None
+            if nf is not None:
+                B = weights.shape[0]
+                lanes = np.full(B, -1, np.int32)
+                if nf.case is None:
+                    lanes[:] = nf.epoch
+                elif 0 <= nf.case < B:
+                    lanes[nf.case] = nf.epoch
+                nf_epochs = jnp.asarray(lanes)
+            out = _simulate_batch_xla(
+                weights,
+                stakes,
+                reset_index,
+                reset_epoch,
+                config,
+                spec,
+                save_bonds=save_bonds,
+                save_incentives=save_incentives,
+                consensus_impl=cons,
+                miner_mask=miner_mask,
+                guard_nonfinite=quarantine,
+                nan_fault_epochs=nf_epochs,
+            )
+        if retry_policy is not None:
+            out = jax.block_until_ready(out)
+        return out
+
+    if retry_policy is None:
+        return _dispatch(epoch_impl)
+    from yuma_simulation_tpu.resilience.retry import run_ladder
+
+    ys, _, _ = run_ladder(
+        _dispatch, epoch_impl, retry_policy, label="simulate_batch"
     )
-    cfg_ax = config_vmap_axes(config) if batched_cfg else None
-    if miner_mask is None:
-        return jax.vmap(
-            lambda W, S, ri, re, cfg: fn(W, S, ri, re, None, cfg),
-            in_axes=(0, 0, 0, 0, cfg_ax),
-        )(weights, stakes, reset_index, reset_epoch, config)
-    return jax.vmap(fn, in_axes=(0, 0, 0, 0, 0, cfg_ax))(
-        weights, stakes, reset_index, reset_epoch, miner_mask, config
-    )
+    return ys
 
 
 def sweep_hyperparams(
@@ -230,10 +336,19 @@ def sweep_hyperparams(
     configs: YumaConfig,
     *,
     save_bonds: bool = False,
+    quarantine: bool = False,
     dtype=jnp.float32,
 ):
     """`vmap` one scenario over a batched config pytree (stacked float
     leaves, shared static fields). Build `configs` with :func:`config_grid`.
+
+    `quarantine=True` arms the per-lane non-finite guard exactly as in
+    :func:`simulate_batch` — here a lane is one hyperparameter grid
+    point, which is the batch axis where NaNs actually originate (a
+    pathological `bond_alpha`/`kappa` value poisons its own recurrence
+    while every other grid point is fine): the bad lane is masked and
+    recorded in `ys["quarantine"]`, the rest of the grid returns
+    bit-for-bit the unguarded values.
     """
     spec = variant_for_version(yuma_version)
     W = jnp.asarray(scenario.weights, dtype)
@@ -256,6 +371,7 @@ def sweep_hyperparams(
         save_bonds=save_bonds,
         save_incentives=False,
         save_consensus=False,
+        guard_nonfinite=quarantine,
     )
     return jax.vmap(fn)(configs)
 

@@ -7,6 +7,36 @@ import logging
 import sys
 
 
+def log_event(
+    logger: logging.Logger,
+    event: str,
+    *,
+    level: int = logging.WARNING,
+    **fields,
+) -> None:
+    """Emit one machine-greppable `event=<name> key=value ...` record.
+
+    The resilience layer's contract (engine demotions, quarantined
+    lanes, checkpoint requeues) is that every recovery action leaves
+    exactly one such line, so an operator can `grep event=` a sweep's
+    log and reconstruct what degraded where — values are flat scalars
+    on one line, not multi-line prose. Values containing whitespace,
+    `=` or quotes (free-text labels, error messages) are double-quoted
+    with inner quotes escaped so a key=value tokenizer still parses the
+    record. Empty-string fields are dropped (optional labels)."""
+
+    def fmt(v) -> str:
+        s = str(v)
+        if any(c in s for c in (" ", "\t", "=", '"')):
+            return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+        return s
+
+    payload = " ".join(
+        f"{k}={fmt(v)}" for k, v in fields.items() if v != ""
+    )
+    logger.log(level, "event=%s%s", event, f" {payload}" if payload else "")
+
+
 def setup_logging(level: int = logging.INFO) -> None:
     """Configure framework-wide logging once, idempotently.
 
