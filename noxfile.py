@@ -34,14 +34,22 @@ def format_(session: nox.Session) -> None:
 
 @nox.session
 def lint(session: nox.Session) -> None:
-    session.install("ruff==0.8.4")
+    """Static guarantees (README "Static guarantees"): the project's own
+    TPU-discipline analyzer (tools/jaxlint — stdlib-ast, --strict also
+    fails on rotted suppressions), ruff, and mypy over the TPU package."""
+    session.install("ruff==0.8.4", "mypy==1.13.0", "-e", ".")
+    session.run("python", "-m", "tools.jaxlint", "yuma_simulation_tpu", "--strict")
     session.run("ruff", "check", *LINT_TARGETS)
+    session.run("mypy", "yuma_simulation_tpu")
 
 
 @nox.session
 def typecheck(session: nox.Session) -> None:
+    """mypy over the legacy compat package only — the TPU package is
+    typechecked by the lint session above; keeping it out of here stops
+    the default `nox` run paying the same mypy pass twice."""
     session.install("mypy==1.13.0", "-e", ".")
-    session.run("mypy", "yuma_simulation_tpu", "yuma_simulation")
+    session.run("mypy", "yuma_simulation")
 
 
 #: One pytest process per group: several hundred distinct XLA-CPU
@@ -63,6 +71,8 @@ TEST_CHUNKS = [
         "tests/unit/test_fused_epoch.py",
         "tests/unit/test_hoisted.py",
         "tests/unit/test_kernels.py",
+        "tests/unit/test_resilience.py",
+        "tests/unit/test_resilience_checkpoint.py",
     ],
     [
         "tests/unit/test_multichip.py",
@@ -77,6 +87,8 @@ TEST_CHUNKS = [
         "tests/unit/test_trajectory_golden.py",
         "tests/unit/test_utils.py",
         "tests/unit/test_distributed_multiprocess.py",
+        "tests/unit/test_jaxlint.py",
+        "tests/unit/test_recompilation.py",
     ],
 ]
 

@@ -1,0 +1,306 @@
+"""jaxlint: per-rule fixture corpus + the live-codebase-clean gate.
+
+Each rule gets one known-violating and one known-clean snippet (the
+clean twin exercises the refinement that keeps the rule quiet on the
+real codebase: static_argnames exemptions, `is None` tests, host-call
+boundaries, dtype'd literals, ...). The final test runs the real CLI
+over the installed package with --strict and requires exit 0 — the
+acceptance gate that keeps the tree violation-free.
+"""
+
+import os
+
+import pytest
+
+from tools.jaxlint import RULES, analyze_source, main
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def codes_of(src: str, path: str = "fixture.py") -> list[str]:
+    return [f.code for f in analyze_source(src, path).findings]
+
+
+# --------------------------------------------------------------------------
+# fixture corpus: (rule, violating snippet, clean twin, path)
+
+CORPUS = {
+    "JX001": (
+        # str param traced -> recompile per value
+        """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("mode",))
+def f(x, mode: str = "a", impl: str = "xla"):
+    return x
+""",
+        # everything str/bool-typed is static; unannotated bool default
+        # (the traced-first_epoch idiom) is deliberately exempt
+        """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("mode", "impl", "save"))
+def f(x, mode: str = "a", impl: str = "xla", save: bool = True,
+      first_epoch=False):
+    return x
+""",
+    ),
+    "JX002": (
+        """
+import jax
+
+@jax.jit
+def f(x):
+    y = x + 1
+    return float(y.sum())
+""",
+        # casts of host constants are fine, as is np on untraced shapes
+        """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    scale = float(2**17)
+    n = np.prod(x.shape)
+    return x * scale + n
+""",
+    ),
+    "JX003": (
+        """
+import jax
+
+@jax.jit
+def f(x):
+    if x.sum() > 0:
+        return x
+    while x[0] > 0:
+        x = x - 1
+    return -x
+""",
+        # static-arg branches, `is None` structure checks, .shape gates
+        # and host-predicate calls are all legitimate trace-time branches
+        """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("mode",))
+def f(x, carry=None, mode: str = "a"):
+    if carry is not None:
+        x = x + carry
+    if mode == "a":
+        x = -x
+    E, V = x.shape
+    if V > 4:
+        x = x * 2
+    if eligibility_gate(x.shape, x):
+        x = x + 1
+    return x
+""",
+    ),
+    "JX004": (
+        """
+import jax
+from yuma_simulation_tpu.resilience.faults import maybe_fail_fused_dispatch
+
+@jax.jit
+def f(x):
+    maybe_fail_fused_dispatch()
+    return x
+""",
+        # host-level dispatch wrapper (not jitted) is where hooks belong
+        """
+from yuma_simulation_tpu.resilience import faults
+
+def dispatch(x):
+    faults.maybe_fail_fused_dispatch()
+    return _jitted_engine(x)
+""",
+    ),
+    "JX005": (
+        """
+import jax.numpy as jnp
+
+def poison():
+    return jnp.asarray(float("nan"))
+""",
+        """
+import jax.numpy as jnp
+
+def poison(dtype):
+    return jnp.asarray(float("nan"), dtype=dtype)
+
+def sentinel():
+    return jnp.asarray(-1, jnp.int32)
+""",
+    ),
+    "JX006": (
+        """
+import jax
+import time
+import random
+
+@jax.jit
+def f(x):
+    return x * time.time() + random.random()
+""",
+        # host-side timing around a jitted call is the supported pattern,
+        # as is jax.random with explicit keys inside
+        """
+import jax
+import time
+
+@jax.jit
+def f(x, key):
+    return x + jax.random.normal(key, x.shape)
+
+def bench(x, key):
+    t0 = time.perf_counter()
+    f(x, key)
+    return time.perf_counter() - t0
+""",
+    ),
+    "JX007": (
+        """
+from yuma_simulation._internal.cases import build
+from yuma_simulation_tpu.simulation.engine import _simulate_scan
+""",
+        # public names (aliased privately) from public modules are fine
+        """
+from yuma_simulation_tpu.simulation.engine import run_simulation
+from yuma_simulation_tpu.simulation.sweep import (
+    pad_scenarios as _pad_scenarios,
+)
+""",
+    ),
+    "JX008": (
+        """
+from jax import lax
+
+def run(xs, step):
+    carry0 = (1, {"bonds": 0})
+    out, _ = lax.scan(step, carry0, xs)
+    final, _ = lax.scan(step, (0, 0), xs)
+    return out, final
+""",
+        """
+from jax import lax
+from yuma_simulation_tpu.simulation.carry import TotalsCarry
+
+def run(xs, step, z):
+    carry0 = TotalsCarry(bonds=z, w_prev=z, consensus=z, acc=z)
+    out, _ = lax.scan(step, carry0, xs)
+    return out
+""",
+    ),
+}
+
+#: rules whose scope is path-filtered
+_RULE_PATHS = {
+    "JX007": "yuma_simulation_tpu/v1/api.py",
+    "JX008": "yuma_simulation_tpu/simulation/engine.py",
+}
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_rule_fires_on_violating_fixture(rule):
+    bad, _ = CORPUS[rule]
+    path = _RULE_PATHS.get(rule, "fixture.py")
+    assert rule in codes_of(bad, path), f"{rule} did not fire on its fixture"
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_rule_quiet_on_clean_fixture(rule):
+    _, clean = CORPUS[rule]
+    path = _RULE_PATHS.get(rule, "fixture.py")
+    got = codes_of(clean, path)
+    assert rule not in got, f"{rule} false-positived on its clean twin: {got}"
+
+
+def test_path_scoped_rules_silent_off_scope():
+    """JX007/JX008 are scoped to v1 modules / engine.py; the same source
+    elsewhere is intentionally not their business."""
+    assert "JX007" not in codes_of(CORPUS["JX007"][0], "scripts/tool.py")
+    assert "JX008" not in codes_of(CORPUS["JX008"][0], "pkg/other.py")
+
+
+def test_suppression_comment_and_unused_tracking():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def g():\n"
+        "    return jnp.asarray(1.5)  # jaxlint: disable=JX005\n"
+        "x = 1  # jaxlint: disable=JX001\n"
+    )
+    rep = analyze_source(src, "s.py")
+    assert rep.findings == []
+    assert rep.suppressed == 1
+    assert rep.unused_suppressions == [(4, frozenset({"JX001"}))]
+    # a bare disable suppresses every rule on the line
+    rep2 = analyze_source(
+        "import jax.numpy as jnp\n"
+        "def g():\n"
+        "    return jnp.asarray(1.5)  # jaxlint: disable\n",
+        "s.py",
+    )
+    assert rep2.findings == [] and rep2.suppressed == 1
+
+
+def test_wrong_code_suppression_does_not_silence():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def g():\n"
+        "    return jnp.asarray(1.5)  # jaxlint: disable=JX001\n"
+    )
+    rep = analyze_source(src, "s.py")
+    assert [f.code for f in rep.findings] == ["JX005"]
+
+
+def test_parse_error_reported_not_crashed():
+    rep = analyze_source("def broken(:\n", "bad.py")
+    assert [f.code for f in rep.findings] == ["JX999"]
+
+
+def test_rule_registry_covers_corpus():
+    assert set(CORPUS) == set(RULES)
+
+
+def test_live_codebase_is_clean_strict(capsys):
+    """The acceptance gate: `python -m tools.jaxlint yuma_simulation_tpu/
+    --strict` exits 0 on the repo (no violations, no rotting
+    suppressions)."""
+    pkg = os.path.join(REPO, "yuma_simulation_tpu")
+    rc = main([pkg, "--strict"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"jaxlint --strict found violations:\n{out}"
+
+
+def test_cli_json_output_and_exit_code(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def g():\n"
+        "    return jnp.asarray(2.5)\n"
+    )
+    rc = main([str(bad), "--format", "json"])
+    assert rc == 1
+    import json
+
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["files_analyzed"] == 1
+    (finding,) = payload["findings"]
+    assert finding["code"] == "JX005" and finding["line"] == 3
+    assert finding["rule"] == "dtypeless-literal"
+
+
+def test_cli_select_and_strict_unused(tmp_path, capsys):
+    f = tmp_path / "m.py"
+    f.write_text("x = 1  # jaxlint: disable=JX005\n")
+    assert main([str(f)]) == 0  # unused suppression is a note by default
+    assert main([str(f), "--strict"]) == 1  # ...and fails under --strict
+    capsys.readouterr()
+    # --select limits the rule set; unknown codes are a usage error
+    assert main([str(f), "--select", "JX001"]) == 0
+    with pytest.raises(SystemExit):
+        main([str(f), "--select", "JX42"])

@@ -1,0 +1,114 @@
+"""RecompilationSentinel: compile-budget enforcement on the hot paths.
+
+The static side of the recompilation story is jaxlint JX001 (str/bool
+params must be static); this pins the runtime side: the hot
+`simulate_batch` / `sweep_hyperparams` engines must be compile-free on
+warm repeat calls, and a hash-unstable static argument (fresh cache key
+per call — the classic silent-retrace bug) must fail the budget loudly.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.variants import variant_for_version
+from yuma_simulation_tpu.scenarios import create_case, get_cases
+from yuma_simulation_tpu.simulation.engine import _simulate_scan
+from yuma_simulation_tpu.simulation.sweep import (
+    _simulate_batch_xla,
+    config_grid,
+    simulate_batch,
+    stack_scenarios,
+    sweep_hyperparams,
+)
+from yuma_simulation_tpu.utils.profiling import (
+    RecompilationBudgetExceeded,
+    RecompilationSentinel,
+)
+
+
+def test_sweep_hyperparams_warm_repeat_is_compile_free():
+    case = create_case("Case 2")
+    configs, _ = config_grid(bond_penalty=[0.0, 0.5, 1.0])
+    args = (case, "Yuma 1 (paper)", configs)
+    sweep_hyperparams(*args)  # warm-up: pays the one cold compile
+    with RecompilationSentinel(
+        _simulate_scan, budget=0, label="sweep_hyperparams warm repeat"
+    ) as sentinel:
+        ys = sweep_hyperparams(*args)
+    assert sentinel.new_entries == 0
+    assert np.isfinite(np.asarray(ys["dividends"])).all()
+
+
+def test_simulate_batch_warm_repeat_is_compile_free():
+    cases = get_cases()[:3]
+    W, S, ri, re = stack_scenarios(cases)
+    cfg = YumaConfig()
+    spec = variant_for_version("Yuma 1 (paper)")
+    simulate_batch(W, S, ri, re, cfg, spec)  # warm-up
+    with RecompilationSentinel(
+        _simulate_batch_xla,
+        _simulate_scan,
+        budget=0,
+        label="simulate_batch warm repeat",
+    ) as sentinel:
+        simulate_batch(W, S, ri, re, cfg, spec)
+    assert sentinel.new_entries == 0
+
+
+class _IdentityHashedSpec:
+    """A 'static' argument whose equality is object identity: every
+    instance is a fresh jit-cache key — the silent-retrace bug the
+    sentinel exists to catch."""
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _engine_with_unstable_static(x, spec):
+    del spec
+    return x * 2
+
+
+def test_sentinel_fails_on_hash_unstable_static_arg():
+    x = jnp.ones(8)
+    _engine_with_unstable_static(x, _IdentityHashedSpec())  # warm-up
+    with pytest.raises(RecompilationBudgetExceeded, match="compile budget"):
+        with RecompilationSentinel(
+            _engine_with_unstable_static, budget=0, label="unstable static"
+        ):
+            # a *fresh* spec instance per call -> one new cache entry each
+            _engine_with_unstable_static(x, _IdentityHashedSpec())
+            _engine_with_unstable_static(x, _IdentityHashedSpec())
+
+
+def test_sentinel_budget_allows_declared_cold_compiles():
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    with RecompilationSentinel(f, budget=2, label="cold region") as s:
+        f(jnp.ones(3))  # 1st shape -> compile
+        f(jnp.ones(4))  # 2nd shape -> compile
+    assert s.new_entries == 2
+    assert s.report[f.__qualname__][1] - s.report[f.__qualname__][0] == 2
+
+
+def test_sentinel_does_not_mask_region_exception():
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    with pytest.raises(ValueError, match="inner"):
+        with RecompilationSentinel(f, budget=0):
+            f(jnp.ones(5))  # would blow the budget...
+            raise ValueError("inner")  # ...but the real failure wins
+
+
+def test_sentinel_rejects_unjitted_callables():
+    with pytest.raises(TypeError, match="_cache_size"):
+        RecompilationSentinel(lambda x: x)
+    with pytest.raises(ValueError, match="at least one"):
+        RecompilationSentinel()

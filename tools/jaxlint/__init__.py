@@ -1,0 +1,16 @@
+"""jaxlint: AST-based TPU-discipline analyzer for yuma_simulation_tpu.
+
+Eight project-specific rules (JX001-JX008) over stdlib ``ast`` — no new
+dependencies. See :mod:`tools.jaxlint.analyzer` for the rule registry and
+the taint model, :mod:`tools.jaxlint.cli` for the CLI
+(``python -m tools.jaxlint yuma_simulation_tpu/ --strict``).
+"""
+
+from tools.jaxlint.analyzer import (  # noqa: F401
+    RULES,
+    FileReport,
+    Finding,
+    analyze_paths,
+    analyze_source,
+)
+from tools.jaxlint.cli import main  # noqa: F401

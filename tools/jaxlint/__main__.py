@@ -1,0 +1,7 @@
+"""``python -m tools.jaxlint`` entry point."""
+
+import sys
+
+from tools.jaxlint.cli import main
+
+sys.exit(main())

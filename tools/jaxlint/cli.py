@@ -1,0 +1,151 @@
+"""jaxlint command line: ``python -m tools.jaxlint yuma_simulation_tpu/``.
+
+Exit codes: 0 clean, 1 findings (with ``--strict`` also unused
+suppressions), 2 usage errors. Output formats: ``human`` (one
+``path:line:col: CODE message`` per finding) and ``json`` (a single
+object with findings, suppression stats, and the rule registry — stable
+for CI consumption).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from tools.jaxlint.analyzer import RULES, analyze_paths
+
+
+def _rule_set(spec: Optional[str], base: set[str]) -> set[str]:
+    if not spec:
+        return base
+    requested = {c.strip() for c in spec.split(",") if c.strip()}
+    unknown = requested - set(RULES)
+    if unknown:
+        raise SystemExit(
+            f"jaxlint: unknown rule code(s): {', '.join(sorted(unknown))}"
+        )
+    return requested
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="jaxlint",
+        description=(
+            "AST-based TPU-discipline analyzer for yuma_simulation_tpu "
+            "(tracer leaks, recompilation triggers, engine-contract "
+            "violations)"
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["yuma_simulation_tpu"],
+        help="files or directories to analyze (default: yuma_simulation_tpu)",
+    )
+    parser.add_argument(
+        "--format", choices=("human", "json"), default="human",
+        help="output format (default: human)",
+    )
+    parser.add_argument(
+        "--select", metavar="CODES",
+        help="comma-separated rule codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--ignore", metavar="CODES",
+        help="comma-separated rule codes to skip",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="also fail on unused suppression comments (keeps "
+        "`# jaxlint: disable` lines from rotting)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule registry"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code, (name, summary) in sorted(RULES.items()):
+            print(f"{code} [{name}]\n    {summary}")
+        return 0
+
+    select = _rule_set(args.select, set(RULES))
+    select -= _rule_set(args.ignore, set())
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(
+            f"jaxlint: path does not exist: {', '.join(missing)}",
+            file=sys.stderr,
+        )
+        return 2
+    reports = analyze_paths(args.paths, select)
+    if not reports:
+        print("jaxlint: no python files found under "
+              f"{', '.join(args.paths)}", file=sys.stderr)
+        return 2
+
+    # Parse failures ride the findings list as JX999 entries, so they
+    # share the findings exit path below.
+    findings = [f for r in reports for f in r.findings]
+    suppressed = sum(r.suppressed for r in reports)
+    unused = [
+        (r.path, line, codes)
+        for r in reports
+        for line, codes in r.unused_suppressions
+    ]
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        {
+                            "path": f.path,
+                            "line": f.line,
+                            "col": f.col,
+                            "code": f.code,
+                            "rule": RULES.get(f.code, ("parse-error",))[0],
+                            "message": f.message,
+                        }
+                        for f in findings
+                    ],
+                    "files_analyzed": len(reports),
+                    "suppressed": suppressed,
+                    "unused_suppressions": [
+                        {
+                            "path": p,
+                            "line": line,
+                            "codes": sorted(codes) if codes else None,
+                        }
+                        for p, line, codes in unused
+                    ],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        for p, line, codes in unused:
+            label = ",".join(sorted(codes)) if codes else "all"
+            print(
+                f"{p}:{line}:0: note: unused suppression ({label})"
+                + (" [--strict fails on this]" if not args.strict else "")
+            )
+        summary = (
+            f"jaxlint: {len(findings)} finding(s) in {len(reports)} "
+            f"file(s), {suppressed} suppressed, {len(unused)} unused "
+            "suppression(s)"
+        )
+        print(summary)
+
+    if findings:
+        return 1
+    if args.strict and unused:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -341,6 +341,7 @@ def _montecarlo_varying_run(
 
     from yuma_simulation_tpu.models.epoch import BondsMode
     from yuma_simulation_tpu.ops.normalize import normalize_weight_rows
+    from yuma_simulation_tpu.simulation.carry import TotalsCarry
     from yuma_simulation_tpu.simulation.engine import _dividends_per_1k
 
     V, M = base_weights.shape
@@ -351,7 +352,7 @@ def _montecarlo_varying_run(
 
         def one(k):
             def step(carry, epoch):
-                B, W_prev, C_prev, acc = carry
+                B, W_prev = carry.bonds, carry.w_prev
                 eps = perturbation * jax.random.normal(
                     jax.random.fold_in(k, epoch), (V, M), dtype
                 )
@@ -382,22 +383,25 @@ def _montecarlo_varying_run(
                     res["weight"] if spec.carries_prev_weights else W_prev
                 )
                 return (
-                    res[spec.bond_state_key],
-                    W_prev_next,
-                    res["server_consensus_weight"],
-                    acc + d,
-                ), None
+                    TotalsCarry(
+                        bonds=res[spec.bond_state_key],
+                        w_prev=W_prev_next,
+                        consensus=res["server_consensus_weight"],
+                        acc=carry.acc + d,
+                    ),
+                    None,
+                )
 
-            carry0 = (
-                jnp.zeros((V, M), dtype),
-                jnp.zeros((V, M), dtype),
-                jnp.zeros((M,), dtype),
-                jnp.zeros((V,), dtype),
+            carry0 = TotalsCarry(
+                bonds=jnp.zeros((V, M), dtype),
+                w_prev=jnp.zeros((V, M), dtype),
+                consensus=jnp.zeros((M,), dtype),
+                acc=jnp.zeros((V,), dtype),
             )
-            (_, _, _, total), _ = lax.scan(
+            final, _ = lax.scan(
                 step, carry0, jnp.arange(num_epochs, dtype=jnp.int32)
             )
-            return total  # [V]
+            return final.acc  # [V]
 
         return jax.vmap(one)(jax.random.split(shard_key, per_shard))
 

@@ -1,0 +1,79 @@
+"""Registered pytree dataclasses for the engine's ``lax.scan`` carries.
+
+jaxlint rule JX008: every scan carry the engine constructs must be one
+of these, never a raw tuple-of-dicts. The positional-tuple carries the
+engine grew up with had two failure modes this fixes structurally:
+
+- *positional-unpack drift*: adding a field (the quarantine state in
+  PR 1) renumbers every ``carry[i]`` access, and a missed site reads the
+  wrong tensor without any error — the dataclass gives each leg a stable
+  name;
+- *silent structure forks*: a tuple carry built slightly differently at
+  two sites (e.g. ``()+()`` concatenation vs a literal) still traces,
+  but keys a second compiled program; a single constructor per carry
+  shape makes the pytree structure a reviewed, single-source contract.
+
+All fields are pytree *data* (leaves); optional legs (``w_prev`` for
+variants that don't carry previous weights, ``quarantine`` when the
+non-finite guard is off) hold ``None``, which JAX treats as an empty
+subtree — the structure stays static per trace, exactly like the old
+conditionally-sized tuples, so compiled-program counts are unchanged
+and outputs are bitwise identical.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from jax import tree_util
+
+
+@tree_util.register_dataclass
+@dataclass
+class ScanCarry:
+    """Carry of the per-epoch XLA case scan (:func:`.engine._simulate_scan`):
+    ``(B, W_prev, C_prev)`` plus the optional quarantine provenance dict
+    of :mod:`..resilience.guards`."""
+
+    bonds: Any  # [V, M]
+    w_prev: Any  # [V, M]
+    consensus: Any  # [M]
+    quarantine: Optional[dict] = None
+
+
+@tree_util.register_dataclass
+@dataclass
+class TotalsCarry:
+    """Carry of the accumulate-in-carry throughput scans
+    (:func:`.engine.simulate_constant`, the per-epoch Monte-Carlo shard
+    body): full kernel state plus the running dividend total."""
+
+    bonds: Any  # [V, M]
+    w_prev: Any  # [V, M]
+    consensus: Any  # [M]
+    acc: Any  # [V]
+
+
+@tree_util.register_dataclass
+@dataclass
+class ScaledCarry:
+    """Carry of the epoch-varying throughput scan
+    (:func:`.engine.simulate_scaled`): ``w_prev`` is ``None`` for
+    variants that don't carry previous weights (empty subtree — same
+    compiled-program structure as the old 2-tuple)."""
+
+    bonds: Any  # [V, M]
+    w_prev: Any  # [V, M] or None
+    acc: Any  # [V]
+
+
+@tree_util.register_dataclass
+@dataclass
+class HoistedCarry:
+    """Carry of the hoisted constant-weights scan
+    (:func:`.engine._simulate_constant_hoisted`): bonds recurrence plus
+    the dividend accumulator — everything else is hoisted out."""
+
+    bonds: Any  # [V, M]
+    acc: Any  # [V]

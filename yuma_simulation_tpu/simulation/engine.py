@@ -43,6 +43,12 @@ from yuma_simulation_tpu.models.variants import (
 )
 from yuma_simulation_tpu.ops.normalize import miner_sum, normalize_weight_rows
 from yuma_simulation_tpu.scenarios.base import Scenario
+from yuma_simulation_tpu.simulation.carry import (
+    HoistedCarry,
+    ScaledCarry,
+    ScanCarry,
+    TotalsCarry,
+)
 
 
 @dataclass
@@ -324,10 +330,8 @@ def _simulate_scan(
     shardings = None if mesh is None else _miner_shardings(mesh, M)
 
     def step(carry, xs):
-        if guard_nonfinite:
-            B, W_prev, C_prev, qstate = carry
-        else:
-            B, W_prev, C_prev = carry
+        B, W_prev, C_prev = carry.bonds, carry.w_prev, carry.consensus
+        qstate = carry.quarantine
         W, S, epoch = xs
         first = epoch == 0
         if shardings is not None:
@@ -380,9 +384,14 @@ def _simulate_scan(
         )
 
         if nan_fault_epoch is not None:
+            # The poison literal carries the carry dtype explicitly
+            # (jaxlint JX005): a bare float("nan") asarray would be
+            # weak-f32 here but f64 under the x64 parity harness, and
+            # dtype promotion through jnp.where would then poison the
+            # whole dividend stream's dtype, not just the target epoch.
             dividends = jnp.where(
                 epoch == nan_fault_epoch,
-                jnp.asarray(float("nan"), dtype),
+                jnp.asarray(float("nan"), dtype=dtype),
                 dividends,
             )
 
@@ -421,24 +430,32 @@ def _simulate_scan(
             )
         if save_consensus:
             ys["consensus"] = C_next
-        if guard_nonfinite:
-            return (B_next, W_prev_next, C_next, qstate), ys
-        return (B_next, W_prev_next, C_next), ys
+        return (
+            ScanCarry(
+                bonds=B_next,
+                w_prev=W_prev_next,
+                consensus=C_next,
+                quarantine=qstate,
+            ),
+            ys,
+        )
 
     if carry is None:
-        carry0 = (
-            jnp.zeros((V, M), dtype),
-            jnp.zeros((V, M), dtype),
-            jnp.zeros((M,), dtype),
+        carry0 = ScanCarry(
+            bonds=jnp.zeros((V, M), dtype),
+            w_prev=jnp.zeros((V, M), dtype),
+            consensus=jnp.zeros((M,), dtype),
+            quarantine=quarantine_init() if guard_nonfinite else None,
         )
     else:
-        carry0 = (
-            jnp.asarray(carry["bonds"], dtype),
-            jnp.asarray(carry.get("w_prev", jnp.zeros((V, M), dtype)), dtype),
-            jnp.asarray(carry["consensus"], dtype),
+        carry0 = ScanCarry(
+            bonds=jnp.asarray(carry["bonds"], dtype),
+            w_prev=jnp.asarray(
+                carry.get("w_prev", jnp.zeros((V, M), dtype)), dtype
+            ),
+            consensus=jnp.asarray(carry["consensus"], dtype),
+            quarantine=quarantine_init() if guard_nonfinite else None,
         )
-    if guard_nonfinite:
-        carry0 = carry0 + (quarantine_init(),)
     xs = (
         weights,
         stakes,
@@ -446,12 +463,12 @@ def _simulate_scan(
     )
     carry_f, ys = lax.scan(step, carry0, xs)
     if guard_nonfinite:
-        ys["quarantine"] = carry_f[3]
+        ys["quarantine"] = carry_f.quarantine
     if not return_carry:
         return ys
-    carry_out = {"bonds": carry_f[0], "consensus": carry_f[2]}
+    carry_out = {"bonds": carry_f.bonds, "consensus": carry_f.consensus}
     if spec.carries_prev_weights:
-        carry_out["w_prev"] = carry_f[1]
+        carry_out["w_prev"] = carry_f.w_prev
     return ys, carry_out
 
 
@@ -1439,27 +1456,30 @@ def simulate_scaled(
     carries_prev = spec.carries_prev_weights
 
     def step(carry, xs):
-        if carries_prev:
-            B, W_prev, acc = carry
-        else:
-            (B, acc), W_prev = carry, None
         scale, epoch = xs
-        B_next, W_n_now, D_n = epoch_body(B, W_prev, scale, epoch == 0)
-        acc = acc + to_dividends(D_n)
-        if carries_prev:
-            return (B_next, W_n_now, acc), None
-        return (B_next, acc), None
+        B_next, W_n_now, D_n = epoch_body(
+            carry.bonds, carry.w_prev, scale, epoch == 0
+        )
+        return (
+            ScaledCarry(
+                bonds=B_next,
+                w_prev=W_n_now if carries_prev else None,
+                acc=carry.acc + to_dividends(D_n),
+            ),
+            None,
+        )
 
     E = scales.shape[0]
     zero_b = jnp.zeros((V, M), dtype)
-    zero_acc = jnp.zeros((V,), dtype)
-    carry0 = (
-        (zero_b, zero_b, zero_acc) if carries_prev else (zero_b, zero_acc)
+    carry0 = ScaledCarry(
+        bonds=zero_b,
+        w_prev=zero_b if carries_prev else None,
+        acc=jnp.zeros((V,), dtype),
     )
     final, _ = lax.scan(
         step, carry0, (scales, jnp.arange(E, dtype=jnp.int32))
     )
-    return final[-1], final[0]
+    return final.acc, final.bonds
 
 
 @partial(
@@ -1619,7 +1639,7 @@ def simulate_constant(
         W = lax.with_sharding_constraint(W, shardings[0])
 
     def step(carry, epoch):
-        B, W_prev, C_prev, acc = carry
+        B, W_prev, C_prev = carry.bonds, carry.w_prev, carry.consensus
         first = epoch == 0
         if shardings is not None:
             vm, m = shardings
@@ -1649,22 +1669,25 @@ def simulate_constant(
         B_next = res[spec.bond_state_key]
         W_prev_next = res["weight"] if spec.carries_prev_weights else W_prev
         return (
-            B_next,
-            W_prev_next,
-            res["server_consensus_weight"],
-            acc + dividends,
-        ), None
+            TotalsCarry(
+                bonds=B_next,
+                w_prev=W_prev_next,
+                consensus=res["server_consensus_weight"],
+                acc=carry.acc + dividends,
+            ),
+            None,
+        )
 
-    carry0 = (
-        jnp.zeros((V, M), dtype),
-        jnp.zeros((V, M), dtype),
-        jnp.zeros((M,), dtype),
-        jnp.zeros((V,), dtype),
+    carry0 = TotalsCarry(
+        bonds=jnp.zeros((V, M), dtype),
+        w_prev=jnp.zeros((V, M), dtype),
+        consensus=jnp.zeros((M,), dtype),
+        acc=jnp.zeros((V,), dtype),
     )
-    (B, _, _, total), _ = lax.scan(
+    final, _ = lax.scan(
         step, carry0, jnp.arange(num_epochs, dtype=jnp.int32)
     )
-    return total, B
+    return final.acc, final.bonds
 
 
 def _simulate_constant_hoisted(
@@ -1733,31 +1756,34 @@ def _simulate_constant_hoisted(
         B_target = res0["validator_bond"]
         renorm = spec.bonds_mode is BondsMode.EMA_RUST
 
-        def step(carry, _):
-            B_ema, acc = carry
-            B_next = pin(ema_bonds_update(B_target, pin(B_ema), rate, None, renorm))
-            return (B_next, acc + dividends_of(B_next)), None
+        def bonds_update(B_prev):
+            return pin(ema_bonds_update(B_target, pin(B_prev), rate, None, renorm))
 
         B0 = res0["validator_ema_bond"]
     elif spec.bonds_mode is BondsMode.CAPACITY:
 
-        def step(carry, _):
-            B_prev, acc = carry
-            B_next = pin(capacity_bonds_update(pin(B_prev), W_n, S_n, config))
-            return (B_next, acc + dividends_of(B_next)), None
+        def bonds_update(B_prev):
+            return pin(capacity_bonds_update(pin(B_prev), W_n, S_n, config))
 
         B0 = res0["validator_bonds"]
     else:  # RELATIVE
 
-        def step(carry, _):
-            B_prev, acc = carry
-            B_next = pin(relative_bonds_update(pin(B_prev), W_n, rate))
-            return (B_next, acc + dividends_of(B_next)), None
+        def bonds_update(B_prev):
+            return pin(relative_bonds_update(pin(B_prev), W_n, rate))
 
         B0 = res0["validator_bonds"]
+
+    def step(carry, _):
+        B_next = bonds_update(carry.bonds)
+        return (
+            HoistedCarry(bonds=B_next, acc=carry.acc + dividends_of(B_next)),
+            None,
+        )
 
     acc0 = dividends_of(B0)
     if num_epochs == 1:
         return acc0, B0
-    (B, total), _ = lax.scan(step, (B0, acc0), None, length=num_epochs - 1)
-    return total, B
+    final, _ = lax.scan(
+        step, HoistedCarry(bonds=B0, acc=acc0), None, length=num_epochs - 1
+    )
+    return final.acc, final.bonds

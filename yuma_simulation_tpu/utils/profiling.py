@@ -61,6 +61,105 @@ def profile_trace(log_dir: Optional[str]) -> Iterator[None]:
     logger.info("profiler trace written to %s", log_dir)
 
 
+class RecompilationBudgetExceeded(RuntimeError):
+    """A :class:`RecompilationSentinel` region compiled more new jit-cache
+    entries than its declared budget allows."""
+
+
+class RecompilationSentinel:
+    """Fail loudly when a region re-traces beyond a declared budget.
+
+    The compile-time counterpart of jaxlint's static rules: JX001 can
+    prove a *str/bool* param would silently key a recompile per value,
+    but a hash-unstable static argument (an object whose ``__eq__`` /
+    ``__hash__`` is identity, so every instance is a fresh cache key) or
+    a drifting shape only shows up at runtime — on a remote-tunnel TPU
+    runtime each such re-trace costs a minutes-scale Mosaic/XLA compile,
+    which is exactly the failure this makes a test failure instead of a
+    silent 100x slowdown.
+
+    Usage::
+
+        warmup()                       # compile once outside the region
+        with RecompilationSentinel(_simulate_scan, budget=0):
+            hot_loop()                 # any new cache entry -> raises
+
+    Each tracked function must be a ``jax.jit`` product exposing the
+    ``_cache_size()`` introspection hook (every ``PjitFunction`` does);
+    entry/exit snapshots are differenced per function, so the report
+    names *which* entry point re-traced. ``budget`` is the total number
+    of NEW cache entries the region may add across all tracked
+    functions (0 = the region must be compile-free; N allows the
+    expected cold compiles of a first-call region).
+
+    The check runs on clean exit only — an exception inside the region
+    propagates untouched (a failing test must not be masked by a
+    budget report).
+    """
+
+    def __init__(self, *functions, budget: int = 0, label: str = "region"):
+        if not functions:
+            raise ValueError(
+                "RecompilationSentinel needs at least one jitted function "
+                "to track"
+            )
+        for fn in functions:
+            if not hasattr(fn, "_cache_size"):
+                raise TypeError(
+                    f"{getattr(fn, '__name__', fn)!r} exposes no "
+                    "_cache_size(); pass the jax.jit-wrapped callable "
+                    "itself, not an unjitted wrapper"
+                )
+        self._functions = functions
+        self.budget = budget
+        self.label = label
+        #: per-function new-entry counts, filled at exit:
+        #: ``{qualname: (before, after)}``
+        self.report: dict[str, tuple[int, int]] = {}
+        self.new_entries: Optional[int] = None
+
+    @staticmethod
+    def _name(fn) -> str:
+        return getattr(fn, "__qualname__", None) or getattr(
+            fn, "__name__", repr(fn)
+        )
+
+    def __enter__(self) -> "RecompilationSentinel":
+        self._before = [fn._cache_size() for fn in self._functions]
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            return  # don't mask the region's own failure
+        after = [fn._cache_size() for fn in self._functions]
+        self.report = {}
+        for fn, b, a in zip(self._functions, self._before, after):
+            name = self._name(fn)
+            while name in self.report:  # same-qualname closures
+                name += "'"
+            self.report[name] = (b, a)
+        # Per-function positive deltas only: a cache shrink elsewhere
+        # (eviction, jax.clear_caches) must not cancel out a genuine
+        # re-trace in another tracked function.
+        self.new_entries = sum(
+            max(0, a - b) for b, a in self.report.values()
+        )
+        if self.new_entries > self.budget:
+            detail = ", ".join(
+                f"{name}: {b}->{a}"
+                for name, (b, a) in self.report.items()
+                if a != b
+            )
+            raise RecompilationBudgetExceeded(
+                f"{self.label}: {self.new_entries} new jit-cache "
+                f"entr{'y' if self.new_entries == 1 else 'ies'} exceed the "
+                f"compile budget of {self.budget} ({detail}). A re-trace "
+                "in this region means a static arg is hash-unstable or a "
+                "shape/dtype drifted — on TPU each one costs a "
+                "minutes-scale compile."
+            )
+
+
 @dataclass
 class timed:
     """Context manager measuring a block; optionally derives epochs/sec.
