@@ -73,6 +73,7 @@ TEST_CHUNKS = [
         "tests/unit/test_kernels.py",
         "tests/unit/test_resilience.py",
         "tests/unit/test_resilience_checkpoint.py",
+        "tests/unit/test_watchdog.py",
     ],
     [
         "tests/unit/test_multichip.py",
@@ -81,6 +82,7 @@ TEST_CHUNKS = [
         "tests/unit/test_parity_golden.py",
         "tests/unit/test_quickstart.py",
         "tests/unit/test_streamed.py",
+        "tests/unit/test_elastic_mesh.py",
     ],
     [
         "tests/unit/test_sweep.py",
@@ -89,6 +91,7 @@ TEST_CHUNKS = [
         "tests/unit/test_distributed_multiprocess.py",
         "tests/unit/test_jaxlint.py",
         "tests/unit/test_recompilation.py",
+        "tests/unit/test_supervisor.py",
     ],
 ]
 
@@ -102,6 +105,19 @@ def test(session: nox.Session) -> None:
         session.run(
             "python", "-m", "pytest", *chunk, "-q", "-m", "not slow"
         )
+
+
+@nox.session
+def chaos(session: nox.Session) -> None:
+    """Chaos lane (mirrors the CI `chaos` job): every deterministic
+    recovery drill — fault-injection battery plus the supervisor's
+    stall/device-loss/multi-fault drills — on the virtual 8-device CPU
+    mesh."""
+    session.install("-e", ".[test]")
+    session.run(
+        "python", "-m", "pytest", "tests/", "-q",
+        "-m", "faultinject or chaos",
+    )
 
 
 @nox.session(python=PY_VERSIONS)

@@ -80,6 +80,21 @@ _CAPABILITY_SKIPS = {
             "test_montecarlo_impl_knobs",
         )
     },
+    # The elastic drills that re-dispatch on the SHRUNK mesh need a real
+    # shard_map; the rest of test_elastic_mesh.py (surviving_mesh logic,
+    # pre-dispatch fault aborts, the single-device last rung) runs
+    # everywhere.
+    **{
+        ("test_elastic_mesh.py", name): (
+            HAS_JAX_SHARD_MAP,
+            f"jax {jax.__version__} has no jax.shard_map "
+            "(pyproject pins jax>=0.7)",
+        )
+        for name in (
+            "test_elastic_degradation_on_device_loss",
+            "test_chaos_drill_all_four_faults_sharded",
+        )
+    },
     # --- CSV byte-parity pins minted on the jax>=0.7 toolchain ---
     ("test_csv_byte_parity.py", "test_rendered_csv_cells_pinned_exactly"): (
         JAX_AT_PINNED_TOOLCHAIN,

@@ -1,0 +1,289 @@
+"""Elastic mesh degradation: shrink-and-continue on device loss.
+
+`surviving_mesh` and the distributed-init wrap are pure host logic and
+run everywhere; the end-to-end elastic drills dispatch through
+`jax.shard_map` and are gated by the conftest capability probe
+(HAS_JAX_SHARD_MAP) exactly like the multichip suite."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.parallel import make_mesh, surviving_mesh
+from yuma_simulation_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from yuma_simulation_tpu.resilience import (
+    Deadline,
+    DeviceLossError,
+    DeviceLossFault,
+    FaultPlan,
+    NaNFault,
+    RetryPolicy,
+    StallFault,
+    SweepSupervisor,
+    classify_failure,
+    inject_faults,
+)
+from yuma_simulation_tpu.scenarios import get_cases
+from yuma_simulation_tpu.utils.logging import parse_event_line
+
+VERSION = "Yuma 1 (paper)"
+POLICY = RetryPolicy(max_attempts_per_rung=2, backoff_base=0.0, seed=0)
+
+
+# --------------------------------------------------------- surviving_mesh
+
+
+def test_surviving_mesh_drops_named_devices():
+    mesh = make_mesh(data=8, model=1)
+    lost = mesh.devices.flat[3].id
+    smaller = surviving_mesh(mesh, [lost])
+    assert smaller is not None
+    assert smaller.shape[DATA_AXIS] == 7
+    assert lost not in {d.id for d in smaller.devices.flat}
+
+
+def test_surviving_mesh_preserves_model_axis_when_divisible():
+    mesh = make_mesh(data=4, model=2)
+    # drop two devices -> 6 survivors, still divisible by model=2
+    ids = [d.id for d in mesh.devices.flat]
+    smaller = surviving_mesh(mesh, ids[:2])
+    assert smaller is not None
+    assert smaller.shape[MODEL_AXIS] == 2
+    assert smaller.shape[DATA_AXIS] == 3
+
+
+def test_surviving_mesh_collapses_model_axis_when_not_divisible():
+    mesh = make_mesh(data=4, model=2)
+    ids = [d.id for d in mesh.devices.flat]
+    smaller = surviving_mesh(mesh, ids[:1])  # 7 survivors, 7 % 2 != 0
+    assert smaller is not None
+    assert smaller.shape[MODEL_AXIS] == 1
+    assert smaller.shape[DATA_AXIS] == 7
+
+
+def test_surviving_mesh_returns_none_at_last_rung():
+    mesh = make_mesh(data=2, model=1, devices=list(make_mesh().devices.flat)[:2])
+    ids = [d.id for d in mesh.devices.flat]
+    assert surviving_mesh(mesh, ids) is None          # nothing survives
+    assert surviving_mesh(mesh, ids[:1]) is None      # one survivor
+
+
+def test_device_loss_error_is_retryable_and_carries_ids():
+    err = DeviceLossError("chip fell over", device_ids=(3, 5))
+    assert classify_failure(err) is err
+    assert err.device_ids == (3, 5)
+
+
+# ------------------------------------------------- distributed-init wrap
+
+
+def test_distributed_init_failure_is_typed_and_logged(monkeypatch, caplog):
+    """ISSUE 3 satellite: an explicit-coordinator join failure surfaces
+    as the typed DistributedInitError with one
+    event=distributed_init_failed record — not a raw backend error."""
+    import jax
+
+    from yuma_simulation_tpu.parallel.mesh import initialize_distributed
+    from yuma_simulation_tpu.resilience import DistributedInitError
+
+    monkeypatch.setattr(
+        jax.distributed, "is_initialized", lambda: False, raising=False
+    )
+
+    def never_joins(**kwargs):
+        raise RuntimeError("barrier timed out waiting for 1 tasks")
+
+    monkeypatch.setattr(jax.distributed, "initialize", never_joins)
+    with caplog.at_level(
+        logging.WARNING, logger="yuma_simulation_tpu.parallel.mesh"
+    ):
+        with pytest.raises(DistributedInitError, match="refusing to degrade"):
+            initialize_distributed(
+                "127.0.0.1:1", 2, 0, initialization_timeout=1
+            )
+    parsed = [
+        p
+        for line in caplog.text.splitlines()
+        if (p := parse_event_line(line)) is not None
+    ]
+    assert any(p["event"] == "distributed_init_failed" for p in parsed)
+    record = next(p for p in parsed if p["event"] == "distributed_init_failed")
+    assert record["coordinator"] == "127.0.0.1:1"
+    # the compat contract the multi-process smoke greps for still holds
+    assert issubclass(DistributedInitError, RuntimeError)
+
+
+# --------------------------------------------- elastic dispatch drills
+
+
+@pytest.mark.chaos
+def test_elastic_degradation_on_device_loss(caplog):
+    """ISSUE 3 tentpole: an injected DeviceLossFault shrinks the mesh
+    over the survivors, re-pads/re-shards, resumes, and the degraded
+    run's lanes are bitwise the full-mesh run — with one
+    event=mesh_degraded record for the shrink."""
+    from yuma_simulation_tpu.parallel import simulate_batch_sharded
+
+    cases = get_cases()[:3]
+    mesh = make_mesh()
+    clean = simulate_batch_sharded(cases, VERSION, mesh=mesh, elastic=True)
+    assert clean["mesh_degradations"] == ()
+    lost = mesh.devices.flat[2].id
+    with caplog.at_level(
+        logging.WARNING, logger="yuma_simulation_tpu.parallel.sharded"
+    ):
+        with inject_faults(
+            FaultPlan(device_loss=DeviceLossFault(device_id=lost))
+        ):
+            got = simulate_batch_sharded(
+                cases, VERSION, mesh=mesh, elastic=True
+            )
+    walk = got["mesh_degradations"]
+    assert len(walk) == 1
+    assert walk[0].from_devices == 8 and walk[0].to_devices == 7
+    assert walk[0].lost_device_ids == (lost,)
+    np.testing.assert_array_equal(got["dividends"], clean["dividends"])
+    records = [
+        p
+        for line in caplog.text.splitlines()
+        if (p := parse_event_line(line)) is not None
+        and p["event"] == "mesh_degraded"
+    ]
+    assert len(records) == 1
+    assert records[0]["from_devices"] == "8" and records[0]["to_devices"] == "7"
+
+
+@pytest.mark.chaos
+def test_device_loss_without_elastic_aborts_typed():
+    from yuma_simulation_tpu.parallel import simulate_batch_sharded
+
+    cases = get_cases()[:2]
+    mesh = make_mesh()
+    lost = mesh.devices.flat[0].id
+    with inject_faults(FaultPlan(device_loss=DeviceLossFault(device_id=lost))):
+        with pytest.raises(DeviceLossError):
+            simulate_batch_sharded(cases, VERSION, mesh=mesh, elastic=False)
+
+
+@pytest.mark.chaos
+def test_unattributed_device_loss_falls_to_single_device(monkeypatch):
+    """A DeviceLossError naming no device cannot pick a shard to drop:
+    the last rung is single-device XLA (no `shard_map`), still bitwise
+    the plain vmap batch. Runs on every toolchain — the sharded dispatch
+    is stubbed to fail, so only host logic and the XLA rung execute."""
+    from yuma_simulation_tpu.models.config import YumaConfig
+    from yuma_simulation_tpu.models.variants import variant_for_version
+    from yuma_simulation_tpu.parallel import sharded as sharded_mod
+    from yuma_simulation_tpu.parallel.sharded import simulate_batch_sharded
+    from yuma_simulation_tpu.simulation.sweep import (
+        simulate_batch,
+        stack_scenarios,
+    )
+
+    cases = get_cases()[:2]
+    mesh = make_mesh()
+    W, S, ri, re = stack_scenarios(cases)
+    ref = simulate_batch(
+        W, S, ri, re, YumaConfig(), variant_for_version(VERSION),
+        epoch_impl="xla",
+    )
+
+    calls = {"n": 0}
+
+    def flaky_scan(*args, **kwargs):
+        calls["n"] += 1
+        raise DeviceLossError("which chip? unknown")
+
+    monkeypatch.setattr(sharded_mod, "_sharded_batch_scan", flaky_scan)
+    got = simulate_batch_sharded(cases, VERSION, mesh=mesh, elastic=True)
+    assert calls["n"] == 1
+    walk = got["mesh_degradations"]
+    assert len(walk) == 1 and walk[0].to_devices == 1
+    assert walk[0].lost_device_ids == ()
+    np.testing.assert_array_equal(
+        got["dividends"], np.asarray(ref["dividends"])
+    )
+
+
+# ------------------------------------- the full four-fault chaos drill
+
+
+@pytest.mark.chaos
+def test_chaos_drill_all_four_faults_sharded(tmp_path):
+    """ISSUE 3 acceptance, full composition: ONE supervised sharded
+    sweep survives a stall, a device loss, a NaN lane, AND a torn
+    checkpoint chunk; healthy lanes are bit-identical to the unfaulted
+    supervised run and the ledger + health report account for every
+    recovery action."""
+    from yuma_simulation_tpu.resilience.supervisor import FailureLedger
+
+    cases = get_cases()[:4]
+    mesh = make_mesh()
+    lost = mesh.devices.flat[1].id
+
+    def supervisor(directory, deadline=None):
+        return SweepSupervisor(
+            directory=directory,
+            unit_size=3,
+            deadline=deadline or Deadline(120.0, grace_seconds=120.0),
+            retry_policy=POLICY,
+        )
+
+    clean = supervisor(tmp_path / "clean").run_batch(
+        cases, VERSION, mesh=mesh
+    )
+    assert clean["report"].clean
+    # Warm the degraded-mesh + NaN-operand jit variants under a roomy
+    # budget (device loss and NaN armed, no stall), so the chaos pass's
+    # tight budget can only ever kill the injected hold — cold-compile
+    # time is machine-dependent and must not race the deadline.
+    with inject_faults(
+        FaultPlan(
+            device_loss=DeviceLossFault(device_id=lost),
+            nan=NaNFault(epoch=2, case=1),
+        )
+    ):
+        supervisor(None).run_batch(cases, VERSION, mesh=mesh)
+
+    # Post-shrink attempts get the retry grace, so the hold must exceed
+    # budget + grace (1.5 + 6.0) to be killed wherever it lands.
+    plan = FaultPlan(
+        stall=StallFault(seconds=12.0, dispatches=1),  # hangs 1 dispatch
+        device_loss=DeviceLossFault(device_id=lost),   # drops 1 device
+        nan=NaNFault(epoch=2, case=1),                 # poisons lane 1
+        truncate_chunks={1: 10},                       # tears chunk 1
+    )
+    with inject_faults(plan):
+        out = supervisor(
+            tmp_path / "chaos", deadline=Deadline(1.5, grace_seconds=6.0)
+        ).run_batch(cases, VERSION, mesh=mesh)
+
+    report = out["report"]
+    assert report.units_completed == report.units_total == 2
+    assert report.stalls_killed == 1
+    assert report.mesh_shrinks >= 1
+    assert report.units_requeued == 1
+    assert report.lanes_quarantined == 1
+
+    # healthy lanes bitwise; the NaN lane masked from its epoch on
+    for lane in (0, 2, 3):
+        np.testing.assert_array_equal(
+            out["dividends"][lane], clean["dividends"][lane]
+        )
+    np.testing.assert_array_equal(
+        out["dividends"][1][:2], clean["dividends"][1][:2]
+    )
+    assert (out["dividends"][1][2:] == 0).all()
+    assert out["quarantine"].quarantined_cases == (1,)
+
+    # the ledger accounts for every action
+    led = FailureLedger(tmp_path / "chaos" / "ledger.jsonl")
+    oks = led.entries("unit_ok")
+    assert [e["unit"] for e in oks] == [0, 1, 1]
+    assert sum(e["stalls"] for e in oks) >= 1
+    assert sum(e["mesh_shrinks"] for e in oks) >= 1
+    assert led.entries("unit_requeued")
+    assert sorted(
+        case for e in oks for case, _epoch, _tensor in e["quarantined"]
+    ) == [1]

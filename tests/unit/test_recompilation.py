@@ -60,6 +60,49 @@ def test_simulate_batch_warm_repeat_is_compile_free():
     assert sentinel.new_entries == 0
 
 
+def test_supervised_sweep_warm_repeat_is_compile_free():
+    """ISSUE 3 acceptance: the watchdog/supervisor tier adds ZERO
+    warm-repeat compiles — running a dispatch on the watchdog's worker
+    thread hits the same process-global jit caches, and the supervisor's
+    unit partitioning reuses one cache entry per unit shape."""
+    from yuma_simulation_tpu.resilience import (
+        Deadline,
+        RetryPolicy,
+        SweepSupervisor,
+    )
+
+    cases = get_cases()[:4]
+    sup = SweepSupervisor(
+        unit_size=2,
+        deadline=Deadline(120.0),
+        retry_policy=RetryPolicy(max_attempts_per_rung=2, backoff_base=0.0),
+    )
+    sup.run_batch(cases, "Yuma 1 (paper)")  # warm-up (one cold compile)
+    with RecompilationSentinel(
+        _simulate_batch_xla,
+        _simulate_scan,
+        budget=0,
+        label="supervised sweep warm repeat",
+    ) as sentinel:
+        out = sup.run_batch(cases, "Yuma 1 (paper)")
+    assert sentinel.new_entries == 0
+    assert out["report"].clean
+
+
+def test_supervised_simulate_warm_repeat_is_compile_free():
+    """run_simulation(supervised=True): the deadline-watchdog wrapper
+    around the single-scenario driver is also compile-free warm."""
+    from yuma_simulation_tpu.simulation.engine import run_simulation
+
+    case = create_case("Case 2")
+    run_simulation(case, "Yuma 1 (paper)", supervised=True)  # warm-up
+    with RecompilationSentinel(
+        _simulate_scan, budget=0, label="supervised run_simulation"
+    ) as sentinel:
+        run_simulation(case, "Yuma 1 (paper)", supervised=True)
+    assert sentinel.new_entries == 0
+
+
 class _IdentityHashedSpec:
     """A 'static' argument whose equality is object identity: every
     instance is a fresh jit-cache key — the silent-retrace bug the

@@ -16,6 +16,7 @@ from yuma_simulation_tpu.models.variants import variant_for_version
 from yuma_simulation_tpu.resilience import (
     ENGINE_LADDER,
     EngineCompileError,
+    EngineFailure,
     EngineLadderExhausted,
     EngineResourceExhausted,
     FaultPlan,
@@ -62,6 +63,51 @@ def test_classify_failure_maps_messages_to_types():
     # caller errors are NOT engine failures: never demoted on
     assert classify_failure(ValueError("RESOURCE_EXHAUSTED-ish")) is None
     assert classify_failure(RuntimeError("some unrelated crash")) is None
+
+
+@pytest.mark.parametrize(
+    "message",
+    [
+        # the status name XLA stamps on an expired operation deadline
+        "DEADLINE_EXCEEDED: operation timed out after 600s",
+        "deadline exceeded while compiling module jit__simulate_scan",
+        # collective / channel timeout phrasings from the TPU runtime:
+        # a wedged all-reduce surfaces on the HEALTHY peers as these
+        "collective operation timed out: all-reduce id=7",
+        "Collective timed out waiting for peers",
+        "channel timed out after 120s",
+        "INTERNAL: channel is in an error state",
+        "timed out waiting for launch group",
+        "barrier timed out: 3 of 4 tasks arrived",
+        "heartbeat timeout: coordinator unreachable",
+    ],
+)
+def test_classify_failure_stall_patterns(message):
+    """ISSUE 3 satellite: every DEADLINE_EXCEEDED / collective-timeout
+    phrasing classifies as a retryable EngineStall — each pattern pinned
+    individually so a marker regression names the exact phrasing lost."""
+    from yuma_simulation_tpu.resilience import EngineStall
+
+    typed = classify_failure(RuntimeError(message))
+    assert isinstance(typed, EngineStall), message
+    assert isinstance(typed, EngineFailure)  # retryable by the ladder
+
+
+def test_classify_failure_stall_beats_compile_marker():
+    """A hung compile ('deadline exceeded while compiling') must
+    classify as a (transient, retryable-in-place) stall, not as a
+    deterministic compile abort."""
+    from yuma_simulation_tpu.resilience import EngineStall
+
+    typed = classify_failure(
+        RuntimeError("deadline exceeded during XLA compilation of module")
+    )
+    assert isinstance(typed, EngineStall)
+
+
+def test_classify_failure_stall_caller_errors_still_win():
+    # the taxonomy's caller-error contract is unchanged by the stall tier
+    assert classify_failure(ValueError("DEADLINE_EXCEEDED-ish")) is None
 
 
 def test_ladder_from_rungs():

@@ -1,0 +1,380 @@
+"""SweepSupervisor: idempotent units, the crash-safe FailureLedger, and
+the SweepHealthReport — ISSUE 3 acceptance battery.
+
+The combined chaos drill here runs the UNSHARDED composition (stall +
+NaN lane + torn checkpoint chunk in one sweep); the sharded composition
+adding device loss lives in tests/unit/test_elastic_mesh.py (it needs
+`jax.shard_map`, which the conftest capability probe gates)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.resilience import (
+    Deadline,
+    FaultPlan,
+    NaNFault,
+    RetryPolicy,
+    StallFault,
+    SweepSupervisor,
+    inject_faults,
+)
+from yuma_simulation_tpu.resilience.supervisor import FailureLedger
+from yuma_simulation_tpu.scenarios import create_case, get_cases
+from yuma_simulation_tpu.simulation.sweep import config_grid
+from yuma_simulation_tpu.utils.logging import parse_event_line
+
+VERSION = "Yuma 1 (paper)"
+#: Deterministic, backoff-free policy: 2 supervised attempts everywhere.
+POLICY = RetryPolicy(max_attempts_per_rung=2, backoff_base=0.0, seed=0)
+#: Roomy budget for healthy dispatches; the stall drills shrink it.
+ROOMY = Deadline(budget_seconds=120.0, grace_seconds=120.0)
+
+
+def _supervisor(**kw):
+    kw.setdefault("unit_size", 2)
+    kw.setdefault("deadline", ROOMY)
+    kw.setdefault("retry_policy", POLICY)
+    return SweepSupervisor(**kw)
+
+
+# --------------------------------------------------------- FailureLedger
+
+
+def test_ledger_appends_atomically_and_reloads(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    led = FailureLedger(path)
+    led.append("unit_ok", unit=0, attempts=1)
+    led.append("unit_stalled", unit=1, attempt=1)
+    # every line on disk is complete JSON at all times
+    lines = path.read_text().splitlines()
+    assert [json.loads(ln)["event"] for ln in lines] == [
+        "unit_ok", "unit_stalled",
+    ]
+    # a fresh handle sees the full history (resume case)
+    led2 = FailureLedger(path)
+    assert len(led2) == 2
+    assert led2.entries("unit_ok")[0]["unit"] == 0
+
+
+def test_ledger_tolerates_torn_tail(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    path.write_text('{"event": "unit_ok", "unit": 0}\n{"event": "unit_')
+    led = FailureLedger(path)
+    assert len(led) == 1  # torn line dropped, valid prefix kept
+    led.append("unit_ok", unit=1)
+    assert [e["unit"] for e in led.entries("unit_ok")] == [0, 1]
+
+
+def test_ledger_survives_midfile_corruption(tmp_path):
+    """A corrupt MIDDLE line (non-atomic external writer, bit rot) must
+    not discard the valid records after it — the next append republishes
+    the history, so a dropped tail would be erased permanently."""
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(
+        '{"event": "unit_ok", "unit": 0}\n'
+        "@@corrupt@@\n"
+        '{"event": "unit_ok", "unit": 1}\n'
+    )
+    led = FailureLedger(path)
+    assert [e["unit"] for e in led.entries("unit_ok")] == [0, 1]
+    led.append("unit_ok", unit=2)
+    led2 = FailureLedger(path)
+    assert [e["unit"] for e in led2.entries("unit_ok")] == [0, 1, 2]
+
+
+def test_ledger_in_memory_mode():
+    led = FailureLedger(None)
+    led.append("unit_ok", unit=0)
+    assert led.path is None and len(led) == 1
+
+
+# ------------------------------------------------------- partition/args
+
+
+def test_partition_covers_range_exactly():
+    sup = _supervisor(unit_size=3)
+    assert sup._partition(7) == [(0, 3), (3, 6), (6, 7)]
+    assert sup._partition(3) == [(0, 3)]
+    with pytest.raises(ValueError, match="empty"):
+        sup._partition(0)
+
+
+def test_supervisor_validation():
+    with pytest.raises(ValueError, match="unit_size"):
+        SweepSupervisor(unit_size=0)
+    with pytest.raises(ValueError, match="quarantine"):
+        SweepSupervisor(engine="fused_scan", quarantine=True)
+
+
+# ------------------------------------------------------------ happy path
+
+
+def test_clean_supervised_batch_matches_unsupervised():
+    from yuma_simulation_tpu.models.config import YumaConfig
+    from yuma_simulation_tpu.models.variants import variant_for_version
+    from yuma_simulation_tpu.simulation.sweep import (
+        simulate_batch,
+        stack_scenarios,
+    )
+
+    cases = get_cases()[:4]
+    W, S, ri, re = stack_scenarios(cases)
+    ref = simulate_batch(
+        W, S, ri, re, YumaConfig(), variant_for_version(VERSION)
+    )
+    out = _supervisor().run_batch(cases, VERSION)
+    report = out["report"]
+    assert report.clean and report.units_total == 2
+    assert report.engines_used == ("xla",)
+    assert not out["quarantine"]
+    # supervision partitions the batch but must not perturb a value
+    np.testing.assert_array_equal(
+        out["dividends"], np.asarray(ref["dividends"])
+    )
+
+
+def test_supervised_grid_quarantines_bad_lane():
+    configs, _ = config_grid(bond_alpha=[0.05, 0.1, float("nan"), 0.3, 0.4])
+    out = _supervisor().run_grid(create_case("Case 2"), VERSION, configs)
+    report = out["report"]
+    assert report.units_total == 3 and report.lanes_quarantined == 1
+    # lane index is GLOBAL (grid point 2 sits in unit 1 at local 0)
+    assert out["quarantine"].quarantined_cases == (2,)
+    clean_cfgs, _ = config_grid(bond_alpha=[0.05, 0.1, 0.2, 0.3, 0.4])
+    clean = _supervisor().run_grid(create_case("Case 2"), VERSION, clean_cfgs)
+    for lane in (0, 1, 3, 4):
+        np.testing.assert_array_equal(
+            out["dividends"][lane], clean["dividends"][lane]
+        )
+    assert np.isfinite(out["dividends"]).all()
+
+
+# ------------------------------------------------------------- recovery
+
+
+@pytest.mark.chaos
+def test_stall_is_killed_counted_and_absorbed():
+    cases = get_cases()[:4]
+    clean = _supervisor().run_batch(cases, VERSION)
+    sup = _supervisor(deadline=Deadline(0.15, grace_seconds=60.0))
+    with inject_faults(FaultPlan(stall=StallFault(seconds=1.0, dispatches=1))):
+        out = sup.run_batch(cases, VERSION)
+    report = out["report"]
+    assert report.stalls_killed == 1
+    assert report.units_completed == report.units_total == 2
+    np.testing.assert_array_equal(out["dividends"], clean["dividends"])
+
+
+@pytest.mark.chaos
+def test_fused_oom_demotion_is_accounted():
+    cases = get_cases()[:3]
+    sup = _supervisor(
+        unit_size=3,
+        quarantine=False,
+        engine="fused_scan",
+        retry_policy=RetryPolicy(max_attempts_per_rung=1, backoff_base=0.0),
+    )
+    with inject_faults(FaultPlan(fused_oom_dispatches=1)):
+        out = sup.run_batch(cases, VERSION)
+    report = out["report"]
+    assert report.engine_demotions == 1
+    assert report.engines_used == ("xla",)
+
+
+@pytest.mark.chaos
+def test_persistent_stall_raises_after_ledgered_attempts(tmp_path):
+    """A unit that stalls on EVERY supervised attempt (no grace saves
+    it) exhausts the unit retry budget and raises the typed failure,
+    with the whole walk in the durable ledger — a wedged sweep dies
+    loudly and auditable, never silently."""
+    cases = get_cases()[:2]
+    sup = _supervisor(
+        directory=tmp_path,
+        deadline=Deadline(0.1),  # no grace: retries get the same budget
+        retry_policy=RetryPolicy(max_attempts_per_rung=2, backoff_base=0.0),
+    )
+    with inject_faults(FaultPlan(stall=StallFault(seconds=0.6, dispatches=99))):
+        with pytest.raises(Exception) as exc:
+            sup.run_batch(cases, VERSION)
+    name = type(exc.value).__name__
+    assert name in ("EngineStall", "EngineLadderExhausted"), name
+    led = FailureLedger(tmp_path / "ledger.jsonl")
+    assert led.entries("unit_failed"), "the final failure must be ledgered"
+    assert led.entries("unit_stalled"), "each stall kill must be ledgered"
+
+
+@pytest.mark.chaos
+def test_resume_preserves_quarantine_provenance(tmp_path):
+    """A resumed sweep's chunks still carry the prior run's zero-masked
+    lanes; the resumed run's QuarantineReport must name them (from the
+    ledger) — otherwise the caller treats masked zeros as genuine."""
+    cases = get_cases()[:4]
+    with inject_faults(FaultPlan(nan=NaNFault(epoch=2, case=1))):
+        first = _supervisor(directory=tmp_path, unit_size=3).run_batch(
+            cases, VERSION
+        )
+    assert first["quarantine"].quarantined_cases == (1,)
+    second = _supervisor(directory=tmp_path, unit_size=3).run_batch(
+        cases, VERSION
+    )
+    assert second["report"].units_resumed == 2
+    assert second["quarantine"].quarantined_cases == (1,)
+    entry = second["quarantine"].entries[0]
+    assert entry.epoch == 2 and entry.tensor == "dividends"
+    assert second["report"].lanes_quarantined == 1
+    assert not second["report"].clean  # the OUTPUT carries masked lanes
+    np.testing.assert_array_equal(first["dividends"], second["dividends"])
+
+
+def test_durable_sweep_resumes_from_chunks(tmp_path):
+    cases = get_cases()[:4]
+    first = _supervisor(directory=tmp_path).run_batch(cases, VERSION)
+    second = _supervisor(directory=tmp_path).run_batch(cases, VERSION)
+    assert second["report"].units_resumed == 2
+    assert second["report"].engines_used == ("resumed",)
+    np.testing.assert_array_equal(first["dividends"], second["dividends"])
+    # the ledger accumulated both runs' history
+    led = FailureLedger(tmp_path / "ledger.jsonl")
+    assert len(led.entries("unit_ok")) == 2  # only the first run executed
+
+
+# ------------------------------------------------- the combined drill
+
+
+@pytest.mark.chaos
+def test_chaos_drill_stall_nan_torn_chunk(tmp_path, caplog):
+    """ISSUE 3 acceptance (unsharded composition): ONE supervised sweep
+    survives an injected stall, a NaN lane, and a torn checkpoint chunk;
+    healthy lanes are bit-identical to the unfaulted run, and the
+    FailureLedger + SweepHealthReport account for every recovery action.
+
+    unit_size=3 over 4 scenarios puts lanes [0,3) in unit 0 and lane 3
+    alone in unit 1, so NaNFault(case=1) poisons exactly one global lane
+    (unit 1's single-lane batch has no index 1)."""
+    import logging
+
+    cases = get_cases()[:4]
+
+    # The clean pass gets the roomy budget (its cold compiles must not
+    # stall) and doubles as a warm-up, so the chaos pass's tight budget
+    # only ever kills the injected 1.0s hold, never a compile.
+    clean = _supervisor(directory=tmp_path / "clean", unit_size=3).run_batch(
+        cases, VERSION
+    )
+    assert clean["report"].clean
+    # The armed NaN fault threads a poison-epoch operand into the jit
+    # signature (a DIFFERENT cache entry from the clean run); warm that
+    # variant too, or its cold compile would race the tight budget and
+    # add machine-speed-dependent stall kills to the deterministic one.
+    with inject_faults(FaultPlan(nan=NaNFault(epoch=2, case=1))):
+        _supervisor(unit_size=3).run_batch(cases, VERSION)
+
+    def sup(directory):
+        return _supervisor(
+            directory=directory,
+            unit_size=3,
+            deadline=Deadline(0.15, grace_seconds=60.0),
+        )
+
+    plan = FaultPlan(
+        stall=StallFault(seconds=1.0, dispatches=1),  # kills 1 dispatch
+        nan=NaNFault(epoch=2, case=1),                # poisons lane 1
+        truncate_chunks={1: 10},                      # tears chunk 1
+    )
+    with caplog.at_level(logging.WARNING):
+        with inject_faults(plan):
+            out = sup(tmp_path / "chaos").run_batch(cases, VERSION)
+
+    report = out["report"]
+    # -- the sweep ran to completion and every action is accounted for
+    assert report.units_completed == report.units_total == 2
+    assert report.stalls_killed == 1
+    assert report.units_requeued == 1  # the torn chunk's unit
+    assert report.lanes_quarantined == 1
+    assert not report.clean
+
+    # -- healthy lanes: bit-identical to the unfaulted run
+    for lane in (0, 2, 3):
+        np.testing.assert_array_equal(
+            out["dividends"][lane], clean["dividends"][lane]
+        )
+    # -- the poisoned lane: valid prefix, zero-masked from the fault on
+    np.testing.assert_array_equal(
+        out["dividends"][1][:2], clean["dividends"][1][:2]
+    )
+    assert (out["dividends"][1][2:] == 0).all()
+    assert np.isfinite(out["dividends"]).all()
+    assert out["quarantine"].quarantined_cases == (1,)
+    assert out["quarantine"].entries[0].epoch == 2
+
+    # -- the ledger tells the same story, structurally
+    led = FailureLedger(tmp_path / "chaos" / "ledger.jsonl")
+    oks = led.entries("unit_ok")
+    assert [e["unit"] for e in oks] == [0, 1, 1]  # unit 1 requeued
+    assert sum(e["stalls"] for e in oks) == report.stalls_killed
+    assert [e["unit"] for e in led.entries("unit_requeued")] == [1]
+    quarantined = sorted(
+        case for e in oks for case, _epoch, _tensor in e["quarantined"]
+    )
+    assert quarantined == [1]
+
+    # -- and the event stream parses record-for-record (no regexing)
+    events = [
+        parsed
+        for line in caplog.text.splitlines()
+        if (parsed := parse_event_line(line)) is not None
+    ]
+    kinds = [e["event"] for e in events]
+    assert "engine_stalled" in kinds
+    assert "checkpoint_chunk_requeued" in kinds
+    assert any(
+        e["event"] == "fault_injected" and e["kind"] == "truncate_chunk"
+        for e in events
+    )
+
+
+@pytest.mark.chaos
+def test_chaos_drill_is_rerunnable_after_crash(tmp_path):
+    """Resume-after-chaos: a second supervisor over the same directory
+    loads every healed chunk and recomputes nothing."""
+    cases = get_cases()[:4]
+    d = tmp_path / "sweep"
+    plan = FaultPlan(truncate_chunks={0: 8})
+    with inject_faults(plan):
+        first = _supervisor(directory=d).run_batch(cases, VERSION)
+    assert first["report"].units_requeued == 1
+    second = _supervisor(directory=d).run_batch(cases, VERSION)
+    assert second["report"].units_resumed == 2
+    np.testing.assert_array_equal(first["dividends"], second["dividends"])
+
+
+# --------------------------------------------------------- error policy
+
+
+def test_caller_errors_are_never_retried(tmp_path):
+    sup = _supervisor(directory=tmp_path)
+    with pytest.raises(ValueError):
+        sup.run_batch([], VERSION)
+    # an empty sweep is rejected before any unit runs
+    assert not (tmp_path / "ledger.jsonl").exists()
+
+
+def test_unclassified_failure_is_ledgered_and_raised(tmp_path, monkeypatch):
+    cases = get_cases()[:2]
+    sup = _supervisor(directory=tmp_path, unit_size=2)
+
+    import yuma_simulation_tpu.resilience.supervisor as supervisor_mod
+
+    def explode(*a, **k):
+        raise ArithmeticError("not an engine failure")
+
+    monkeypatch.setattr(supervisor_mod, "_batch_on_rung", explode)
+    with pytest.raises(ArithmeticError):
+        sup.run_batch(cases, VERSION)
+    led = FailureLedger(tmp_path / "ledger.jsonl")
+    failed = led.entries("unit_failed")
+    assert len(failed) == 1 and failed[0]["error"] == "ArithmeticError"
+    # no retry for caller errors: exactly one attempt was booked
+    assert not led.entries("unit_retry")

@@ -36,6 +36,104 @@ def test_checkpointed_sweep_rejects_mismatched_manifest(tmp_path):
         CheckpointedSweep(tmp_path, num_chunks=8, tag="a")
 
 
+class _CaptureHandler(logging.Handler):
+    """Grab formatted record messages exactly as log_event emits them."""
+
+    def __init__(self):
+        super().__init__()
+        self.lines: list[str] = []
+
+    def emit(self, record):
+        self.lines.append(record.getMessage())
+
+
+def _roundtrip(event, fields):
+    from yuma_simulation_tpu.utils.logging import log_event, parse_event_line
+
+    logger = logging.getLogger("yuma_simulation_tpu.test_parse_event")
+    logger.propagate = False
+    handler = _CaptureHandler()
+    logger.addHandler(handler)
+    try:
+        log_event(logger, event, **fields)
+    finally:
+        logger.removeHandler(handler)
+    (line,) = handler.lines
+    return parse_event_line(line)
+
+
+def test_parse_event_line_roundtrip_quoting():
+    """ISSUE 3 satellite: parse_event_line is the exact inverse of
+    log_event's quoting — spaces, equals signs, quotes, backslashes."""
+    fields = {
+        "plain": "ok",
+        "spaced": "a b c",
+        "equals": "k=v",
+        "quoted": 'she said "hi"',
+        "backslash": "a\\b\\\\c",
+        "number": 7,
+        "mixed": 'x="1 2" \\ end',
+    }
+    parsed = _roundtrip("drill", fields)
+    assert parsed is not None
+    assert parsed.pop("event") == "drill"
+    assert parsed == {k: str(v) for k, v in fields.items()}
+
+
+def test_parse_event_line_property_roundtrip():
+    """Randomized round-trip over the quoting alphabet (seeded — a
+    failure reproduces exactly): every generated field survives
+    log_event -> parse_event_line verbatim."""
+    import random
+    import string
+
+    alphabet = string.ascii_letters + string.digits + ' ="\\=_-.:,'
+    rng = random.Random(1234)
+    for trial in range(50):
+        fields = {}
+        for k in range(rng.randint(1, 6)):
+            value = "".join(
+                rng.choice(alphabet) for _ in range(rng.randint(1, 24))
+            )
+            if value == "":
+                continue
+            fields[f"f{k}"] = value
+        parsed = _roundtrip("prop", fields)
+        assert parsed is not None, (trial, fields)
+        assert parsed.pop("event") == "prop"
+        expected = {k: v for k, v in fields.items() if v != ""}
+        assert parsed == expected, (trial, fields)
+
+
+def test_parse_event_line_skips_formatter_prefix_and_non_events():
+    from yuma_simulation_tpu.utils.logging import parse_event_line
+
+    parsed = parse_event_line(
+        "12:00:01 WARNING yuma_simulation_tpu.resilience.retry: "
+        'event=engine_demoted from_engine=fused_scan to_engine=xla'
+    )
+    assert parsed == {
+        "event": "engine_demoted",
+        "from_engine": "fused_scan",
+        "to_engine": "xla",
+    }
+    assert parse_event_line("no structured record here") is None
+    assert parse_event_line("") is None
+
+
+def test_publish_atomic_is_crash_safe_shape(tmp_path):
+    """The shared primitive the ledger and checkpoint sidecars reuse:
+    publish leaves no temp residue and replaces content atomically."""
+    from yuma_simulation_tpu.utils import publish_atomic
+
+    target = tmp_path / "x.json"
+    publish_atomic(target, b"one")
+    assert target.read_bytes() == b"one"
+    publish_atomic(target, b"two")
+    assert target.read_bytes() == b"two"
+    assert list(tmp_path.iterdir()) == [target]
+
+
 def test_timed_rate():
     with timed("x", epochs=100) as t:
         pass

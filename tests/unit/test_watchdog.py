@@ -1,0 +1,199 @@
+"""Deadline watchdog: hang detection for dispatches that never raise.
+
+The retry ladder (PR 1) only sees failures that surface as exceptions;
+these tests pin the supervision tier for the ones that don't — a
+dispatch that simply never returns. Every drill is deterministic via the
+host-side `StallFault` hook (the worker thread sleeps through the
+deadline, exactly the observable behavior of a hung native compile) and
+CPU-safe (ISSUE 3: stall drills are `chaos`, not `slow`)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.resilience import (
+    Deadline,
+    EngineStall,
+    FaultPlan,
+    RetryPolicy,
+    StallFault,
+    classify_failure,
+    inject_faults,
+    run_with_deadline,
+)
+from yuma_simulation_tpu.scenarios import create_case
+from yuma_simulation_tpu.simulation.engine import simulate
+
+VERSION = "Yuma 1 (paper)"
+POLICY = RetryPolicy(max_attempts_per_rung=1, backoff_base=0.0)
+
+
+# ------------------------------------------------------------- Deadline
+
+
+def test_deadline_validation():
+    with pytest.raises(ValueError, match="budget_seconds"):
+        Deadline(budget_seconds=0.0)
+    with pytest.raises(ValueError, match="grace_seconds"):
+        Deadline(budget_seconds=1.0, grace_seconds=-1.0)
+
+
+def test_deadline_retry_grace():
+    d = Deadline(budget_seconds=2.0, grace_seconds=3.0)
+    assert d.budget_for_attempt(0) == 2.0
+    assert d.budget_for_attempt(1) == 5.0
+    assert d.budget_for_attempt(5) == 5.0
+
+
+# ----------------------------------------------------- run_with_deadline
+
+
+def test_none_deadline_runs_inline():
+    """deadline=None is supervision OFF: same thread, no worker."""
+    tid = []
+    assert run_with_deadline(lambda: tid.append(threading.get_ident()) or 7,
+                             None) == 7
+    assert tid == [threading.get_ident()]
+
+
+def test_result_and_exception_pass_through():
+    assert run_with_deadline(lambda: 41 + 1, Deadline(5.0)) == 42
+
+    def boom():
+        raise KeyError("inner failure")
+
+    with pytest.raises(KeyError, match="inner failure"):
+        run_with_deadline(boom, Deadline(5.0))
+
+
+def test_worker_exception_keeps_traceback():
+    def deep():
+        raise RuntimeError("from the worker")
+
+    try:
+        run_with_deadline(deep, Deadline(5.0))
+    except RuntimeError as e:
+        frames = []
+        tb = e.__traceback__
+        while tb is not None:
+            frames.append(tb.tb_frame.f_code.co_name)
+            tb = tb.tb_next
+        assert "deep" in frames
+    else:  # pragma: no cover
+        pytest.fail("exception swallowed")
+
+
+@pytest.mark.chaos
+def test_missed_heartbeat_raises_engine_stall(caplog):
+    """A worker that outsleeps the budget is abandoned; the caller gets
+    a typed EngineStall and one event=engine_stalled record."""
+    import logging
+
+    release = threading.Event()
+    with caplog.at_level(
+        logging.WARNING, logger="yuma_simulation_tpu.resilience.watchdog"
+    ):
+        with pytest.raises(EngineStall) as exc:
+            run_with_deadline(
+                lambda: release.wait(5.0), Deadline(0.05), label="drill"
+            )
+    assert exc.value.budget_seconds == pytest.approx(0.05)
+    assert "event=engine_stalled" in caplog.text
+    assert "label=drill" in caplog.text
+    release.set()  # un-wedge the abandoned worker promptly
+
+
+@pytest.mark.chaos
+def test_late_result_is_dropped_not_half_published():
+    """A worker finishing AFTER its deadline fired must not publish —
+    the stall already won; the late value lands on the floor."""
+    done = threading.Event()
+
+    def slow():
+        time.sleep(0.2)
+        done.set()
+        return "late"
+
+    with pytest.raises(EngineStall):
+        run_with_deadline(slow, Deadline(0.05), label="late")
+    assert done.wait(5.0)  # the abandoned worker did finish...
+    # ...and nothing exploded: a fresh supervised dispatch still works.
+    assert run_with_deadline(lambda: "fresh", Deadline(5.0)) == "fresh"
+
+
+def test_engine_stall_is_retryable():
+    stall = EngineStall("x", budget_seconds=1.0)
+    assert classify_failure(stall) is stall
+
+
+# ------------------------------------------------- stall fault drills
+
+
+@pytest.mark.chaos
+def test_stall_fault_holds_supervised_dispatch():
+    """The StallFault hook sleeps on the WORKER, so the caller's
+    deadline sees a genuine missed heartbeat."""
+    with inject_faults(FaultPlan(stall=StallFault(seconds=0.6))):
+        with pytest.raises(EngineStall):
+            run_with_deadline(lambda: 1, Deadline(0.05), label="drill")
+
+
+@pytest.mark.chaos
+def test_stalled_engine_demotes_down_ladder():
+    """ISSUE 3 tentpole: a stall on a fused rung feeds the existing
+    demotion ladder — killed by the watchdog, classified retryable,
+    demoted to XLA, and the completed run matches the clean XLA run
+    bitwise (the stalled attempt never published anything)."""
+    case = create_case("Case 2")
+    ref = simulate(
+        case, VERSION, epoch_impl="xla",
+        save_bonds=False, save_incentives=False,
+    )
+    with inject_faults(FaultPlan(stall=StallFault(seconds=1.0))):
+        got = simulate(
+            case, VERSION, epoch_impl="fused_scan",
+            retry_policy=POLICY,
+            deadline=Deadline(0.1, grace_seconds=30.0),
+            save_bonds=False, save_incentives=False,
+        )
+    assert got.demotions is not None and len(got.demotions) == 1
+    rec = got.demotions[0]
+    assert rec.from_engine == "fused_scan" and rec.to_engine == "xla"
+    assert rec.error_type == "EngineStall"
+    np.testing.assert_array_equal(got.dividends, ref.dividends)
+
+
+@pytest.mark.chaos
+def test_stall_without_retry_policy_aborts_typed():
+    """deadline alone (no ladder): the stall surfaces as the typed
+    EngineStall instead of a silent hang."""
+    case = create_case("Case 2")
+    with inject_faults(FaultPlan(stall=StallFault(seconds=0.6))):
+        with pytest.raises(EngineStall):
+            simulate(
+                case, VERSION, epoch_impl="xla",
+                deadline=Deadline(0.05),
+                save_bonds=False, save_incentives=False,
+            )
+
+
+@pytest.mark.chaos
+def test_transient_stall_retries_in_place():
+    """One stalled attempt, then the retry (with grace) completes on the
+    SAME rung: no demotion — a transient hang must not cost a rung."""
+    case = create_case("Case 2")
+    ref = simulate(
+        case, VERSION, epoch_impl="xla",
+        save_bonds=False, save_incentives=False,
+    )
+    with inject_faults(FaultPlan(stall=StallFault(seconds=1.0, dispatches=1))):
+        got = simulate(
+            case, VERSION, epoch_impl="xla",
+            retry_policy=RetryPolicy(max_attempts_per_rung=2, backoff_base=0.0),
+            deadline=Deadline(0.1, grace_seconds=30.0),
+            save_bonds=False, save_incentives=False,
+        )
+    assert got.demotions is None
+    np.testing.assert_array_equal(got.dividends, ref.dividends)

@@ -20,9 +20,11 @@ ICI (:func:`make_hybrid_mesh`), so all per-epoch traffic rides ICI.
 """
 
 from yuma_simulation_tpu.parallel.mesh import (  # noqa: F401
+    MeshDegradation,
+    initialize_distributed,
     make_hybrid_mesh,
     make_mesh,
-    initialize_distributed,
+    surviving_mesh,
 )
 from yuma_simulation_tpu.parallel.sharded import (  # noqa: F401
     montecarlo_total_dividends,

@@ -14,6 +14,7 @@ annotations in :mod:`yuma_simulation_tpu.parallel.sharded`.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from typing import Optional, Sequence
 
@@ -21,6 +22,8 @@ import jax
 import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
+
+from yuma_simulation_tpu.utils.logging import log_event
 
 logger = logging.getLogger(__name__)
 
@@ -57,6 +60,46 @@ def make_mesh(
     except Exception:  # non-TPU platforms without topology info
         dev_array = np.asarray(devices).reshape(data, model)
     return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshDegradation:
+    """One elastic shrink of a sweep's mesh (also logged as
+    `event=mesh_degraded`): which devices were dropped, what the mesh
+    shrank from and to, and why."""
+
+    from_devices: int
+    to_devices: int
+    lost_device_ids: tuple
+    reason: str
+
+
+def surviving_mesh(
+    mesh: Mesh, lost_device_ids: Sequence[int]
+) -> Optional[Mesh]:
+    """Rebuild `mesh` over its surviving devices after losing
+    `lost_device_ids` — the shrink-and-continue step of elastic
+    degradation (Pathways-style: a sweep outlives a device, it does not
+    die with it).
+
+    The ``model`` axis width is preserved when the survivor count still
+    divides by it (miner-sharded programs keep their collective
+    geometry); otherwise it collapses to 1 — a scenario-batch sweep has
+    no cross-shard traffic, so any data-axis width is valid. Returns
+    None when zero devices survive, or when exactly one does: one device
+    cannot host a multi-axis mesh usefully, and the caller's last rung
+    (single-device XLA, no `shard_map`) is strictly simpler than a 1x1
+    mesh. One `event=mesh_degraded` record is emitted per rebuild by the
+    elastic driver, not here — the driver knows the dispatch context.
+    """
+    lost = set(lost_device_ids)
+    survivors = [d for d in mesh.devices.flat if d.id not in lost]
+    if len(survivors) <= 1:
+        return None
+    model = mesh.shape.get(MODEL_AXIS, 1)
+    if model > 1 and len(survivors) % model:
+        model = 1
+    return make_mesh(data=-1, model=model, devices=survivors)
 
 
 def make_hybrid_mesh(
@@ -139,7 +182,31 @@ def initialize_distributed(
         )
     except (RuntimeError, ValueError) as e:
         if explicit:
-            raise RuntimeError(
+            # Typed, logged failure instead of the raw backend error: a
+            # peer that never joined within initialization_timeout is an
+            # operator-actionable event (re-launch the job), and the one
+            # structured record makes it greppable alongside every other
+            # recovery action (README "Failure semantics & recovery").
+            from yuma_simulation_tpu.resilience.errors import (
+                DistributedInitError,
+            )
+
+            log_event(
+                logger,
+                "distributed_init_failed",
+                coordinator=coordinator_address,
+                process=process_id if process_id is not None else "",
+                num_processes=(
+                    num_processes if num_processes is not None else ""
+                ),
+                timeout_s=(
+                    initialization_timeout
+                    if initialization_timeout is not None
+                    else ""
+                ),
+                error=type(e).__name__,
+            )
+            raise DistributedInitError(
                 f"distributed join failed for explicit coordinator "
                 f"{coordinator_address} (process {process_id}/"
                 f"{num_processes}); refusing to degrade to a "

@@ -20,6 +20,8 @@ Replaces — TPU-natively — the distributed layer the reference never had
 
 from __future__ import annotations
 
+import contextlib
+import logging
 from functools import partial
 from typing import Optional, Sequence
 
@@ -32,10 +34,18 @@ from yuma_simulation_tpu.models.config import YumaConfig
 from yuma_simulation_tpu.models.epoch import yuma_epoch
 from yuma_simulation_tpu.models.variants import VariantSpec, variant_for_version
 from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
-from yuma_simulation_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from yuma_simulation_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshDegradation,
+    surviving_mesh,
+)
 from yuma_simulation_tpu.scenarios.base import Scenario
 from yuma_simulation_tpu.simulation.engine import simulate_constant
 from yuma_simulation_tpu.simulation.sweep import simulate_batch, stack_scenarios
+from yuma_simulation_tpu.utils.logging import log_event
+
+logger = logging.getLogger(__name__)
 
 
 def _pad_batch(n: int, shards: int) -> int:
@@ -92,6 +102,23 @@ def _sharded_batch_scan(
     )(weights, stakes, reset_index, reset_epoch)
 
 
+def _unpad_outputs(ys: dict, n: int) -> dict:
+    """Trim padded lanes and fetch to numpy; a raw per-lane quarantine
+    state becomes a host-side :class:`..resilience.guards.QuarantineReport`
+    over the un-padded batch."""
+    qstate = ys.pop("quarantine", None)
+    out = {k: np.asarray(v)[:n] for k, v in ys.items()}
+    if qstate is not None:
+        from yuma_simulation_tpu.resilience.guards import (
+            build_quarantine_report,
+        )
+
+        out["quarantine"] = build_quarantine_report(
+            {k: np.asarray(v)[:n] for k, v in qstate.items()}
+        )
+    return out
+
+
 def simulate_batch_sharded(
     scenarios: Sequence[Scenario],
     yuma_version: str,
@@ -101,6 +128,8 @@ def simulate_batch_sharded(
     save_bonds: bool = False,
     quarantine: bool = False,
     dtype=jnp.float32,
+    elastic: bool = False,
+    deadline=None,
 ):
     """Run a scenario suite sharded over the mesh's data axis.
 
@@ -117,35 +146,161 @@ def simulate_batch_sharded(
     tensor)` provenance: the returned dict gains a `"quarantine"`
     report (a :class:`..resilience.guards.QuarantineReport` over the
     un-padded batch).
+
+    `elastic=True` arms shrink-and-continue on device loss: a dispatch
+    failure attributable to specific devices (a typed
+    :class:`..errors.DeviceLossError`, real or fault-injected) rebuilds
+    the mesh over the surviving devices (:func:`..mesh.surviving_mesh`),
+    re-pads and re-shards the batch for the new data-axis width, and
+    re-dispatches — one `event=mesh_degraded` record per shrink, the
+    walk returned as `out["mesh_degradations"]` (a tuple of
+    :class:`..mesh.MeshDegradation`, empty on the healthy path). The
+    last rung is single-device XLA (`simulate_batch`, no `shard_map`) —
+    taken when <= 1 device survives or when the failure names no
+    surviving-mesh device to drop. Per-lane results are independent of
+    the data-axis layout (the shard body is the shared `vmap` engine
+    with zero collectives), so a degraded run's lanes are bitwise what
+    the full mesh produces. Failures that are NOT device loss (compile
+    aborts, OOM, caller errors) propagate unchanged: shrinking the mesh
+    cannot fix them, and the retry ladder / caller owns those.
+
+    `deadline` (a :class:`..resilience.watchdog.Deadline`) supervises
+    EACH mesh attempt separately — the shrink-and-continue walk runs on
+    the caller side of the heartbeat, so a multi-rung recovery gets a
+    fresh budget (with retry grace) per rung instead of racing one
+    budget for the whole walk. A stall raises a typed `EngineStall` to
+    the caller (it is not device loss; shrinking would not fix it).
     """
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
     n = len(scenarios)
-    shards = mesh.shape[DATA_AXIS]
-    pad = _pad_batch(n, shards)
-    padded = list(scenarios) + [scenarios[-1]] * pad
-    W, S, ri, re = stack_scenarios(padded, dtype)
-
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
-    W = jax.device_put(W, sharding)
-    S = jax.device_put(S, sharding)
-    ri = jax.device_put(ri, sharding)
-    re = jax.device_put(re, sharding)
-
-    ys = _sharded_batch_scan(
-        W, S, ri, re, config, spec, mesh,
-        save_bonds=save_bonds, quarantine=quarantine,
+    from yuma_simulation_tpu.resilience import faults
+    from yuma_simulation_tpu.resilience.errors import (
+        DeviceLossError,
+        classify_failure,
     )
-    qstate = ys.pop("quarantine", None)
-    out = {k: np.asarray(v)[:n] for k, v in ys.items()}
-    if qstate is not None:
-        from yuma_simulation_tpu.resilience.guards import (
-            build_quarantine_report,
+    from yuma_simulation_tpu.resilience.watchdog import run_with_deadline
+
+    def dispatch_on(mesh_now: Mesh) -> dict:
+        shards = mesh_now.shape[DATA_AXIS]
+        pad = _pad_batch(n, shards)
+        padded = list(scenarios) + [scenarios[-1]] * pad
+        W, S, ri, re = stack_scenarios(padded, dtype)
+
+        sharding = NamedSharding(mesh_now, P(DATA_AXIS))
+        W = jax.device_put(W, sharding)
+        S = jax.device_put(S, sharding)
+        ri = jax.device_put(ri, sharding)
+        re = jax.device_put(re, sharding)
+
+        return jax.block_until_ready(
+            _sharded_batch_scan(
+                W, S, ri, re, config, spec, mesh_now,
+                save_bonds=save_bonds, quarantine=quarantine,
+            )
         )
 
-        out["quarantine"] = build_quarantine_report(
-            {k: np.asarray(v)[:n] for k, v in qstate.items()}
+    def dispatch_single_device(device) -> dict:
+        W, S, ri, re = stack_scenarios(list(scenarios), dtype)
+        # Pin the fallback to a KNOWN SURVIVOR when the degradation walk
+        # identified one — JAX's default device may be exactly the one
+        # that died. `device=None` (unattributed loss) keeps the
+        # default-device behavior: nothing better is known.
+        ctx = (
+            jax.default_device(device)
+            if device is not None
+            else contextlib.nullcontext()
         )
+        with ctx:
+            return jax.block_until_ready(
+                simulate_batch(
+                    W, S, ri, re, config, spec,
+                    save_bonds=save_bonds, save_incentives=False,
+                    epoch_impl="xla", quarantine=quarantine,
+                )
+            )
+
+    degradations: list = []
+    mesh_now: Optional[Mesh] = mesh
+    fallback_device = None
+    while True:
+        # Each iteration supervises ONE dispatch on ONE mesh; the
+        # shrink logic below runs on the caller side of the watchdog
+        # heartbeat, so a legitimate multi-step recovery (cold compile
+        # per shard width) gets a fresh budget per rung instead of the
+        # whole walk racing a single one. The attempt index is the
+        # shrink count, so post-shrink recompiles get the retry grace.
+        try:
+            if mesh_now is None:
+                if fallback_device is not None:
+                    faults.maybe_lose_device([fallback_device])
+                ys = run_with_deadline(
+                    lambda: dispatch_single_device(fallback_device),
+                    deadline,
+                    label="sharded_batch:single_device",
+                    attempt=len(degradations),
+                )
+            else:
+                # Test-only device-loss drill (inert in production):
+                # fires while the armed plan's lost device is still part
+                # of this mesh, host-level, before any trace.
+                faults.maybe_lose_device(list(mesh_now.devices.flat))
+                # Bind by value: an abandoned (stalled) worker must not
+                # read a mesh the caller has since replaced.
+                ys = run_with_deadline(
+                    lambda m=mesh_now: dispatch_on(m),
+                    deadline,
+                    label="sharded_batch",
+                    attempt=len(degradations),
+                )
+            break
+        except BaseException as exc:  # noqa: BLE001 — classified below
+            typed = classify_failure(exc)
+            if (
+                not elastic
+                or mesh_now is None
+                or not isinstance(typed, DeviceLossError)
+            ):
+                raise
+            present = {d.id for d in mesh_now.devices.flat}
+            lost = tuple(i for i in typed.device_ids if i in present)
+            if typed.device_ids and not lost:
+                # Names only devices this mesh does not route to: the
+                # failure is not attributable here — shrinking cannot
+                # help, so propagate rather than loop.
+                raise
+            survivors = [
+                d for d in mesh_now.devices.flat if d.id not in set(lost)
+            ]
+            if lost and not survivors:
+                # Every device of this mesh is gone; there is no rung
+                # left to degrade to.
+                raise
+            new_mesh = surviving_mesh(mesh_now, lost) if lost else None
+            if new_mesh is None and lost:
+                fallback_device = survivors[0]
+            from_n = int(mesh_now.devices.size)
+            to_n = int(new_mesh.devices.size) if new_mesh is not None else 1
+            record = MeshDegradation(
+                from_devices=from_n,
+                to_devices=to_n,
+                lost_device_ids=lost,
+                reason=type(typed).__name__,
+            )
+            degradations.append(record)
+            log_event(
+                logger,
+                "mesh_degraded",
+                from_devices=from_n,
+                to_devices=to_n,
+                lost=",".join(map(str, lost)) if lost else "unattributed",
+                reason=record.reason,
+            )
+            mesh_now = new_mesh
+
+    out = _unpad_outputs(dict(ys), n)
+    if elastic:
+        out["mesh_degradations"] = tuple(degradations)
     return out
 
 

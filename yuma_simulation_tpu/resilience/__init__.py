@@ -11,6 +11,12 @@ the pieces the simulation and sweep layers wire together:
   (fused_scan_mxu -> fused_scan -> xla) with jittered bounded retry;
 - :mod:`.guards` — the opt-in `jnp.isfinite` quarantine folded into the
   scan carry, plus the host-side :class:`QuarantineReport`;
+- :mod:`.watchdog` — the deadline watchdog: supervised dispatch on a
+  worker thread, typed `EngineStall` on a missed heartbeat (hangs don't
+  raise; this tier makes them);
+- :mod:`.supervisor` — the sweep supervisor composing every tier over
+  idempotent work units, with the crash-safe :class:`FailureLedger` and
+  the :class:`SweepHealthReport`;
 - :mod:`.faults` — test-only deterministic fault hooks so every ladder
   rung and recovery path runs in CPU CI.
 
@@ -20,17 +26,22 @@ contract.
 
 from yuma_simulation_tpu.resilience.errors import (  # noqa: F401
     CheckpointCorruptionError,
+    DeviceLossError,
+    DistributedInitError,
     EngineCompileError,
     EngineFailure,
     EngineLadderExhausted,
     EngineResourceExhausted,
+    EngineStall,
     NonFiniteOutputError,
     ResilienceError,
     classify_failure,
 )
 from yuma_simulation_tpu.resilience.faults import (  # noqa: F401
+    DeviceLossFault,
     FaultPlan,
     NaNFault,
+    StallFault,
     inject_faults,
 )
 from yuma_simulation_tpu.resilience.guards import (  # noqa: F401
@@ -46,4 +57,14 @@ from yuma_simulation_tpu.resilience.retry import (  # noqa: F401
     default_retry_policy,
     ladder_from,
     run_ladder,
+)
+from yuma_simulation_tpu.resilience.supervisor import (  # noqa: F401
+    FailureLedger,
+    SweepHealthReport,
+    SweepSupervisor,
+    default_deadline,
+)
+from yuma_simulation_tpu.resilience.watchdog import (  # noqa: F401
+    Deadline,
+    run_with_deadline,
 )

@@ -36,6 +36,39 @@ class EngineResourceExhausted(EngineFailure):
     RESOURCE_EXHAUSTED at dispatch)."""
 
 
+class EngineStall(EngineFailure):
+    """A compile or dispatch exceeded its deadline (hung XLA/Mosaic
+    compile, wedged collective, dead coordinator). Raised by the
+    watchdog (:mod:`.watchdog`) when a budget expires, and by
+    classification of XLA ``DEADLINE_EXCEEDED`` / collective-timeout
+    runtime errors. Retryable: a stall on one rung demotes like any
+    other engine failure — the lower rungs compile different (smaller)
+    programs and do not share the wedged channel."""
+
+    def __init__(self, message: str, budget_seconds: Optional[float] = None):
+        super().__init__(message)
+        self.budget_seconds = budget_seconds
+
+
+class DeviceLossError(EngineFailure):
+    """A device dropped out of the mesh mid-sweep (ICI link down, chip
+    reset, preempted host). Carries the failed device ids so the elastic
+    path (:mod:`..parallel.sharded`) can rebuild the mesh over the
+    survivors."""
+
+    def __init__(self, message: str, device_ids=()):
+        super().__init__(message)
+        self.device_ids = tuple(device_ids)
+
+
+class DistributedInitError(ResilienceError):
+    """A multi-host distributed join failed within its initialization
+    timeout (peer crashed before the barrier, wrong coordinator
+    address). NOT an :class:`EngineFailure`: there is no lower rung to
+    demote to before the backend exists — the caller must decide whether
+    to re-launch or abort the job."""
+
+
 class EngineLadderExhausted(EngineFailure):
     """Every rung of the degradation ladder failed. Carries the
     per-demotion records so the caller can see the full walk."""
@@ -68,6 +101,23 @@ _RESOURCE_MARKERS = (
     "allocation failure",
 )
 
+#: Substrings that identify a hang/timeout failure in the raw message of
+#: an XLA runtime error: the status name XLA stamps on an expired
+#: operation deadline, plus the collective/channel timeout phrasings the
+#: TPU runtime emits when a peer stops participating (a wedged all-gather
+#: surfaces on the HEALTHY hosts as one of these, not as a device error).
+_STALL_MARKERS = (
+    "deadline_exceeded",
+    "deadline exceeded",
+    "collective operation timed out",
+    "collective timed out",
+    "channel timed out",
+    "channel is in an error state",
+    "timed out waiting for",
+    "barrier timed out",
+    "heartbeat timeout",
+)
+
 #: Substrings that identify a kernel/program compile failure.
 _COMPILE_MARKERS = (
     "mosaic failed",
@@ -97,6 +147,14 @@ def classify_failure(exc: BaseException) -> Optional[EngineFailure]:
     msg = str(exc).lower()
     if any(marker in msg for marker in _RESOURCE_MARKERS):
         err = EngineResourceExhausted(str(exc))
+        err.__cause__ = exc
+        return err
+    if any(marker in msg for marker in _STALL_MARKERS):
+        # Checked before the compile markers: a hung compile surfaces as
+        # "deadline exceeded while compiling", which must classify as a
+        # stall (the retry may succeed where the hang was transient),
+        # not as a deterministic compile abort.
+        err = EngineStall(str(exc))
         err.__cause__ = exc
         return err
     if any(marker in msg for marker in _COMPILE_MARKERS):

@@ -25,7 +25,14 @@ deterministically*, so every ladder rung runs in CI under
 - `FaultPlan.truncate_chunks` / `corrupt_chunks` — a just-published
   checkpoint chunk file is truncated / bit-flipped ONCE (simulating
   disk corruption between runs), so resume-time checksum verification
-  and requeue are exercised end to end.
+  and requeue are exercised end to end;
+- `FaultPlan.stall` — the watchdog's worker thread sleeps through the
+  deadline before the real dispatch (a simulated hung compile: no
+  heartbeat, a typed `EngineStall` in the caller);
+- `FaultPlan.device_loss` — every elastic sharded dispatch whose mesh
+  still routes to the named device raises a simulated
+  :class:`..errors.DeviceLossError`, until the mesh is rebuilt without
+  it (the semantics of real hardware loss: only shrinking recovers).
 
 The hooks are consulted at host level by the engines and
 `CheckpointedSweep`; with no plan armed (the production state) each is
@@ -50,10 +57,41 @@ import logging
 import os
 from typing import Optional
 
-from yuma_simulation_tpu.resilience.errors import EngineResourceExhausted
+from yuma_simulation_tpu.resilience.errors import (
+    DeviceLossError,
+    EngineResourceExhausted,
+)
 from yuma_simulation_tpu.utils.logging import log_event
 
 logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class StallFault:
+    """Hold the first `dispatches` supervised dispatches (after letting
+    `skip` through) hostage for `seconds` of wall clock — a simulated
+    hung compile/collective. The sleep happens on the watchdog's WORKER
+    thread (:func:`maybe_stall_dispatch` is called there, host level,
+    just before the real dispatch), so a deadline shorter than `seconds`
+    sees exactly what a real hang produces: no heartbeat, an abandoned
+    worker, a typed `EngineStall` in the caller."""
+
+    seconds: float = 5.0
+    dispatches: int = 1
+    skip: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceLossFault:
+    """Simulate device `device_id` dropping out of the mesh: every
+    elastic sharded dispatch whose mesh still contains that device
+    raises a :class:`..errors.DeviceLossError` naming it. The fault
+    keeps firing until the mesh no longer includes the device — exactly
+    the semantics of real hardware loss (retrying on the same mesh
+    cannot succeed; only shrinking recovers), so the drill proves the
+    degradation actually happened rather than a lucky retry."""
+
+    device_id: int
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,6 +119,10 @@ class FaultPlan:
     truncate_chunks: dict = dataclasses.field(default_factory=dict)
     #: chunk indices whose published file gets one byte flipped.
     corrupt_chunks: tuple = ()
+    #: hold supervised dispatches past their deadline (hang simulation).
+    stall: Optional[StallFault] = None
+    #: drop one device out of the elastic sharded mesh.
+    device_loss: Optional[DeviceLossFault] = None
 
 
 class _FaultState:
@@ -88,6 +130,8 @@ class _FaultState:
         self.plan = plan
         self.fused_dispatches_seen = 0
         self.fused_dispatches_failed = 0
+        self.stall_dispatches_seen = 0
+        self.stall_dispatches_fired = 0
         self.mangled_chunks: set = set()
 
 
@@ -151,6 +195,57 @@ def maybe_fail_fused_dispatch() -> None:
         raise EngineResourceExhausted(
             "injected fault: simulated RESOURCE_EXHAUSTED on fused dispatch "
             f"{state.fused_dispatches_failed}/{state.plan.fused_oom_dispatches}"
+        )
+
+
+def maybe_stall_dispatch() -> None:
+    """Watchdog-worker hook: called on the worker thread immediately
+    before a supervised dispatch. Sleeps through the armed plan's stall
+    window for its first N supervised calls — the caller's deadline
+    expires while this thread is asleep, exactly as it would during a
+    real native-code hang."""
+    state = _ACTIVE
+    if state is None or state.plan.stall is None:
+        return
+    if _tracing_now():
+        return
+    plan_stall = state.plan.stall
+    state.stall_dispatches_seen += 1
+    if (
+        state.stall_dispatches_seen > plan_stall.skip
+        and state.stall_dispatches_fired < plan_stall.dispatches
+    ):
+        state.stall_dispatches_fired += 1
+        log_event(
+            logger,
+            "fault_injected",
+            kind="stall",
+            dispatch=state.stall_dispatches_fired,
+            hold_s=f"{plan_stall.seconds:.3f}",
+        )
+        import time
+
+        time.sleep(plan_stall.seconds)
+
+
+def maybe_lose_device(devices) -> None:
+    """Elastic-dispatch hook: called with the mesh's device list before
+    each sharded dispatch. Raises a simulated
+    :class:`..errors.DeviceLossError` while the armed plan's lost device
+    is still part of the mesh; once the mesh has been rebuilt without
+    it, the hook goes quiet — retrying on the degraded mesh succeeds."""
+    state = _ACTIVE
+    if state is None or state.plan.device_loss is None:
+        return
+    if _tracing_now():
+        return
+    lost = state.plan.device_loss.device_id
+    if any(getattr(d, "id", None) == lost for d in devices):
+        log_event(logger, "fault_injected", kind="device_loss", device=lost)
+        raise DeviceLossError(
+            f"injected fault: simulated loss of device {lost} "
+            "(mesh still routes work to it)",
+            device_ids=(lost,),
         )
 
 

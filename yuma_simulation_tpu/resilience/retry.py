@@ -112,6 +112,8 @@ def run_ladder(
     *,
     rungs: Optional[Sequence[str]] = None,
     label: str = "",
+    deadline=None,
+    on_failure: Optional[Callable[[BaseException, str, int], None]] = None,
 ):
     """Run `dispatch(rung)` down the ladder starting at `engine`.
 
@@ -120,6 +122,20 @@ def run_ladder(
     on the happy path). Non-engine failures propagate immediately;
     exhausting the ladder raises :class:`EngineLadderExhausted` chaining
     the last rung's failure.
+
+    `deadline` (a :class:`..watchdog.Deadline`, default None = no hang
+    supervision) runs every attempt under the deadline watchdog: an
+    attempt that posts no heartbeat within its budget raises a typed
+    `EngineStall`, which classifies as retryable — so a hung compile or
+    wedged dispatch walks the same retry-then-demote ladder as a VMEM
+    exhaustion instead of blocking the sweep forever.
+
+    `on_failure(typed, rung, attempt)` is called for every CLASSIFIED
+    failure, including ones a same-rung retry then absorbs — the
+    supervisor's accounting hook: demotion records alone undercount
+    (a stall killed on attempt 1 that succeeds on attempt 2 leaves no
+    demotion), and the health report must account for every recovery
+    action, not just the ones that moved rungs.
     """
     rungs = tuple(rungs) if rungs is not None else ladder_from(engine)
     rng = random.Random(policy.seed)
@@ -129,11 +145,28 @@ def run_ladder(
         last_failure = None
         for attempt in range(policy.max_attempts_per_rung):
             try:
-                return dispatch(rung), rung, demotions
+                if deadline is None:
+                    return dispatch(rung), rung, demotions
+                from yuma_simulation_tpu.resilience.watchdog import (
+                    run_with_deadline,
+                )
+
+                result = run_with_deadline(
+                    # Bind by value: an abandoned (stalled) worker that
+                    # wakes later must not dispatch whatever rung the
+                    # ladder has since advanced to.
+                    lambda r=rung: dispatch(r),
+                    deadline,
+                    label=f"{label}:{rung}" if label else rung,
+                    attempt=attempt,
+                )
+                return result, rung, demotions
             except BaseException as exc:  # noqa: BLE001 — classified below
                 typed = classify_failure(exc)
                 if typed is None:
                     raise
+                if on_failure is not None:
+                    on_failure(typed, rung, attempt)
                 last_failure = typed
                 retries_left = policy.max_attempts_per_rung - attempt - 1
                 if retries_left:

@@ -1,0 +1,718 @@
+"""Sweep supervisor: idempotent units under deadline + retry + quarantine.
+
+The top of the resilience stack. The lower tiers each contain ONE
+failure class — the ladder contains engine failures, the watchdog
+contains hangs, the elastic mesh contains device loss, the quarantine
+contains NaN lanes, `CheckpointedSweep` contains torn chunks — but a
+pod-scale Monte-Carlo sweep meets all of them in one run, and something
+has to compose the tiers, keep the bookkeeping, and tell the operator
+what actually happened. That is the :class:`SweepSupervisor`:
+
+- the sweep is partitioned into **idempotent units** (contiguous slices
+  of the scenario batch or hyperparameter grid — pure functions of their
+  inputs, so re-executing a unit is always safe);
+- each unit dispatches under the deadline watchdog, the engine-retry
+  ladder, the per-lane quarantine, and (sharded) elastic mesh
+  degradation;
+- every per-unit outcome is appended to a crash-safe JSONL
+  :class:`FailureLedger` (atomic fsync+rename publish via
+  :func:`..utils.checkpoint.publish_atomic` — a crash mid-append leaves
+  the previous ledger, never a torn line);
+- with a `directory`, unit results snapshot through
+  :class:`..utils.checkpoint.CheckpointedSweep`, so a killed sweep
+  resumes from its completed units and a corrupt chunk requeues its
+  unit — the ledger and the chunk store live side by side in the same
+  directory;
+- the return value carries a :class:`SweepHealthReport`: engines used,
+  demotions walked, stalls killed, lanes quarantined, units
+  retried/requeued — the operator's one-glance answer to "what degraded
+  while I wasn't looking", cross-checkable against the `event=` log
+  records and the ledger line by line.
+
+Deadline placement (one watchdog per dispatch, never nested): unsharded
+units thread the deadline INTO the engine ladder — each rung attempt
+gets its own budget, a stall classifies and retries/demotes like any
+engine failure. Sharded units thread it into the elastic dispatch the
+same way — one watchdog per MESH ATTEMPT, with the shrink logic on the
+caller side of the heartbeat, so each rung of a degradation walk (cold
+compile included) gets a fresh budget. An outer budget wrapping an
+inner recovery loop would kill the loop mid-recovery — exactly the
+false positive a watchdog must not produce.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import pathlib
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from yuma_simulation_tpu.resilience.errors import (
+    EngineStall,
+    classify_failure,
+)
+from yuma_simulation_tpu.resilience.guards import (
+    QuarantineEntry,
+    QuarantineReport,
+)
+from yuma_simulation_tpu.resilience.retry import (
+    RetryPolicy,
+    default_retry_policy,
+)
+from yuma_simulation_tpu.resilience.watchdog import Deadline
+from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+from yuma_simulation_tpu.utils.logging import log_event
+
+logger = logging.getLogger(__name__)
+
+#: Production default dispatch budget: generous enough for a cold
+#: XLA/Mosaic compile of the largest supported shapes (minutes-scale on
+#: a remote-tunnel runtime), small enough that a genuinely hung compile
+#: is killed well inside a sweep's lifetime. Retries get the same again
+#: on top (`grace_seconds`) since a retry may recompile from scratch.
+DEFAULT_UNIT_BUDGET_SECONDS = 900.0
+
+
+def default_deadline() -> Deadline:
+    """The production default unit deadline (15 min + 15 min retry grace)."""
+    return Deadline(
+        budget_seconds=DEFAULT_UNIT_BUDGET_SECONDS,
+        grace_seconds=DEFAULT_UNIT_BUDGET_SECONDS,
+    )
+
+
+class FailureLedger:
+    """Crash-safe JSONL of per-unit sweep outcomes.
+
+    Each appended record is one JSON object per line. The whole file is
+    re-published atomically per append (temp + fsync + rename — the
+    checkpoint layer's primitive), so at every instant the on-disk
+    ledger is a complete, parseable prefix of the sweep's history; a
+    torn trailing line cannot exist by construction, but a load
+    tolerates one anyway (a ledger written by a pre-atomic tool must
+    not brick the directory — the torn tail is dropped with a warning).
+    Records are small (a few hundred bytes) and units are coarse, so
+    rewrite-per-append stays trivial I/O even for thousand-unit sweeps.
+
+    `path=None` keeps the ledger in memory only — same API, no
+    durability — for supervised sweeps without a checkpoint directory.
+    """
+
+    def __init__(self, path: Optional[str | pathlib.Path] = None):
+        self.path = pathlib.Path(path) if path is not None else None
+        self._entries: list[dict] = []
+        if self.path is not None and self.path.exists():
+            for lineno, line in enumerate(self.path.read_text().splitlines()):
+                if not line.strip():
+                    continue
+                try:
+                    self._entries.append(json.loads(line))
+                except json.JSONDecodeError:
+                    # Skip, don't stop: a corrupt MIDDLE line (bit rot,
+                    # a non-atomic external writer) must not discard the
+                    # valid records after it — the next append would
+                    # republish the truncated history and erase them.
+                    logger.warning(
+                        "dropping undecodable ledger line %d in %s "
+                        "(torn write from a non-atomic writer?)",
+                        lineno,
+                        self.path,
+                    )
+                    continue
+
+    def append(self, event: str, **fields) -> dict:
+        """Append one outcome record and (if durable) publish the
+        updated ledger atomically. Returns the record."""
+        record = {"event": event, **fields}
+        self._entries.append(record)
+        if self.path is not None:
+            payload = "".join(
+                json.dumps(e, sort_keys=True) + "\n" for e in self._entries
+            )
+            publish_atomic(self.path, payload.encode())
+        return record
+
+    def entries(self, event: Optional[str] = None) -> tuple:
+        """All records, oldest first; `event` filters by record type."""
+        if event is None:
+            return tuple(self._entries)
+        return tuple(e for e in self._entries if e.get("event") == event)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepHealthReport:
+    """What a supervised sweep survived — the operator-facing summary,
+    cross-checkable record-for-record against the :class:`FailureLedger`
+    and the `event=` log stream. Action counts (stalls, demotions,
+    shrinks, retries) cover the units EXECUTED in this run —
+    fully-resumed units' history lives in the durable ledger — but
+    `lanes_quarantined` covers the RETURNED output, resumed units
+    included: their chunks still carry the zero-masked lanes."""
+
+    units_total: int
+    units_completed: int
+    #: units satisfied from a prior run's checkpoint chunks (resume).
+    units_resumed: int
+    #: units that needed more than one supervised attempt this run.
+    units_retried: int
+    #: units re-executed by checkpoint verification (torn/corrupt chunk).
+    units_requeued: int
+    #: supervised dispatches killed by the deadline watchdog.
+    stalls_killed: int
+    #: engine-ladder demotions across all units.
+    engine_demotions: int
+    #: elastic mesh shrinks across all units.
+    mesh_shrinks: int
+    #: scenario/grid lanes masked by the non-finite quarantine.
+    lanes_quarantined: int
+    #: engine rungs/paths that produced accepted unit results, sorted.
+    engines_used: tuple
+    ledger_path: Optional[str] = None
+
+    @property
+    def clean(self) -> bool:
+        """True iff nothing degraded: no retries, requeues, stalls,
+        demotions, shrinks or quarantined lanes."""
+        return not (
+            self.units_retried
+            or self.units_requeued
+            or self.stalls_killed
+            or self.engine_demotions
+            or self.mesh_shrinks
+            or self.lanes_quarantined
+        )
+
+
+class _UnitOutcome:
+    """Mutable accumulator for one unit's recovery actions. Owns the
+    unit's ledger bookkeeping for stalls so a kill is recorded whether
+    the in-unit ladder absorbs it or it escapes to the unit retry."""
+
+    def __init__(
+        self, idx: int = 0, ledger: Optional[FailureLedger] = None
+    ) -> None:
+        self.idx = idx
+        self.ledger = ledger
+        self.attempts = 0
+        self.stalls = 0
+        self.demotions = 0
+        self.mesh_shrinks = 0
+        self.engine = "xla"
+        self.quarantine_entries: tuple = ()
+
+    def record_stall(
+        self, *, attempt: int, rung: str = "", budget_s=None
+    ) -> None:
+        self.stalls += 1
+        if self.ledger is not None:
+            self.ledger.append(
+                "unit_stalled",
+                unit=self.idx,
+                attempt=attempt,
+                **({"rung": rung} if rung else {}),
+                **({"budget_s": budget_s} if budget_s is not None else {}),
+            )
+
+
+@dataclasses.dataclass
+class SweepSupervisor:
+    """Run partitioned sweeps under full supervision.
+
+    `unit_size` scenarios (or grid points) per idempotent unit;
+    `deadline` bounds each supervised dispatch (None disables the
+    watchdog — not recommended for unattended sweeps); `retry_policy`
+    drives both the in-unit engine ladder and the unit-level retry count
+    (`max_attempts_per_rung` supervised attempts per unit, so a ladder
+    path gets rungs x attempts^2 total tries in the worst case);
+    `quarantine=True` arms the per-lane non-finite guard (forces the XLA
+    engine — the fused scan cannot host the guard); `elastic=True` arms
+    shrink-and-continue on device loss for sharded units; `engine` is
+    the starting ladder rung for unsharded units (must be "xla" under
+    quarantine). `directory` makes the sweep durable: unit results
+    snapshot through :class:`..utils.checkpoint.CheckpointedSweep`
+    (chunk files + checksums) and the ledger publishes to `ledger.jsonl`
+    alongside them, so a killed run resumes from its completed units and
+    a torn chunk requeues exactly one unit.
+    """
+
+    directory: Optional[str | pathlib.Path] = None
+    unit_size: int = 64
+    deadline: Optional[Deadline] = dataclasses.field(
+        default_factory=default_deadline
+    )
+    retry_policy: RetryPolicy = dataclasses.field(
+        default_factory=default_retry_policy
+    )
+    quarantine: bool = True
+    elastic: bool = True
+    engine: str = "xla"
+
+    def __post_init__(self) -> None:
+        if self.unit_size < 1:
+            raise ValueError("unit_size must be >= 1")
+        if self.quarantine and self.engine != "xla":
+            raise ValueError(
+                "quarantine rides the XLA scan carry; a supervised sweep "
+                f"cannot start on engine {self.engine!r} with "
+                "quarantine=True (pass quarantine=False to drill fused "
+                "rungs)"
+            )
+
+    # -- public drivers -------------------------------------------------
+
+    def run_batch(
+        self,
+        scenarios: Sequence,
+        yuma_version: str,
+        config=None,
+        *,
+        mesh=None,
+        dtype=jnp.float32,
+        tag: str = "",
+    ) -> dict:
+        """Supervised :func:`..simulation.sweep.simulate_batch` /
+        :func:`..parallel.sharded.simulate_batch_sharded` over a
+        scenario suite.
+
+        Returns `{"dividends": [B, E, V], "quarantine":
+        QuarantineReport, "report": SweepHealthReport}`. With `mesh`,
+        units dispatch sharded (elastic if armed) under one watchdog
+        each; without, down the engine ladder starting at `self.engine`
+        with per-attempt deadlines. Healthy lanes are bitwise what an
+        unfaulted run produces — every recovery action either
+        re-executes a pure unit or masks a lane, never perturbs a
+        healthy one.
+        """
+        from yuma_simulation_tpu.models.config import YumaConfig
+        from yuma_simulation_tpu.models.variants import variant_for_version
+        from yuma_simulation_tpu.simulation.sweep import stack_scenarios
+
+        config = config if config is not None else YumaConfig()
+        spec = variant_for_version(yuma_version)
+        scenarios = list(scenarios)
+        units = self._partition(len(scenarios))
+
+        def dispatch_unit(
+            idx: int, lo: int, hi: int, attempt: int, outcome: _UnitOutcome
+        ) -> dict:
+            unit = scenarios[lo:hi]
+            label = f"{tag or 'batch'}:unit{idx}"
+            if mesh is not None:
+                from yuma_simulation_tpu.parallel.sharded import (
+                    simulate_batch_sharded,
+                )
+
+                # The deadline goes INTO the elastic dispatch (one
+                # watchdog per mesh attempt, shrinks on the caller side
+                # of the heartbeat — see the module docstring). On a
+                # unit retry the budget is pre-extended by the grace:
+                # the sharded walk restarts its shrink count at 0 and
+                # would otherwise retry on the cold-start budget.
+                dl = self.deadline
+                if dl is not None and attempt > 0:
+                    dl = Deadline(
+                        budget_seconds=dl.budget_for_attempt(attempt),
+                        grace_seconds=dl.grace_seconds,
+                    )
+                ys = simulate_batch_sharded(
+                    unit,
+                    yuma_version,
+                    config,
+                    mesh=mesh,
+                    quarantine=self.quarantine,
+                    dtype=dtype,
+                    elastic=self.elastic,
+                    deadline=dl,
+                )
+                out = dict(ys)
+                shrinks = out.get("mesh_degradations", ())
+                out["_engine_used"] = (
+                    "single_device_xla"
+                    if shrinks and shrinks[-1].to_devices == 1
+                    else "sharded_xla"
+                )
+                return out
+            W, S, ri, re = stack_scenarios(unit, dtype)
+            return self._ladder_dispatch(
+                lambda rung: _batch_on_rung(
+                    W, S, ri, re, config, spec, rung, self.quarantine
+                ),
+                label=label,
+                outcome=outcome,
+            )
+
+        return self._run_units(
+            units,
+            dispatch_unit,
+            num_lanes=len(scenarios),
+            tag=tag or f"batch:{yuma_version}",
+            config_fingerprint={
+                "driver": "run_batch",
+                "version": yuma_version,
+                "num_scenarios": len(scenarios),
+                "unit_size": self.unit_size,
+            },
+        )
+
+    def run_grid(
+        self,
+        scenario,
+        yuma_version: str,
+        configs,
+        *,
+        tag: str = "",
+    ) -> dict:
+        """Supervised :func:`..simulation.sweep.sweep_hyperparams` over
+        a batched config grid (built with `config_grid`): the grid's
+        lanes partition into units exactly like scenarios do, each unit
+        re-slicing the batched config pytree (static leaves shared).
+        Returns the same `{"dividends", "quarantine", "report"}` shape
+        as :meth:`run_batch`, with lanes = grid points."""
+        import jax
+
+        leaves = jax.tree.leaves(configs)
+        num_points = next(
+            (leaf.shape[0] for leaf in leaves if jnp.ndim(leaf) > 0), 1
+        )
+        units = self._partition(num_points)
+
+        def dispatch_unit(
+            idx: int, lo: int, hi: int, attempt: int, outcome: _UnitOutcome
+        ) -> dict:
+            unit_cfg = jax.tree.map(
+                lambda leaf: leaf[lo:hi] if jnp.ndim(leaf) > 0 else leaf,
+                configs,
+            )
+            return self._ladder_dispatch(
+                lambda rung: _grid_on_xla(
+                    scenario, yuma_version, unit_cfg, self.quarantine
+                ),
+                label=f"{tag or 'grid'}:unit{idx}",
+                outcome=outcome,
+                rungs=("xla",),
+            )
+
+        return self._run_units(
+            units,
+            dispatch_unit,
+            num_lanes=num_points,
+            tag=tag or f"grid:{yuma_version}",
+            config_fingerprint={
+                "driver": "run_grid",
+                "version": yuma_version,
+                "num_points": num_points,
+                "unit_size": self.unit_size,
+            },
+        )
+
+    # -- internals ------------------------------------------------------
+
+    def _partition(self, n: int) -> list:
+        """Contiguous `(lo, hi)` unit bounds covering `range(n)`."""
+        if n < 1:
+            raise ValueError("cannot supervise an empty sweep")
+        return [
+            (lo, min(lo + self.unit_size, n))
+            for lo in range(0, n, self.unit_size)
+        ]
+
+    def _ladder_dispatch(
+        self,
+        dispatch: Callable,
+        *,
+        label: str,
+        outcome: _UnitOutcome,
+        rungs=None,
+    ) -> dict:
+        """One unit attempt through the engine ladder. The deadline is
+        threaded INTO the ladder (per rung attempt), and `on_failure`
+        feeds every classified failure — including same-rung-absorbed
+        stalls — into the unit's books."""
+        from yuma_simulation_tpu.resilience.retry import run_ladder
+
+        def on_failure(typed, rung, attempt):
+            if isinstance(typed, EngineStall):
+                outcome.record_stall(
+                    attempt=attempt + 1,
+                    rung=rung,
+                    budget_s=typed.budget_seconds,
+                )
+
+        ys, engine_used, records = run_ladder(
+            dispatch,
+            self.engine,
+            self.retry_policy,
+            rungs=rungs,
+            label=label,
+            deadline=self.deadline,
+            on_failure=on_failure,
+        )
+        out = dict(ys)
+        out["_engine_used"] = engine_used
+        out["_demotions"] = tuple(records)
+        return out
+
+    def _run_units(
+        self,
+        units: list,
+        dispatch_unit: Callable,
+        *,
+        num_lanes: int,
+        tag: str,
+        config_fingerprint: dict,
+    ) -> dict:
+        directory = (
+            pathlib.Path(self.directory) if self.directory is not None else None
+        )
+        if directory is not None:
+            directory.mkdir(parents=True, exist_ok=True)
+        ledger = FailureLedger(
+            directory / "ledger.jsonl" if directory is not None else None
+        )
+        # One _UnitOutcome PER EXECUTION (a requeued unit appends a
+        # second): the report must account for every recovery action
+        # taken, including ones on an execution whose chunk was later
+        # torn and redone — last-write-wins would silently drop them.
+        outcomes: dict[int, list] = {}
+        executions: dict[int, int] = {}
+
+        def unit_fn(idx: int) -> dict:
+            lo, hi = units[idx]
+            executions[idx] = executions.get(idx, 0) + 1
+            if executions[idx] > 1:
+                # Re-entry within one run = the checkpoint layer
+                # requeued this unit (torn/corrupt chunk detected).
+                ledger.append(
+                    "unit_requeued", unit=idx, executions=executions[idx]
+                )
+            outcome = _UnitOutcome(idx, ledger)
+            outcomes.setdefault(idx, []).append(outcome)
+            last = None
+            for attempt in range(self.retry_policy.max_attempts_per_rung):
+                outcome.attempts = attempt + 1
+                try:
+                    ys = dispatch_unit(idx, lo, hi, attempt, outcome)
+                    return self._accept_unit(idx, lo, hi, ys, outcome, ledger)
+                except BaseException as exc:  # noqa: BLE001 — classified
+                    typed = classify_failure(exc)
+                    if typed is None:
+                        ledger.append(
+                            "unit_failed",
+                            unit=idx,
+                            error=type(exc).__name__,
+                            message=str(exc)[:500],
+                        )
+                        raise
+                    last = typed
+                    if isinstance(typed, EngineStall):
+                        outcome.record_stall(
+                            attempt=attempt + 1,
+                            budget_s=typed.budget_seconds,
+                        )
+                    else:
+                        ledger.append(
+                            "unit_retry",
+                            unit=idx,
+                            attempt=attempt + 1,
+                            error=type(typed).__name__,
+                        )
+            ledger.append(
+                "unit_failed",
+                unit=idx,
+                error=type(last).__name__,
+                message=str(last)[:500],
+            )
+            assert last is not None
+            raise last
+
+        if directory is not None:
+            from yuma_simulation_tpu.utils.checkpoint import CheckpointedSweep
+
+            sweep = CheckpointedSweep(
+                directory,
+                num_chunks=len(units),
+                tag=tag,
+                config=config_fingerprint,
+            )
+            dividends = sweep.run(lambda i: unit_fn(i)["dividends"])
+        else:
+            dividends = np.concatenate(
+                [unit_fn(i)["dividends"] for i in range(len(units))], axis=0
+            )
+        resumed = sum(1 for i in range(len(units)) if i not in executions)
+
+        # Quarantine provenance comes from each unit's LAST execution —
+        # the one whose result stands in the output. Units satisfied
+        # from a prior run's chunks did not execute here, but their
+        # chunks still carry any zero-masked lanes: recover their
+        # provenance from the ledger's unit_ok records, or the caller
+        # would treat masked zeros as genuine dividends.
+        entries: list = []
+        for idx in range(len(units)):
+            if idx in outcomes:
+                entries.extend(outcomes[idx][-1].quarantine_entries)
+            else:
+                entries.extend(_ledger_quarantine_entries(ledger, idx))
+        quarantine = QuarantineReport(
+            entries=tuple(entries), num_cases=num_lanes
+        )
+        report = self._build_report(
+            units, outcomes, executions, resumed, len(entries), directory
+        )
+        log_event(
+            logger,
+            "sweep_supervised",
+            level=logging.INFO,
+            tag=tag,
+            units=report.units_total,
+            resumed=report.units_resumed,
+            retried=report.units_retried,
+            requeued=report.units_requeued,
+            stalls=report.stalls_killed,
+            demotions=report.engine_demotions,
+            mesh_shrinks=report.mesh_shrinks,
+            quarantined=report.lanes_quarantined,
+        )
+        return {
+            "dividends": dividends,
+            "quarantine": quarantine,
+            "report": report,
+        }
+
+    def _accept_unit(
+        self,
+        idx: int,
+        lo: int,
+        hi: int,
+        ys: dict,
+        outcome: _UnitOutcome,
+        ledger: FailureLedger,
+    ) -> dict:
+        """Fold one successful unit dispatch into the books; returns the
+        ys dict (its "dividends" is what the chunk store snapshots)."""
+        ys = dict(ys)
+        outcome.engine = ys.pop("_engine_used", "xla")
+        demotions = ys.pop("_demotions", ())
+        outcome.demotions = len(demotions)
+        shrinks = ys.pop("mesh_degradations", ())
+        outcome.mesh_shrinks = len(shrinks)
+        q = ys.get("quarantine")
+        if q is not None:
+            if not isinstance(q, QuarantineReport):
+                from yuma_simulation_tpu.resilience.guards import (
+                    build_quarantine_report,
+                )
+
+                q = build_quarantine_report(q)
+            outcome.quarantine_entries = tuple(
+                QuarantineEntry(case=lo + e.case, epoch=e.epoch, tensor=e.tensor)
+                for e in q.entries
+            )
+        ledger.append(
+            "unit_ok",
+            unit=idx,
+            lanes=[lo, hi],
+            attempts=outcome.attempts,
+            engine=outcome.engine,
+            stalls=outcome.stalls,
+            demotions=outcome.demotions,
+            mesh_shrinks=outcome.mesh_shrinks,
+            # Full provenance, not just lane indices: a later RESUMED
+            # run reconstructs its QuarantineReport from these records
+            # (the resumed chunks still carry the zero-masked lanes).
+            quarantined=[
+                [e.case, e.epoch, e.tensor]
+                for e in outcome.quarantine_entries
+            ],
+        )
+        ys["dividends"] = np.asarray(ys["dividends"])
+        return ys
+
+    def _build_report(
+        self, units, outcomes, executions, resumed, lanes_quarantined,
+        directory,
+    ) -> SweepHealthReport:
+        runs = [o for per_unit in outcomes.values() for o in per_unit]
+        final = [per_unit[-1] for per_unit in outcomes.values()]
+        return SweepHealthReport(
+            units_total=len(units),
+            units_completed=len(units),
+            units_resumed=resumed,
+            units_retried=sum(
+                1
+                for per_unit in outcomes.values()
+                if any(o.attempts > 1 for o in per_unit)
+            ),
+            units_requeued=sum(1 for c in executions.values() if c > 1),
+            stalls_killed=sum(o.stalls for o in runs),
+            engine_demotions=sum(o.demotions for o in runs),
+            mesh_shrinks=sum(o.mesh_shrinks for o in runs),
+            lanes_quarantined=lanes_quarantined,
+            engines_used=tuple(sorted({o.engine for o in final}))
+            or ("resumed",),
+            ledger_path=(
+                str(directory / "ledger.jsonl") if directory is not None else None
+            ),
+        )
+
+
+def _ledger_quarantine_entries(
+    ledger: FailureLedger, idx: int
+) -> tuple:
+    """Quarantine provenance for a RESUMED unit, from its last
+    `unit_ok` ledger record. Tolerates the legacy record shape (bare
+    lane indices) by returning unknown-provenance entries."""
+    last = None
+    for record in ledger.entries("unit_ok"):
+        if record.get("unit") == idx:
+            last = record
+    if last is None:
+        return ()
+    entries = []
+    for item in last.get("quarantined", ()):
+        if isinstance(item, (list, tuple)) and len(item) == 3:
+            entries.append(
+                QuarantineEntry(
+                    case=int(item[0]), epoch=int(item[1]), tensor=str(item[2])
+                )
+            )
+        else:
+            entries.append(
+                QuarantineEntry(case=int(item), epoch=-1, tensor="unknown")
+            )
+    return tuple(entries)
+
+
+def _batch_on_rung(W, S, ri, re, config, spec, rung, quarantine) -> dict:
+    """One `simulate_batch` dispatch pinned to ladder rung `rung`,
+    blocked to completion so async failures surface inside the
+    supervising try. Module-level so every unit hits the same jitted
+    cache entries — the supervisor adds zero warm-repeat compiles."""
+    import jax
+
+    from yuma_simulation_tpu.simulation.sweep import simulate_batch
+
+    return jax.block_until_ready(
+        simulate_batch(
+            W, S, ri, re, config, spec, epoch_impl=rung, quarantine=quarantine
+        )
+    )
+
+
+def _grid_on_xla(scenario, yuma_version, configs, quarantine) -> dict:
+    """One `sweep_hyperparams` dispatch (grid sweeps have a single-rung
+    ladder: the vmap'd XLA engine), blocked to completion."""
+    import jax
+
+    from yuma_simulation_tpu.simulation.sweep import sweep_hyperparams
+
+    return jax.block_until_ready(
+        sweep_hyperparams(scenario, yuma_version, configs, quarantine=quarantine)
+    )

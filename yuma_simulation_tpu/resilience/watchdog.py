@@ -1,0 +1,176 @@
+"""Deadline watchdog: bounded wall-clock supervision for jit dispatches.
+
+PR 1's retry ladder recovers from failures that *raise*; this module
+covers the ones that *don't*. An XLA/Mosaic compile can hang for hours
+on a pathological program, and a dispatch whose collective partner died
+blocks forever rather than erroring — on a pod-scale sweep either one
+silently wedges the whole job (the Pathways/MegaScale lesson: hang
+detection must be first-class, not an operator staring at a flat
+utilization graph).
+
+:func:`run_with_deadline` executes a dispatch on a *worker thread* and
+watches it from the caller: the worker posts a heartbeat when it
+finishes (result or exception); if the heartbeat does not arrive within
+the :class:`Deadline` budget, the caller logs one
+``event=engine_stalled`` record and raises a typed
+:class:`..errors.EngineStall` — which :func:`..errors.classify_failure`
+treats as retryable, so a stall inside :func:`..retry.run_ladder`
+demotes down the engine ladder exactly like a VMEM exhaustion.
+
+Why a thread and not a signal/alarm: the hang is inside native XLA code
+holding no GIL, so no Python-level interruption can unwind it. The
+worker is a daemon thread that is *abandoned*, not killed — if the
+native call eventually returns, the result is discarded (the
+:class:`_Dispatch` records that its deadline already fired and drops
+the late value on the floor). Abandonment is safe here because every
+dispatch in this framework is functionally pure: the only leaked
+resources are the thread stack and the (shared, process-global) jit
+cache entry the late compile populates — which the retry then reuses
+for free.
+
+Zero cost on the healthy path beyond one thread spawn per supervised
+dispatch (~50 us, dwarfed by any real dispatch); jit caches are
+process-global, so running a dispatch on a worker thread adds no
+compiles (pinned by tests/unit/test_recompilation.py's supervised
+budget).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Callable, Optional, TypeVar
+
+from yuma_simulation_tpu.resilience.errors import EngineStall
+from yuma_simulation_tpu.utils.logging import log_event
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+@dataclasses.dataclass(frozen=True)
+class Deadline:
+    """A wall-clock budget for one supervised dispatch.
+
+    `budget_seconds` is the hard limit: the dispatch (compile included —
+    first calls pay the trace+compile inside the budget) must post its
+    heartbeat within it. `grace_seconds` is added on retries of the SAME
+    work (`attempt > 0` in :meth:`budget_for_attempt`): a retried
+    dispatch may legitimately need to recompile after a cache-poisoning
+    failure, and killing the retry on the cold-start budget would turn
+    one transient stall into a guaranteed ladder walk.
+    """
+
+    budget_seconds: float
+    grace_seconds: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.budget_seconds <= 0:
+            raise ValueError("Deadline budget_seconds must be > 0")
+        if self.grace_seconds < 0:
+            raise ValueError("Deadline grace_seconds must be >= 0")
+
+    def budget_for_attempt(self, attempt: int) -> float:
+        """The budget for retry number `attempt` (0 = first try)."""
+        return self.budget_seconds + (self.grace_seconds if attempt else 0.0)
+
+
+class _Dispatch:
+    """One supervised dispatch's shared state between caller and worker.
+
+    The `done` event is the heartbeat; `expired` latches (under `lock`)
+    when the caller gives up, so a worker that wakes up late can see its
+    result is unwanted and drop it instead of leaking device references
+    in a dead thread's frame."""
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.lock = threading.Lock()
+        self.expired = False
+        self.result: object = None
+        self.error: Optional[BaseException] = None
+
+
+def run_with_deadline(
+    fn: Callable[[], T],
+    deadline: Optional[Deadline],
+    *,
+    label: str = "",
+    attempt: int = 0,
+) -> T:
+    """Run `fn()` under `deadline`; raise :class:`EngineStall` on expiry.
+
+    `fn` runs on a daemon worker thread while the caller waits on the
+    heartbeat. Three outcomes:
+
+    - the worker finishes in time: its return value is returned (or its
+      exception re-raised with the original traceback — the retry
+      ladder's `classify_failure` sees exactly what a direct call would
+      have raised);
+    - the budget expires: one ``event=engine_stalled`` record is logged
+      and :class:`EngineStall` raised; the worker is abandoned (see the
+      module docstring for why that is safe here);
+    - `deadline` is None: `fn` runs inline on the caller's thread —
+      supervision off, byte-for-byte the unsupervised code path.
+    """
+    if deadline is None:
+        return fn()
+    budget = deadline.budget_for_attempt(attempt)
+    state = _Dispatch()
+
+    def worker() -> None:
+        try:
+            # Test-only hang simulation (inert in production — one
+            # `is None` check): sleeps HERE, on the worker, so the
+            # caller's deadline machinery sees a real missed heartbeat.
+            from yuma_simulation_tpu.resilience import faults
+
+            faults.maybe_stall_dispatch()
+            result = fn()
+            error = None
+        except BaseException as exc:  # noqa: BLE001 — reported to caller
+            result, error = None, exc
+        with state.lock:
+            if state.expired:
+                # The caller already raised EngineStall for this
+                # dispatch; a late result must not be half-published.
+                return
+            state.result, state.error = result, error
+            # set() under the SAME lock as the publish: outside it, the
+            # caller could time out between the publish and the set,
+            # latch expired, and raise EngineStall for a dispatch whose
+            # result was already complete — a burned retry.
+            state.done.set()
+
+    thread = threading.Thread(
+        target=worker,
+        name=f"yuma-watchdog-{label or 'dispatch'}",
+        daemon=True,
+    )
+    thread.start()
+    if not state.done.wait(budget):
+        with state.lock:
+            if not state.done.is_set():
+                state.expired = True
+        if state.expired:
+            log_event(
+                logger,
+                "engine_stalled",
+                label=label,
+                budget_s=f"{budget:.3f}",
+                attempt=attempt,
+            )
+            raise EngineStall(
+                f"dispatch {label or '<unnamed>'!s} exceeded its "
+                f"{budget:.3f}s deadline (attempt {attempt}); the worker "
+                "was abandoned",
+                budget_seconds=budget,
+            )
+        # Lost the race: the worker posted between wait() timing out and
+        # the lock — take the result, it arrived within epsilon of the
+        # budget.
+    if state.error is not None:
+        raise state.error
+    return state.result  # type: ignore[return-value]

@@ -585,6 +585,7 @@ def simulate(
     mesh: Optional[Mesh] = None,
     max_resident_epochs: Optional[int] = None,
     retry_policy=None,
+    deadline=None,
 ) -> SimulationResult:
     """Simulate one scenario under one named version; returns host arrays.
 
@@ -596,6 +597,14 @@ def simulate(
     xla — logging one structured `event=engine_demoted` record per step;
     the demotion history is returned on `SimulationResult.demotions`.
     Caller errors (bad impl names, shape mistakes) are never retried.
+
+    `deadline` (a :class:`..resilience.watchdog.Deadline`, default None
+    = unbounded): arm the deadline watchdog. Each engine dispatch runs
+    on a supervised worker thread; a compile or dispatch that posts no
+    heartbeat within the budget raises a typed `EngineStall` — which,
+    combined with `retry_policy`, retries and demotes down the ladder
+    exactly like a raising failure (a hung Mosaic compile must not
+    wedge a sweep any harder than a VMEM exhaustion does).
 
     Memory note: `save_bonds`/`save_incentives` default "auto": True (the
     reference driver's outputs, simulation_utils.py:109-112) while the
@@ -763,20 +772,27 @@ def simulate(
                     else jnp.asarray(nf.epoch, jnp.int32)
                 ),
             )
-        if retry_policy is not None:
+        if retry_policy is not None or deadline is not None:
             # Surface async dispatch failures (device OOM) inside the
-            # ladder's try, not at some later host fetch.
+            # ladder's/watchdog's try, not at some later host fetch.
             out = jax.block_until_ready(out)
         return out
 
     demotions = None
-    if retry_policy is None:
+    if retry_policy is None and deadline is None:
         ys = _dispatch(epoch_impl)
+    elif retry_policy is None:
+        from yuma_simulation_tpu.resilience.watchdog import run_with_deadline
+
+        ys = run_with_deadline(
+            lambda: _dispatch(epoch_impl), deadline, label=yuma_version
+        )
     else:
         from yuma_simulation_tpu.resilience.retry import run_ladder
 
         ys, _, records = run_ladder(
-            _dispatch, epoch_impl, retry_policy, label=yuma_version
+            _dispatch, epoch_impl, retry_policy, label=yuma_version,
+            deadline=deadline,
         )
         demotions = tuple(records) or None
     ys = jax.device_get(ys)
@@ -793,14 +809,32 @@ def run_simulation(
     case: Scenario,
     yuma_version: str,
     yuma_config: Optional[YumaConfig] = None,
+    *,
+    supervised: bool = False,
 ) -> tuple[dict[str, list[float]], list[np.ndarray], list[np.ndarray]]:
     """Drop-in equivalent of the reference driver
     (simulation_utils.py:26-112): returns `(dividends_per_validator,
     bonds_per_epoch, server_incentives_per_epoch)` with numpy arrays in
     place of torch tensors.
+
+    `supervised=True` (new, default off — byte-for-byte the reference
+    behavior otherwise) arms the production resilience tier: the
+    default engine-degradation ladder plus the default deadline
+    watchdog, so a hung compile or engine failure degrades and retries
+    instead of wedging/aborting the run (README "Supervised sweeps").
     """
+    supervision = {}
+    if supervised:
+        from yuma_simulation_tpu.resilience.retry import default_retry_policy
+        from yuma_simulation_tpu.resilience.supervisor import default_deadline
+
+        supervision = {
+            "retry_policy": default_retry_policy(),
+            "deadline": default_deadline(),
+        }
     result = simulate(
-        case, yuma_version, yuma_config, save_bonds=True, save_incentives=True
+        case, yuma_version, yuma_config, save_bonds=True, save_incentives=True,
+        **supervision,
     )
     dividends_per_validator = {
         validator: [float(x) for x in result.dividends[:, i]]

@@ -165,6 +165,7 @@ def simulate_batch(
     epoch_impl: str = "xla",
     quarantine: bool = False,
     retry_policy=None,
+    deadline=None,
 ):
     """A scenario suite in one computation.
 
@@ -198,6 +199,11 @@ def simulate_batch(
     rung retry with backoff, then demote toward "xla", logging one
     `event=engine_demoted` record per step (records are log-only here —
     the ys dict stays a pure array pytree).
+
+    `deadline` (a :class:`..resilience.watchdog.Deadline`) arms the
+    deadline watchdog around each dispatch: a hang raises a typed
+    `EngineStall` (one `event=engine_stalled` record), which the armed
+    ladder retries/demotes like any engine failure.
 
     This wrapper is trace-safe with the default knobs (the sharded
     `shard_map` path calls it inside jit): resilience hooks reduce to
@@ -316,16 +322,23 @@ def simulate_batch(
                 guard_nonfinite=quarantine,
                 nan_fault_epochs=nf_epochs,
             )
-        if retry_policy is not None:
+        if retry_policy is not None or deadline is not None:
             out = jax.block_until_ready(out)
         return out
 
-    if retry_policy is None:
+    if retry_policy is None and deadline is None:
         return _dispatch(epoch_impl)
+    if retry_policy is None:
+        from yuma_simulation_tpu.resilience.watchdog import run_with_deadline
+
+        return run_with_deadline(
+            lambda: _dispatch(epoch_impl), deadline, label="simulate_batch"
+        )
     from yuma_simulation_tpu.resilience.retry import run_ladder
 
     ys, _, _ = run_ladder(
-        _dispatch, epoch_impl, retry_policy, label="simulate_batch"
+        _dispatch, epoch_impl, retry_policy, label="simulate_batch",
+        deadline=deadline,
     )
     return ys
 

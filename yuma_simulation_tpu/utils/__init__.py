@@ -7,6 +7,7 @@ equivalents sized to this framework's workloads.
 
 from yuma_simulation_tpu.utils.checkpoint import (  # noqa: F401
     CheckpointedSweep,
+    publish_atomic,
 )
 from yuma_simulation_tpu.utils.profiling import (  # noqa: F401
     enable_compilation_cache,

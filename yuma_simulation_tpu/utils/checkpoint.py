@@ -66,6 +66,18 @@ def _publish(tmp: pathlib.Path, final: pathlib.Path) -> None:
     tmp.replace(final)
 
 
+def publish_atomic(path: pathlib.Path, data: bytes) -> None:
+    """Publish `data` at `path` under the crash-safety contract above:
+    written to a temp name, fsync'd, renamed. The shared primitive for
+    every durable sidecar in the resilience layer (checkpoint manifests
+    and checksums here, the supervisor's :class:`~yuma_simulation_tpu.
+    resilience.supervisor.FailureLedger`)."""
+    path = pathlib.Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    _fsync_write(tmp, lambda f: f.write(data))
+    _publish(tmp, path)
+
+
 def _file_sha256(path: pathlib.Path) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -160,9 +172,7 @@ class CheckpointedSweep:
     # -- atomic JSON sidecars ------------------------------------------
 
     def _write_json(self, path: pathlib.Path, obj) -> None:
-        tmp = path.with_name(path.name + ".tmp")
-        _fsync_write(tmp, lambda f: f.write(json.dumps(obj).encode()))
-        _publish(tmp, path)
+        publish_atomic(path, json.dumps(obj).encode())
 
     def _load_checksums(self) -> dict:
         if self._checksums is not None:

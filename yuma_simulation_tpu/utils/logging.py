@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import logging
 import sys
+from typing import Optional
 
 
 def log_event(
@@ -35,6 +36,73 @@ def log_event(
         f"{k}={fmt(v)}" for k, v in fields.items() if v != ""
     )
     logger.log(level, "event=%s%s", event, f" {payload}" if payload else "")
+
+
+def parse_event_line(line: str) -> Optional[dict]:
+    """Parse one `event=<name> key=value ...` record back into a dict —
+    the exact inverse of :func:`log_event`'s quoting, so supervisor
+    tests and operator tooling can consume recovery records structurally
+    instead of regexing them.
+
+    Anything before the first ``event=`` token (timestamp/level/logger
+    prefixes from the formatter) is skipped; returns ``None`` for lines
+    carrying no event record. All values come back as strings exactly as
+    :func:`log_event` stringified them — double-quoted values are
+    unescaped (``\\\\`` and ``\\"``), bare values taken verbatim. The
+    returned dict includes the event name under ``"event"``.
+    """
+    idx = line.find("event=")
+    if idx > 0 and line[idx - 1] not in (" ", "\t"):
+        # `event=` embedded in some other token (e.g. a quoted message
+        # containing the literal text) — not a record boundary.
+        idx = -1
+    if idx == -1:
+        return None
+    s = line[idx:].rstrip("\n")
+    fields: dict = {}
+    i, n = 0, len(s)
+    while i < n:
+        while i < n and s[i] in (" ", "\t"):
+            i += 1
+        if i >= n:
+            break
+        eq = s.find("=", i)
+        if eq == -1:
+            break
+        key = s[i:eq]
+        if not key or any(c in key for c in (" ", "\t", '"')):
+            break
+        i = eq + 1
+        if i < n and s[i] == '"':
+            i += 1
+            buf = []
+            closed = False
+            while i < n:
+                c = s[i]
+                if c == "\\" and i + 1 < n:
+                    buf.append(s[i + 1])
+                    i += 2
+                    continue
+                if c == '"':
+                    i += 1
+                    closed = True
+                    break
+                buf.append(c)
+                i += 1
+            if not closed:
+                # Torn record (crash mid-line): drop the dangling field,
+                # keep what parsed completely.
+                break
+            fields[key] = "".join(buf)
+        else:
+            j = i
+            while j < n and s[j] not in (" ", "\t"):
+                j += 1
+            fields[key] = s[i:j]
+            i = j
+    if "event" not in fields:
+        return None
+    return fields
 
 
 def setup_logging(level: int = logging.INFO) -> None:

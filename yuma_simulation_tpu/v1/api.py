@@ -86,6 +86,7 @@ def _simulate_suite(
     cases: list[Scenario],
     yuma_versions: list[tuple[str, YumaParams]],
     yuma_hyperparameters: SimulationHyperparameters,
+    supervised: bool = False,
 ) -> dict:
     """ONE batched dispatch per version over the (padded) case suite,
     un-padded back to per-case `run_simulation`-shaped outputs.
@@ -137,6 +138,14 @@ def _simulate_suite(
     # happy path this is a single no-op predicate check.
     from yuma_simulation_tpu.resilience.retry import default_retry_policy
 
+    # `supervised=True` additionally arms the deadline watchdog: a HUNG
+    # compile/dispatch (which raises nothing on its own) is killed at
+    # the default budget and retried/demoted through the same ladder.
+    deadline = None
+    if supervised:
+        from yuma_simulation_tpu.resilience.supervisor import default_deadline
+
+        deadline = default_deadline()
     out = {}
     for yuma_version, yuma_params in yuma_versions:
         config = YumaConfig(
@@ -146,7 +155,7 @@ def _simulate_suite(
         ys = _simulate_batch(
             W, S, ri, re, config, spec,
             save_bonds=True, save_incentives=True, miner_mask=mask,
-            retry_policy=default_retry_policy(),
+            retry_policy=default_retry_policy(), deadline=deadline,
         )
         div = np.asarray(ys["dividends"])  # [B, Ep, Vp]
         bonds = np.asarray(ys["bonds"])  # [B, Ep, Vp, Mp]
@@ -173,17 +182,25 @@ def generate_chart_table(
     yuma_versions: list[tuple[str, YumaParams]],
     yuma_hyperparameters: SimulationHyperparameters,
     draggable_table: bool = False,
+    supervised: bool = False,
 ) -> "HTML":
     """Simulate every case x version and assemble the chart grid
     (rows = chart types per case, columns = versions) as an
-    `IPython.display.HTML` (reference v1/api.py:24-132)."""
+    `IPython.display.HTML` (reference v1/api.py:24-132).
+
+    `supervised=True` (new; off by default) runs every simulation under
+    the full supervision tier — deadline watchdog + engine-degradation
+    ladder — so an unattended artifact build survives hung compiles as
+    well as raising engine failures (README "Supervised sweeps")."""
     table_data: dict[str, list[str]] = {v: [] for v, _ in yuma_versions}
     case_row_ranges: list[tuple[int, int, int]] = []
     row = 0
 
     # One simulation per (case, version) — batched into one dispatch per
     # version across the whole suite.
-    per_pair = _simulate_suite(cases, yuma_versions, yuma_hyperparameters)
+    per_pair = _simulate_suite(
+        cases, yuma_versions, yuma_hyperparameters, supervised=supervised
+    )
 
     for idx, case in enumerate(cases):
         chart_types = list(_CHART_TYPES)
