@@ -46,7 +46,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from yuma_simulation_tpu.utils import enable_compilation_cache
+from yuma_simulation_tpu.telemetry import RunContext, get_registry, record_epoch_rate
+from yuma_simulation_tpu.utils import enable_compilation_cache, setup_logging
 from yuma_simulation_tpu.utils.timing import time_best
 
 enable_compilation_cache()
@@ -118,6 +119,17 @@ def _true_weights_reps(W_e, S_e, config, spec, reps, epoch_impl):
 
 
 def main() -> None:
+    # Operator stream + run-scoped telemetry: the bench is a run like
+    # any sweep — its epoch rate lands on the metrics registry
+    # (`epochs_total`/`epochs_per_sec`) and is emitted as exactly one
+    # run-stamped `event=epoch_rate` record (stderr; the stdout JSON
+    # line below stays byte-compatible).
+    setup_logging()
+    with RunContext():
+        _bench()
+
+
+def _bench() -> None:
     rng = np.random.default_rng(42)
     W = jnp.asarray(rng.random((V, M)), jnp.float32)
     S = jnp.asarray(rng.random((V,)) + 0.01, jnp.float32)
@@ -281,6 +293,13 @@ def main() -> None:
             1,
         )
 
+    record_epoch_rate("bench_primary", epochs_per_sec=primary)
+    # The secondary rates ride the registry snapshot as gauges so a
+    # scrape of the bench process sees the full matrix, not just the
+    # headline.
+    registry = get_registry()
+    for name, rate in secondary.items():
+        registry.gauge(f"bench_{name}_epochs_per_sec").set(rate)
     print(
         json.dumps(
             {

@@ -92,6 +92,7 @@ TEST_CHUNKS = [
         "tests/unit/test_jaxlint.py",
         "tests/unit/test_recompilation.py",
         "tests/unit/test_supervisor.py",
+        "tests/unit/test_telemetry.py",
     ],
 ]
 
@@ -117,6 +118,18 @@ def chaos(session: nox.Session) -> None:
     session.run(
         "python", "-m", "pytest", "tests/", "-q",
         "-m", "faultinject or chaos",
+    )
+    # Mirror the CI obsreport gate: drill a flight-recorder bundle and
+    # fail if any ledger record lacks a resolvable span. The bundle
+    # goes under the session's tmp dir — a fresh directory per run
+    # (the drill refuses to resume a stale bundle) that never pollutes
+    # the working tree.
+    import os
+
+    session.run(
+        "python", "-m", "tools.obsreport",
+        os.path.join(session.create_tmp(), "chaos-bundle"),
+        "--drill", "--check",
     )
 
 

@@ -95,6 +95,14 @@ _CAPABILITY_SKIPS = {
             "test_chaos_drill_all_four_faults_sharded",
         )
     },
+    # The telemetry flight-recorder drill that adds device loss needs
+    # the same elastic sharded dispatch; the rest of test_telemetry.py
+    # runs everywhere.
+    ("test_telemetry.py", "test_chaos_drill_four_faults_sharded_bundle"): (
+        HAS_JAX_SHARD_MAP,
+        f"jax {jax.__version__} has no jax.shard_map "
+        "(pyproject pins jax>=0.7)",
+    ),
     # --- CSV byte-parity pins minted on the jax>=0.7 toolchain ---
     ("test_csv_byte_parity.py", "test_rendered_csv_cells_pinned_exactly"): (
         JAX_AT_PINNED_TOOLCHAIN,

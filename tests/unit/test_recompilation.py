@@ -103,6 +103,41 @@ def test_supervised_simulate_warm_repeat_is_compile_free():
     assert sentinel.new_entries == 0
 
 
+def test_telemetry_instrumented_sweep_is_compile_free(tmp_path):
+    """ISSUE 4 acceptance: the telemetry layer (RunContext + spans +
+    metrics + device sampling + flight-recorder bundle) is host-side
+    only — a fully instrumented supervised sweep adds ZERO warm-repeat
+    compiles over the bare engines."""
+    from yuma_simulation_tpu.resilience import (
+        Deadline,
+        RetryPolicy,
+        SweepSupervisor,
+    )
+    from yuma_simulation_tpu.telemetry import RunContext, load_bundle
+
+    cases = get_cases()[:4]
+    sup = SweepSupervisor(
+        directory=tmp_path,
+        unit_size=2,
+        deadline=Deadline(120.0),
+        retry_policy=RetryPolicy(max_attempts_per_rung=2, backoff_base=0.0),
+    )
+    with RunContext("run-warm"):
+        sup.run_batch(cases, "Yuma 1 (paper)")  # warm-up (cold compiles)
+    with RecompilationSentinel(
+        _simulate_batch_xla,
+        _simulate_scan,
+        budget=0,
+        label="telemetry-instrumented sweep warm repeat",
+    ) as sentinel:
+        with RunContext("run-measured"):
+            out = sup.run_batch(cases, "Yuma 1 (paper)")
+    assert sentinel.new_entries == 0
+    # the instrumentation actually ran: both runs landed in the bundle
+    assert {"run-warm", "run-measured"} <= set(load_bundle(tmp_path).run_ids())
+    assert out["report"].units_resumed == 2  # warm run's chunks reused
+
+
 class _IdentityHashedSpec:
     """A 'static' argument whose equality is object identity: every
     instance is a fresh jit-cache key — the silent-retrace bug the

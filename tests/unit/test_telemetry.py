@@ -1,0 +1,744 @@
+"""Telemetry layer: run-scoped tracing, the metrics registry,
+device/compile sampling, and the sweep flight recorder — ISSUE 4
+acceptance battery.
+
+The combined chaos drill here is the unsharded composition (stall + NaN
+lane + torn chunk) producing a full flight-recorder bundle; the sharded
+composition adding device loss lives behind the conftest
+HAS_JAX_SHARD_MAP probe exactly like the elastic-mesh drills."""
+
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.resilience import (
+    Deadline,
+    FaultPlan,
+    NaNFault,
+    RetryPolicy,
+    StallFault,
+    SweepSupervisor,
+    inject_faults,
+)
+from yuma_simulation_tpu.telemetry import (
+    CompileTracker,
+    MetricsRegistry,
+    RunContext,
+    check_bundle,
+    current_fields,
+    ensure_run,
+    ledger_counts,
+    load_bundle,
+    record_device_telemetry,
+    record_epoch_rate,
+    sample_device_telemetry,
+    span,
+)
+from yuma_simulation_tpu.utils.logging import log_event, parse_event_line
+
+VERSION = "Yuma 1 (paper)"
+POLICY = RetryPolicy(max_attempts_per_rung=2, backoff_base=0.0, seed=0)
+ROOMY = Deadline(budget_seconds=120.0, grace_seconds=120.0)
+
+
+# ------------------------------------------------------ RunContext/spans
+
+
+def test_no_active_run_is_a_noop():
+    assert current_fields() == {}
+    with span("orphan") as s:
+        assert s is None  # spanning without a run costs nothing
+
+
+def test_span_nesting_and_records():
+    with RunContext("run-fixed") as run:
+        assert current_fields() == {"run_id": "run-fixed"}
+        with span("outer") as outer:
+            with span("inner", flavor="x") as inner:
+                fields = current_fields()
+                assert fields["span_id"] == inner.span_id
+                assert fields["parent_id"] == outer.span_id
+        records = run.span_records()
+    # close order: inner first, then outer
+    assert [r["name"] for r in records] == ["inner", "outer"]
+    inner_rec, outer_rec = records
+    assert inner_rec["parent_id"] == outer_rec["span_id"]
+    assert outer_rec["parent_id"] == ""
+    assert inner_rec["attrs"] == {"flavor": "x"}
+    assert all(r["run_id"] == "run-fixed" for r in records)
+    assert all(r["t_end"] >= r["t_start"] for r in records)
+
+
+def test_span_error_status_and_always_closes():
+    with RunContext() as run:
+        with pytest.raises(ValueError, match="boom"):
+            with span("failing"):
+                raise ValueError("boom")
+        assert current_fields() == {"run_id": run.run_id}  # span closed
+    (rec,) = run.span_records()
+    assert rec["status"] == "error"
+
+
+def test_ensure_run_joins_active_run():
+    with RunContext("run-outer") as outer:
+        with ensure_run() as joined:
+            assert joined is outer  # no second run forked for same work
+    with ensure_run() as fresh:
+        assert fresh.run_id != "run-outer"
+
+
+def test_run_context_survives_watchdog_worker_thread():
+    """The watchdog copies the caller's contextvars into its worker, so
+    records emitted during a supervised dispatch carry the caller's
+    run/span identity."""
+    from yuma_simulation_tpu.resilience.watchdog import run_with_deadline
+
+    seen = {}
+
+    def dispatch():
+        seen.update(current_fields())
+        seen["thread"] = threading.current_thread().name
+        return 42
+
+    with RunContext("run-wd"):
+        with span("dispatching") as s:
+            out = run_with_deadline(
+                dispatch, Deadline(30.0), label="ctxprop"
+            )
+    assert out == 42
+    assert seen["run_id"] == "run-wd" and seen["span_id"] == s.span_id
+    assert seen["thread"].startswith("yuma-watchdog-")
+
+
+# ------------------------------------- log_event / ledger identity stamp
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.lines: list[str] = []
+
+    def emit(self, record):
+        self.lines.append(record.getMessage())
+
+
+def _captured_event(**fields) -> dict:
+    logger = logging.getLogger("yuma_simulation_tpu.test_telemetry")
+    logger.propagate = False
+    h = _Capture()
+    logger.addHandler(h)
+    try:
+        log_event(logger, "probe", **fields)
+    finally:
+        logger.removeHandler(h)
+    parsed = parse_event_line(h.lines[0])
+    assert parsed is not None
+    return parsed
+
+
+def test_log_event_stamps_run_and_span_and_roundtrips():
+    """ISSUE 4 satellite: parse_event_line round-trips records carrying
+    the new run_id/span_id fields (they are ordinary key=value pairs —
+    the format is additive)."""
+    with RunContext("run-stamp"):
+        with span("work") as s:
+            parsed = _captured_event(label="x y")  # quoted value too
+    assert parsed == {
+        "event": "probe",
+        "label": "x y",
+        "run_id": "run-stamp",
+        "span_id": s.span_id,
+    }
+    # caller-passed identity wins over the ambient context
+    with RunContext("run-ambient"):
+        parsed = _captured_event(run_id="run-explicit")
+    assert parsed["run_id"] == "run-explicit"
+    # and without a run, no identity fields appear at all
+    assert "run_id" not in _captured_event(label="bare")
+
+
+def test_ledger_records_stamped_with_identity(tmp_path):
+    from yuma_simulation_tpu.resilience.supervisor import FailureLedger
+
+    led = FailureLedger(tmp_path / "ledger.jsonl")
+    with RunContext("run-led"):
+        with span("unit0") as s:
+            led.append("unit_ok", unit=0)
+    led.append("unit_ok", unit=1)  # outside any run: no identity
+    rec0, rec1 = (
+        json.loads(line)
+        for line in (tmp_path / "ledger.jsonl").read_text().splitlines()
+    )
+    assert rec0["run_id"] == "run-led" and rec0["span_id"] == s.span_id
+    assert rec0["t"] > 0
+    assert "run_id" not in rec1  # additive format, old shape still valid
+
+
+# ------------------------------------------------------ metrics registry
+
+
+def test_counter_gauge_histogram_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("epochs_total")
+    c.inc()
+    c.inc(9)
+    assert c.value == 10
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+    g = reg.gauge("epochs_per_sec")
+    g.set(2.5)
+    assert g.value == 2.5
+    h = reg.histogram("unit_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(99)
+    snap = h.snapshot()
+    assert snap["count"] == 3 and snap["sum"] == pytest.approx(99.55)
+    assert snap["buckets"] == {"0.1": 1, "1.0": 2, "+Inf": 3}
+    # get-or-create returns the same instance; kind mismatch is loud
+    assert reg.counter("epochs_total") is c
+    with pytest.raises(TypeError, match="already registered"):
+        reg.gauge("epochs_total")
+    with pytest.raises(ValueError, match="Prometheus"):
+        reg.counter("bad name!")
+
+
+def test_counter_thread_safe_under_contention():
+    reg = MetricsRegistry()
+    c = reg.counter("hits")
+
+    def hammer():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+
+
+def test_snapshot_and_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("engine_demotions", help="ladder demotions").inc(3)
+    reg.gauge("device_peak_bytes").set(1 << 20)
+    reg.histogram("unit_seconds", buckets=(1.0,)).observe(0.5)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"engine_demotions": 3}
+    assert snap["gauges"] == {"device_peak_bytes": float(1 << 20)}
+    assert snap["histograms"]["unit_seconds"]["count"] == 1
+    text = reg.prometheus_text()
+    assert "# HELP engine_demotions ladder demotions" in text
+    assert "# TYPE engine_demotions counter" in text
+    assert "engine_demotions 3" in text
+    assert "device_peak_bytes 1048576" in text
+    assert 'unit_seconds_bucket{le="+Inf"} 1' in text
+    assert text.endswith("\n")
+
+
+def test_publish_snapshot_jsonl_accumulates_and_tolerates_torn_tail(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("epochs_total").inc(5)
+    path = tmp_path / "metrics.jsonl"
+    reg.publish_snapshot(path, run_id="run-a")
+    reg.counter("epochs_total").inc(5)
+    # simulate a torn line from a pre-atomic writer between snapshots
+    path.write_text(path.read_text() + '{"torn": ')
+    reg.publish_snapshot(path, run_id="run-b")
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [ln["run_id"] for ln in lines] == ["run-a", "run-b"]
+    assert lines[0]["counters"]["epochs_total"] == 5
+    assert lines[1]["counters"]["epochs_total"] == 10
+    assert all("t" in ln for ln in lines)
+
+
+def test_record_epoch_rate_feeds_registry_and_emits_event(caplog):
+    reg = MetricsRegistry()
+    with caplog.at_level(logging.INFO):
+        rate = record_epoch_rate(
+            "probe_run", epochs=100, seconds=4.0, registry=reg
+        )
+    assert rate == 25.0
+    assert reg.counter("epochs_total").value == 100
+    assert reg.gauge("epochs_per_sec").value == 25.0
+    events = [
+        p
+        for line in caplog.text.splitlines()
+        if (p := parse_event_line(line)) is not None
+    ]
+    (rec,) = [e for e in events if e["event"] == "epoch_rate"]
+    assert rec["label"] == "probe_run"
+    assert rec["epochs"] == "100" and rec["epochs_per_sec"] == "25.0"
+
+
+def test_timed_routes_through_epoch_rate(caplog):
+    """ISSUE 4 satellite: `timed` is no longer dead code with drifting
+    docs — with `epochs` it reports through the registry and emits one
+    event=epoch_rate record."""
+    from yuma_simulation_tpu.telemetry import get_registry
+    from yuma_simulation_tpu.utils.profiling import timed
+
+    before = get_registry().counter("epochs_total").value
+    with caplog.at_level(logging.INFO):
+        with timed("timed_probe", epochs=7) as t:
+            pass
+    assert t.seconds >= 0
+    assert get_registry().counter("epochs_total").value == before + 7
+    events = [
+        p
+        for line in caplog.text.splitlines()
+        if (p := parse_event_line(line)) is not None
+        and p["event"] == "epoch_rate"
+    ]
+    assert len(events) == 1 and events[0]["label"] == "timed_probe"
+
+
+# ----------------------------------------- device / compile telemetry
+
+
+def test_device_sample_degrades_gracefully_on_cpu():
+    """ISSUE 4 satellite: memory_stats() is None on every CPU device —
+    the sample must say so (None, not 0) and still count devices."""
+    sample = sample_device_telemetry()
+    assert sample["backend"] == "cpu"
+    assert sample["num_devices"] >= 1
+    assert sample["device_peak_bytes"] is None
+    assert sample["device_bytes_in_use"] is None
+    assert sample["live_buffers"] is not None  # introspection exists on CPU
+
+
+def test_device_sample_handles_absent_memory_stats(monkeypatch):
+    """A device object with no memory_stats at all (older runtimes) is
+    the same graceful None, not an exception."""
+    import jax
+
+    class _BareDevice:
+        pass
+
+    monkeypatch.setattr(jax, "devices", lambda: [_BareDevice()])
+    sample = sample_device_telemetry()
+    assert sample["num_devices"] == 1
+    assert sample["device_peak_bytes"] is None
+
+
+def test_record_device_telemetry_leaves_gauges_untouched_on_none():
+    reg = MetricsRegistry()
+    reg.gauge("device_peak_bytes").set(777.0)  # a real prior TPU reading
+    sample = record_device_telemetry(reg)
+    assert sample["device_peak_bytes"] is None  # CPU run
+    assert reg.gauge("device_peak_bytes").value == 777.0  # not zeroed
+    if sample["live_buffers"] is not None:
+        assert reg.gauge("live_buffers").value == sample["live_buffers"]
+
+
+def test_compile_tracker_counts_new_cache_entries():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    reg = MetricsRegistry()
+    tracker = CompileTracker(f, registry=reg)
+    f(jnp.ones(3))  # new shape -> one compile
+    assert tracker.record() == 1
+    f(jnp.ones(3))  # warm repeat -> zero
+    assert tracker.record() == 0
+    assert reg.counter("recompiles").value == 1
+    with pytest.raises(TypeError, match="_cache_size"):
+        CompileTracker(lambda x: x)
+    with pytest.raises(ValueError, match="at least one"):
+        CompileTracker()
+
+
+def test_recompilation_sentinel_feeds_recompiles_counter():
+    import jax
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.telemetry import get_registry
+    from yuma_simulation_tpu.utils.profiling import RecompilationSentinel
+
+    @jax.jit
+    def g(x):
+        return x * 2
+
+    before = get_registry().counter("recompiles").value
+    with RecompilationSentinel(g, budget=1, label="telemetry probe"):
+        g(jnp.ones(5))
+    assert get_registry().counter("recompiles").value == before + 1
+
+
+# ------------------------------------------------- profile_trace finally
+
+
+def test_profile_trace_logs_pointer_even_on_failure(tmp_path, caplog):
+    """ISSUE 4 satellite: an exception inside the traced region must not
+    eat the pointer to the dump that would explain it."""
+    from yuma_simulation_tpu.utils import profile_trace
+
+    with caplog.at_level(logging.INFO, "yuma_simulation_tpu.utils.profiling"):
+        with pytest.raises(RuntimeError, match="mid-trace"):
+            with profile_trace(str(tmp_path / "trace")):
+                raise RuntimeError("mid-trace")
+    assert any(
+        "profiler trace written" in r.getMessage() for r in caplog.records
+    )
+
+
+# ------------------------------------------- the flight-recorder bundle
+
+
+def _supervisor(**kw):
+    kw.setdefault("unit_size", 3)
+    kw.setdefault("deadline", ROOMY)
+    kw.setdefault("retry_policy", POLICY)
+    return SweepSupervisor(**kw)
+
+
+def test_clean_supervised_sweep_writes_sound_bundle(tmp_path):
+    from yuma_simulation_tpu.scenarios import get_cases
+
+    out = _supervisor(directory=tmp_path).run_batch(
+        get_cases()[:4], VERSION
+    )
+    assert out["report"].clean
+    bundle = load_bundle(tmp_path)
+    assert check_bundle(bundle) == []
+    (run_id,) = bundle.run_ids()
+    # span chain: sweep -> unit -> attempt -> engine rung
+    names = [s["name"] for s in bundle.spans]
+    assert any(n.startswith("sweep:") for n in names)
+    assert "unit0" in names and "attempt1" in names
+    assert any(n.startswith("engine:") for n in names)
+    # every ledger record resolves under the one run
+    assert all(r["run_id"] == run_id for r in bundle.ledger)
+    # one metrics snapshot line with the epoch counters
+    (snap,) = bundle.metrics
+    assert snap["run_id"] == run_id
+    assert snap["counters"]["epochs_total"] > 0
+    assert snap["gauges"]["epochs_per_sec"] > 0
+    # report.json cross-checks clean
+    assert bundle.report["run_id"] == run_id
+    assert bundle.report["report"]["stalls_killed"] == 0
+
+
+@pytest.mark.chaos
+def test_chaos_drill_bundle_reconstructs_timeline(tmp_path, caplog):
+    """ISSUE 4 acceptance (unsharded composition): the stall + NaN +
+    torn-chunk drill produces a flight-recorder bundle where every
+    ledger record resolves to a span under ONE run_id and the
+    ledger-derived counts match the SweepHealthReport exactly."""
+    from yuma_simulation_tpu.scenarios import get_cases
+    from yuma_simulation_tpu.telemetry import build_timeline
+
+    cases = get_cases()[:4]
+    # Warm-up passes (the chaos pass's tight budget must only ever kill
+    # the injected hold — same discipline as test_supervisor).
+    _supervisor().run_batch(cases, VERSION)
+    with inject_faults(FaultPlan(nan=NaNFault(epoch=2, case=1))):
+        _supervisor().run_batch(cases, VERSION)
+
+    plan = FaultPlan(
+        stall=StallFault(seconds=1.0, dispatches=1),
+        nan=NaNFault(epoch=2, case=1),
+        truncate_chunks={1: 10},
+    )
+    with caplog.at_level(logging.INFO):
+        with inject_faults(plan):
+            out = _supervisor(
+                directory=tmp_path,
+                deadline=Deadline(0.15, grace_seconds=60.0),
+            ).run_batch(cases, VERSION)
+    report = out["report"]
+    assert report.stalls_killed == 1
+    assert report.units_requeued == 1
+    assert report.lanes_quarantined == 1
+
+    bundle = load_bundle(tmp_path)
+    assert check_bundle(bundle) == []
+    (run_id,) = bundle.run_ids()
+    assert bundle.ledger, "the drill must ledger its recovery actions"
+    span_ids = {s["span_id"] for s in bundle.spans}
+    for rec in bundle.ledger:
+        assert rec["run_id"] == run_id
+        assert rec["span_id"] in span_ids
+
+    # the ledger-derived counts ARE the report's counts
+    derived = ledger_counts(bundle.ledger, run_id)
+    assert derived == {
+        "stalls_killed": report.stalls_killed,
+        "units_requeued": report.units_requeued,
+        "engine_demotions": report.engine_demotions,
+        "mesh_shrinks": report.mesh_shrinks,
+        "lanes_quarantined": report.lanes_quarantined,
+    }
+
+    # the timeline reconstructs: one sweep root, the stalled attempt's
+    # engine span is marked error, and the requeued unit appears twice
+    tl = build_timeline(bundle, run_id)
+    roots = [tl["spans"][r]["name"] for r in tl["roots"]]
+    assert any(n.startswith("sweep:") for n in roots)
+    statuses = [
+        s["status"]
+        for s in tl["spans"].values()
+        if s["name"].startswith("engine:")
+    ]
+    assert "error" in statuses  # the stalled attempt's rung span
+    unit1_spans = [
+        s for s in tl["spans"].values() if s["name"] == "unit1"
+    ]
+    assert len(unit1_spans) == 2  # original + requeue
+    # and the log stream carries the same run identity end to end
+    stamped = [
+        p
+        for line in caplog.text.splitlines()
+        if (p := parse_event_line(line)) is not None
+        and p.get("run_id") == run_id
+    ]
+    assert any(e["event"] == "engine_stalled" for e in stamped)
+    assert any(e["event"] == "epoch_rate" for e in stamped)
+
+
+def test_bundle_sound_under_operator_opened_spans(tmp_path):
+    """The README's own usage — the supervisor joining an operator
+    RunContext inside an operator span — must yield a sound bundle: the
+    still-open outer span is recorded (status=open) so the sweep span's
+    parent resolves, and a second sweep in the same run replaces it
+    instead of duplicating spans."""
+    from yuma_simulation_tpu.scenarios import get_cases
+
+    cases = get_cases()[:4]
+    with RunContext() as run:
+        with span("nightly"):
+            _supervisor(directory=tmp_path).run_batch(cases, VERSION)
+            bundle = load_bundle(tmp_path)
+            assert check_bundle(bundle) == []
+            (nightly,) = [
+                s for s in bundle.spans if s["name"] == "nightly"
+            ]
+            assert nightly["status"] == "open" and nightly["t_end"] is None
+            # second sweep in the SAME run: spans merge, not duplicate
+            _supervisor(directory=tmp_path).run_batch(cases, VERSION)
+    bundle = load_bundle(tmp_path)
+    assert check_bundle(bundle) == []
+    assert bundle.run_ids() == [run.run_id]
+    keys = [(s["run_id"], s["span_id"]) for s in bundle.spans]
+    assert len(keys) == len(set(keys)), "republish must not duplicate spans"
+    assert len([s for s in bundle.spans if s["name"] == "nightly"]) == 1
+
+
+def test_ledger_counts_requeued_units_not_events():
+    """SweepHealthReport.units_requeued counts UNITS; a unit torn twice
+    emits two unit_requeued records but must derive as one."""
+    ledger = [
+        {"event": "unit_requeued", "unit": 0, "executions": 2,
+         "run_id": "run-a", "span_id": "s0001"},
+        {"event": "unit_requeued", "unit": 0, "executions": 3,
+         "run_id": "run-a", "span_id": "s0002"},
+        {"event": "unit_requeued", "unit": 2, "executions": 2,
+         "run_id": "run-a", "span_id": "s0003"},
+    ]
+    assert ledger_counts(ledger, "run-a")["units_requeued"] == 2
+
+
+@pytest.mark.chaos
+def test_resumed_sweep_appends_second_run_to_bundle(tmp_path):
+    from yuma_simulation_tpu.scenarios import get_cases
+
+    cases = get_cases()[:4]
+    first = _supervisor(directory=tmp_path).run_batch(cases, VERSION)
+    second = _supervisor(directory=tmp_path).run_batch(cases, VERSION)
+    assert second["report"].units_resumed == 2
+    np.testing.assert_array_equal(first["dividends"], second["dividends"])
+    bundle = load_bundle(tmp_path)
+    assert len(bundle.run_ids()) == 2
+    assert check_bundle(bundle) == []  # both runs fully resolvable
+    assert len(bundle.metrics) == 2  # one snapshot per run
+    # report.json is the LATEST run's
+    assert bundle.report["run_id"] == bundle.run_ids()[-1]
+    assert bundle.report["report"]["units_resumed"] == 2
+
+
+@pytest.mark.chaos
+def test_failed_sweep_still_publishes_bundle(tmp_path, monkeypatch):
+    """A sweep that dies mid-run must leave a bundle whose ledger
+    records still resolve — the crash is exactly when the operator
+    needs the timeline."""
+    import yuma_simulation_tpu.resilience.supervisor as supervisor_mod
+    from yuma_simulation_tpu.scenarios import get_cases
+
+    def explode(*a, **k):
+        raise ArithmeticError("not an engine failure")
+
+    monkeypatch.setattr(supervisor_mod, "_batch_on_rung", explode)
+    with pytest.raises(ArithmeticError):
+        _supervisor(directory=tmp_path).run_batch(get_cases()[:2], VERSION)
+    bundle = load_bundle(tmp_path)
+    assert check_bundle(bundle) == []
+    assert any(r["event"] == "unit_failed" for r in bundle.ledger)
+    failed = [s for s in bundle.spans if s["status"] == "error"]
+    assert failed, "the failing spans must be recorded as errors"
+
+
+# ------------------------------------------------------------ obsreport
+
+
+@pytest.mark.chaos
+def test_obsreport_renders_and_checks_drill_bundle(tmp_path, capsys):
+    from tools.obsreport import main as obsreport_main
+    from yuma_simulation_tpu.scenarios import get_cases
+
+    cases = get_cases()[:4]
+    _supervisor().run_batch(cases, VERSION)  # warm
+    with inject_faults(
+        FaultPlan(stall=StallFault(seconds=1.0, dispatches=1))
+    ):
+        _supervisor(
+            directory=tmp_path, deadline=Deadline(0.15, grace_seconds=60.0)
+        ).run_batch(cases, VERSION)
+
+    assert obsreport_main([str(tmp_path), "--check"]) == 0
+    text = capsys.readouterr().out
+    assert "unit_stalled" in text and "sweep:" in text
+    assert "ledger-derived counts" in text
+    assert "bundle is sound" in text
+
+    assert obsreport_main([str(tmp_path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["runs"] and payload["ledger"]
+
+    # tamper: a ledger record with no span identity must fail --check
+    ledger_path = tmp_path / "ledger.jsonl"
+    ledger_path.write_text(
+        ledger_path.read_text() + '{"event": "unit_ok", "unit": 9}\n'
+    )
+    assert obsreport_main([str(tmp_path), "--check"]) == 2
+    err = capsys.readouterr().err
+    assert "lacks run/span identity" in err
+
+
+def test_obsreport_empty_directory_reports_gracefully(tmp_path, capsys):
+    from tools.obsreport import main as obsreport_main
+
+    assert obsreport_main([str(tmp_path)]) == 0
+    assert "no runs recorded" in capsys.readouterr().out
+
+
+def test_check_bundle_flags_unresolvable_span(tmp_path):
+    (tmp_path / "spans.jsonl").write_text(
+        json.dumps(
+            {
+                "span_id": "s0001",
+                "parent_id": "",
+                "name": "sweep:x",
+                "run_id": "run-a",
+                "t_start": 1.0,
+                "t_end": 2.0,
+                "status": "ok",
+            }
+        )
+        + "\n"
+    )
+    (tmp_path / "ledger.jsonl").write_text(
+        json.dumps(
+            {
+                "event": "unit_ok",
+                "unit": 0,
+                "run_id": "run-a",
+                "span_id": "s0099",
+            }
+        )
+        + "\n"
+    )
+    problems = check_bundle(load_bundle(tmp_path))
+    assert len(problems) == 1 and "does not resolve" in problems[0]
+
+
+def test_check_bundle_flags_report_mismatch(tmp_path):
+    (tmp_path / "spans.jsonl").write_text(
+        json.dumps(
+            {
+                "span_id": "s0001",
+                "parent_id": "",
+                "name": "sweep:x",
+                "run_id": "run-a",
+                "t_start": 1.0,
+                "t_end": 2.0,
+                "status": "ok",
+            }
+        )
+        + "\n"
+    )
+    (tmp_path / "ledger.jsonl").write_text(
+        json.dumps(
+            {
+                "event": "unit_stalled",
+                "unit": 0,
+                "run_id": "run-a",
+                "span_id": "s0001",
+            }
+        )
+        + "\n"
+    )
+    (tmp_path / "report.json").write_text(
+        json.dumps({"run_id": "run-a", "report": {"stalls_killed": 0}})
+    )
+    problems = check_bundle(load_bundle(tmp_path))
+    assert len(problems) == 1
+    assert "stalls_killed" in problems[0] and "derives 1" in problems[0]
+
+
+# ------------------------------------- sharded composition (gated)
+
+
+@pytest.mark.chaos
+def test_chaos_drill_four_faults_sharded_bundle(tmp_path):
+    """ISSUE 4 acceptance, full composition: stall + device loss + NaN
+    lane + torn chunk under one supervised SHARDED sweep — the bundle
+    resolves completely and the counts (mesh shrink included) match the
+    report. Gated on jax.shard_map via the conftest probe."""
+    from yuma_simulation_tpu.parallel import make_mesh
+    from yuma_simulation_tpu.resilience import DeviceLossFault
+    from yuma_simulation_tpu.scenarios import get_cases
+
+    cases = get_cases()[:4]
+    mesh = make_mesh()
+    lost = mesh.devices.flat[1].id
+    _supervisor().run_batch(cases, VERSION, mesh=mesh)  # warm full mesh
+    with inject_faults(
+        FaultPlan(
+            device_loss=DeviceLossFault(device_id=lost),
+            nan=NaNFault(epoch=2, case=1),
+        )
+    ):
+        _supervisor().run_batch(cases, VERSION, mesh=mesh)  # warm shrunk
+
+    plan = FaultPlan(
+        stall=StallFault(seconds=12.0, dispatches=1),
+        device_loss=DeviceLossFault(device_id=lost),
+        nan=NaNFault(epoch=2, case=1),
+        truncate_chunks={1: 10},
+    )
+    with inject_faults(plan):
+        out = _supervisor(
+            directory=tmp_path, deadline=Deadline(1.5, grace_seconds=6.0)
+        ).run_batch(cases, VERSION, mesh=mesh)
+    report = out["report"]
+    assert report.mesh_shrinks >= 1 and report.stalls_killed >= 1
+    assert report.lanes_quarantined == 1 and report.units_requeued == 1
+
+    bundle = load_bundle(tmp_path)
+    assert check_bundle(bundle) == []
+    (run_id,) = bundle.run_ids()
+    derived = ledger_counts(bundle.ledger, run_id)
+    assert derived["mesh_shrinks"] == report.mesh_shrinks
+    assert derived["stalls_killed"] == report.stalls_killed
+    assert derived["lanes_quarantined"] == report.lanes_quarantined
+    # the mesh walk appears as spans too
+    names = [s["name"] for s in bundle.spans]
+    assert any(n.startswith("mesh:") for n in names)

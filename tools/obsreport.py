@@ -1,0 +1,300 @@
+"""obsreport: render a sweep's flight-recorder bundle as a human timeline.
+
+The operator's one-stop answer to "what did this sweep actually do":
+loads the bundle a supervised sweep leaves in its checkpoint directory
+(``ledger.jsonl`` + ``spans.jsonl`` + ``metrics.jsonl`` +
+``report.json`` — see :mod:`yuma_simulation_tpu.telemetry.flight`) and
+renders the span tree with every ledger record — demotions, stalls,
+shrinks, requeues, quarantines — attributed to its span, cross-checked
+against the run's `SweepHealthReport`.
+
+Usage::
+
+    python -m tools.obsreport SWEEP_DIR              # timeline, latest run
+    python -m tools.obsreport SWEEP_DIR --run RUN_ID # a specific run
+    python -m tools.obsreport SWEEP_DIR --check      # CI gate: exit 2 on
+                                                     # unresolvable records
+                                                     # or report mismatch
+    python -m tools.obsreport SWEEP_DIR --json       # machine-readable
+    python -m tools.obsreport SWEEP_DIR --drill      # run the chaos drill
+                                                     # into SWEEP_DIR first
+                                                     # (CI smoke; CPU)
+
+``--drill`` provokes the full chaos composition deterministically via
+the test-only fault hooks — a stall, a NaN lane, a torn checkpoint
+chunk, and (when ``jax.shard_map`` is available) a device loss on the
+virtual 8-device CPU mesh — so the CI chaos lane can produce, gate and
+upload a real bundle on every push.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+#: Identity/bookkeeping keys not repeated per rendered ledger record.
+_IDENTITY_KEYS = ("event", "t", "run_id", "span_id", "parent_id")
+
+
+def _fmt_ts(t: float | None) -> str:
+    if not t:
+        return "--:--:--.---"
+    return datetime.datetime.fromtimestamp(t).strftime("%H:%M:%S.%f")[:-3]
+
+
+def _fmt_fields(rec: dict) -> str:
+    parts = []
+    for k, v in rec.items():
+        if k in _IDENTITY_KEYS:
+            continue
+        parts.append(f"{k}={json.dumps(v) if isinstance(v, (list, dict)) else v}")
+    return " ".join(parts)
+
+
+def render_run(bundle, run_id: str) -> str:
+    """One run's recovery timeline as indented text."""
+    from yuma_simulation_tpu.telemetry.flight import build_timeline
+
+    tl = build_timeline(bundle, run_id)
+    lines = [f"run {run_id}"]
+    if not tl["spans"]:
+        lines.append("  (no spans recorded for this run)")
+
+    def emit(span_id: str, depth: int) -> None:
+        s = tl["spans"][span_id]
+        t0, t1 = s.get("t_start"), s.get("t_end")
+        dur = f"{t1 - t0:.3f}s" if t0 and t1 else "?"
+        status = "" if s.get("status") == "ok" else f"  {s['status'].upper()}"
+        attrs = s.get("attrs") or {}
+        attr_txt = "".join(
+            f" {k}={json.dumps(v)}" for k, v in attrs.items() if k != "steps"
+        )
+        pad = "  " * (depth + 1)
+        lines.append(
+            f"{pad}{_fmt_ts(t0)}  {s.get('name')} [{span_id}] "
+            f"{dur}{attr_txt}{status}"
+        )
+        for rec in tl["records"].get(span_id, ()):
+            lines.append(
+                f"{pad}  * {_fmt_ts(rec.get('t'))} "
+                f"{rec.get('event')} {_fmt_fields(rec)}".rstrip()
+            )
+        for child in tl["children"].get(span_id, ()):
+            emit(child, depth + 1)
+
+    for root in tl["roots"]:
+        emit(root, 0)
+    orphans = tl["records"].get("", ())
+    if orphans:
+        lines.append("  records with no span (pre-telemetry writer?):")
+        for rec in orphans:
+            lines.append(f"    * {rec.get('event')} {_fmt_fields(rec)}")
+    return "\n".join(lines)
+
+
+def render(bundle, run_id: str | None) -> str:
+    from yuma_simulation_tpu.telemetry.flight import ledger_counts
+
+    lines = [f"flight bundle: {bundle.directory}"]
+    runs = bundle.run_ids()
+    if not runs:
+        lines.append("no runs recorded (empty or pre-telemetry directory)")
+        return "\n".join(lines)
+    lines.append(
+        "runs: " + ", ".join(runs) + f"  (ledger: {len(bundle.ledger)} "
+        f"records, spans: {len(bundle.spans)})"
+    )
+    target = run_id if run_id is not None else runs[-1]
+    lines.append("")
+    lines.append(render_run(bundle, target))
+    counts = ledger_counts(bundle.ledger, target)
+    lines.append("")
+    lines.append(
+        "ledger-derived counts: "
+        + " ".join(f"{k}={v}" for k, v in counts.items())
+    )
+    if bundle.report is not None and bundle.report.get("run_id") == target:
+        rep = bundle.report.get("report", {})
+        lines.append(
+            "health report:         "
+            + " ".join(f"{k}={rep.get(k)}" for k in counts)
+        )
+    if bundle.metrics:
+        last = bundle.metrics[-1]
+        counters = last.get("counters", {})
+        gauges = last.get("gauges", {})
+        lines.append(
+            "metrics (last snapshot): "
+            + " ".join(
+                f"{k}={_num(v)}" for k, v in {**counters, **gauges}.items()
+            )
+        )
+    return "\n".join(lines)
+
+
+def _num(v):
+    return int(v) if isinstance(v, float) and v.is_integer() else v
+
+
+def run_drill(directory: str) -> None:
+    """The deterministic chaos drill: stall + NaN lane + torn chunk
+    (+ device loss when `jax.shard_map` exists), supervised into
+    `directory` — produces a complete flight-recorder bundle. CPU-only
+    by construction (the virtual 8-device mesh)."""
+    import pathlib
+
+    target = pathlib.Path(directory)
+    if target.exists() and any(target.iterdir()):
+        # A resumed drill satisfies every unit from the prior run's
+        # chunks, dispatches nothing, and the armed faults never fire —
+        # a green gate that verified nothing. Refuse rather than
+        # silently no-op (and never delete a directory we didn't write).
+        raise SystemExit(
+            f"--drill target {directory!r} already exists and is not "
+            "empty; point the drill at a fresh directory (a resumed "
+            "drill exercises none of its faults)"
+        )
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    from yuma_simulation_tpu.resilience import (
+        Deadline,
+        DeviceLossFault,
+        FaultPlan,
+        NaNFault,
+        RetryPolicy,
+        StallFault,
+        SweepSupervisor,
+        inject_faults,
+    )
+    from yuma_simulation_tpu.scenarios import get_cases
+    from yuma_simulation_tpu.utils import setup_logging
+
+    setup_logging()
+    version = "Yuma 1 (paper)"
+    cases = get_cases()[:4]
+    policy = RetryPolicy(max_attempts_per_rung=2, backoff_base=0.0, seed=0)
+    roomy = Deadline(120.0, grace_seconds=120.0)
+    sharded = hasattr(jax, "shard_map")
+    mesh = None
+    lost = None
+    if sharded:
+        from yuma_simulation_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        lost = mesh.devices.flat[1].id
+    dispatch_kwargs = {"mesh": mesh} if mesh is not None else {}
+
+    def supervisor(d, deadline):
+        return SweepSupervisor(
+            directory=d, unit_size=3, deadline=deadline, retry_policy=policy
+        )
+
+    # Warm-up passes under the roomy budget, exactly as the chaos tests
+    # do: the tight chaos deadline must only ever kill the injected
+    # hold, never a machine-speed-dependent cold compile — including
+    # the NaN-operand and degraded-mesh jit variants.
+    supervisor(None, roomy).run_batch(cases, version, **dispatch_kwargs)
+    warm = {"nan": NaNFault(epoch=2, case=1)}
+    if sharded:
+        warm["device_loss"] = DeviceLossFault(device_id=lost)
+    with inject_faults(FaultPlan(**warm)):
+        supervisor(None, roomy).run_batch(cases, version, **dispatch_kwargs)
+
+    # Post-shrink attempts get the retry grace; the hold must exceed
+    # budget + grace wherever it lands (same arithmetic as the tests).
+    plan_kwargs = dict(
+        nan=NaNFault(epoch=2, case=1),
+        truncate_chunks={1: 10},
+    )
+    if sharded:
+        plan_kwargs["stall"] = StallFault(seconds=12.0, dispatches=1)
+        plan_kwargs["device_loss"] = DeviceLossFault(device_id=lost)
+        tight = Deadline(1.5, grace_seconds=6.0)
+    else:
+        plan_kwargs["stall"] = StallFault(seconds=1.0, dispatches=1)
+        tight = Deadline(0.15, grace_seconds=60.0)
+    with inject_faults(FaultPlan(**plan_kwargs)):
+        out = supervisor(directory, tight).run_batch(
+            cases, version, **dispatch_kwargs
+        )
+    report = out["report"]
+    print(
+        f"drill complete ({'sharded, 4 faults' if sharded else '3 faults'}):"
+        f" stalls={report.stalls_killed} requeued={report.units_requeued}"
+        f" shrinks={report.mesh_shrinks}"
+        f" quarantined={report.lanes_quarantined}"
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="obsreport", description=__doc__.split("\n\n")[0]
+    )
+    parser.add_argument("directory", help="the supervised sweep directory")
+    parser.add_argument(
+        "--run", default=None, help="run_id to render (default: latest)"
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="consistency gate: exit 2 if any ledger record lacks a "
+        "resolvable span or the report counts mismatch the ledger",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the bundle as JSON"
+    )
+    parser.add_argument(
+        "--drill",
+        action="store_true",
+        help="run the deterministic chaos drill into DIRECTORY first "
+        "(CI smoke; forces the CPU backend)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.drill:
+        run_drill(args.directory)
+
+    from yuma_simulation_tpu.telemetry.flight import check_bundle, load_bundle
+
+    bundle = load_bundle(args.directory)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "directory": str(bundle.directory),
+                    "runs": bundle.run_ids(),
+                    "spans": bundle.spans,
+                    "ledger": bundle.ledger,
+                    "metrics": bundle.metrics,
+                    "report": bundle.report,
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        print(render(bundle, args.run))
+    if args.check:
+        problems = check_bundle(bundle)
+        if problems:
+            print("\nobsreport --check FAILED:", file=sys.stderr)
+            for p in problems:
+                print(f"  - {p}", file=sys.stderr)
+            return 2
+        print("\nobsreport --check: bundle is sound")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,6 +16,8 @@ import pathlib
 from yuma_simulation_tpu.models.config import SimulationHyperparameters
 from yuma_simulation_tpu.models.variants import canonical_versions
 from yuma_simulation_tpu.scenarios import create_case, get_cases
+from yuma_simulation_tpu.telemetry import RunContext
+from yuma_simulation_tpu.utils import profile_trace, setup_logging
 from yuma_simulation_tpu.v1.api import generate_chart_table
 
 
@@ -43,7 +45,17 @@ def main(argv: list[str] | None = None) -> None:
         action="store_true",
         help="emit the notebook-style table instead of the drag-to-scroll one",
     )
+    parser.add_argument(
+        "--profile-dir",
+        default=None,
+        help="write a jax.profiler trace (Perfetto/XPlane) of the whole "
+        "build under this directory (default: no profiling)",
+    )
     args = parser.parse_args(argv)
+
+    # Operator-facing stream (structured event= records included) — the
+    # logging setup was previously never wired into any entry point.
+    setup_logging()
 
     if args.cases:
         cases = [create_case(name) for name in args.cases]
@@ -51,17 +63,23 @@ def main(argv: list[str] | None = None) -> None:
         cases = get_cases()
 
     args.out_dir.mkdir(parents=True, exist_ok=True)
-    for bond_penalty in args.bond_penalty:
-        hp = SimulationHyperparameters(bond_penalty=float(bond_penalty))
-        table = generate_chart_table(
-            cases,
-            canonical_versions(),
-            hp,
-            draggable_table=not args.no_draggable,
-        )
-        file_name = args.out_dir / f"simulation_results_b{bond_penalty}.html"
-        file_name.write_text(table.data, encoding="utf-8")
-        print(f"HTML saved to {file_name}")
+    # One telemetry run for the whole invocation: every structured
+    # record emitted below carries this run_id, and the per-beta suite
+    # builds become spans under it (yuma_simulation_tpu.telemetry).
+    with RunContext(), profile_trace(args.profile_dir):
+        for bond_penalty in args.bond_penalty:
+            hp = SimulationHyperparameters(bond_penalty=float(bond_penalty))
+            table = generate_chart_table(
+                cases,
+                canonical_versions(),
+                hp,
+                draggable_table=not args.no_draggable,
+            )
+            file_name = (
+                args.out_dir / f"simulation_results_b{bond_penalty}.html"
+            )
+            file_name.write_text(table.data, encoding="utf-8")
+            print(f"HTML saved to {file_name}")
 
 
 if __name__ == "__main__":

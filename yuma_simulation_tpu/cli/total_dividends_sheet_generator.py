@@ -16,6 +16,8 @@ from yuma_simulation_tpu.models.config import SimulationHyperparameters
 from yuma_simulation_tpu.models.variants import canonical_versions
 from yuma_simulation_tpu.reporting.tables import generate_total_dividends_table
 from yuma_simulation_tpu.scenarios import get_cases
+from yuma_simulation_tpu.telemetry import RunContext, span
+from yuma_simulation_tpu.utils import profile_trace, setup_logging
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -30,19 +32,37 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument(
         "--out-dir", type=pathlib.Path, default=pathlib.Path(".")
     )
+    parser.add_argument(
+        "--profile-dir",
+        default=None,
+        help="write a jax.profiler trace (Perfetto/XPlane) of the whole "
+        "build under this directory (default: no profiling)",
+    )
     args = parser.parse_args(argv)
+
+    # Operator-facing stream (structured event= records included) — the
+    # logging setup was previously never wired into any entry point.
+    setup_logging()
 
     cases = get_cases()
     args.out_dir.mkdir(parents=True, exist_ok=True)
-    for bond_penalty in args.bond_penalty:
-        print(f"Generating total dividends sheet for bond_penalty={bond_penalty}")
-        hp = SimulationHyperparameters(bond_penalty=float(bond_penalty))
-        df = generate_total_dividends_table(cases, canonical_versions(), hp)
-        if df.isnull().values.any():
-            print("Warning: NaN values detected in the dividends table.")
-        file_name = args.out_dir / f"total_dividends_b{bond_penalty}.csv"
-        df.to_csv(file_name, index=False, float_format="%.6f")
-        print(f"CSV saved to {file_name}")
+    # One telemetry run for the invocation, one span per beta sheet.
+    with RunContext(), profile_trace(args.profile_dir):
+        for bond_penalty in args.bond_penalty:
+            print(
+                f"Generating total dividends sheet for "
+                f"bond_penalty={bond_penalty}"
+            )
+            hp = SimulationHyperparameters(bond_penalty=float(bond_penalty))
+            with span(f"sheet:b{bond_penalty}"):
+                df = generate_total_dividends_table(
+                    cases, canonical_versions(), hp
+                )
+            if df.isnull().values.any():
+                print("Warning: NaN values detected in the dividends table.")
+            file_name = args.out_dir / f"total_dividends_b{bond_penalty}.csv"
+            df.to_csv(file_name, index=False, float_format="%.6f")
+            print(f"CSV saved to {file_name}")
 
 
 if __name__ == "__main__":

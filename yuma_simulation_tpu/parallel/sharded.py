@@ -43,6 +43,11 @@ from yuma_simulation_tpu.parallel.mesh import (
 from yuma_simulation_tpu.scenarios.base import Scenario
 from yuma_simulation_tpu.simulation.engine import simulate_constant
 from yuma_simulation_tpu.simulation.sweep import simulate_batch, stack_scenarios
+from yuma_simulation_tpu.telemetry.metrics import get_registry
+from yuma_simulation_tpu.telemetry.runctx import (
+    dispatch_annotation,
+    span as telemetry_span,
+)
 from yuma_simulation_tpu.utils.logging import log_event
 
 logger = logging.getLogger(__name__)
@@ -193,12 +198,13 @@ def simulate_batch_sharded(
         ri = jax.device_put(ri, sharding)
         re = jax.device_put(re, sharding)
 
-        return jax.block_until_ready(
-            _sharded_batch_scan(
-                W, S, ri, re, config, spec, mesh_now,
-                save_bonds=save_bonds, quarantine=quarantine,
+        with dispatch_annotation(f"sharded_batch:{shards}dev"):
+            return jax.block_until_ready(
+                _sharded_batch_scan(
+                    W, S, ri, re, config, spec, mesh_now,
+                    save_bonds=save_bonds, quarantine=quarantine,
+                )
             )
-        )
 
     def dispatch_single_device(device) -> dict:
         W, S, ri, re = stack_scenarios(list(scenarios), dtype)
@@ -211,7 +217,7 @@ def simulate_batch_sharded(
             if device is not None
             else contextlib.nullcontext()
         )
-        with ctx:
+        with ctx, dispatch_annotation("sharded_batch:single_device"):
             return jax.block_until_ready(
                 simulate_batch(
                     W, S, ri, re, config, spec,
@@ -232,27 +238,31 @@ def simulate_batch_sharded(
         # shrink count, so post-shrink recompiles get the retry grace.
         try:
             if mesh_now is None:
-                if fallback_device is not None:
-                    faults.maybe_lose_device([fallback_device])
-                ys = run_with_deadline(
-                    lambda: dispatch_single_device(fallback_device),
-                    deadline,
-                    label="sharded_batch:single_device",
-                    attempt=len(degradations),
-                )
+                with telemetry_span("mesh:single_device"):
+                    if fallback_device is not None:
+                        faults.maybe_lose_device([fallback_device])
+                    ys = run_with_deadline(
+                        lambda: dispatch_single_device(fallback_device),
+                        deadline,
+                        label="sharded_batch:single_device",
+                        attempt=len(degradations),
+                    )
             else:
-                # Test-only device-loss drill (inert in production):
-                # fires while the armed plan's lost device is still part
-                # of this mesh, host-level, before any trace.
-                faults.maybe_lose_device(list(mesh_now.devices.flat))
-                # Bind by value: an abandoned (stalled) worker must not
-                # read a mesh the caller has since replaced.
-                ys = run_with_deadline(
-                    lambda m=mesh_now: dispatch_on(m),
-                    deadline,
-                    label="sharded_batch",
-                    attempt=len(degradations),
-                )
+                with telemetry_span(
+                    f"mesh:{int(mesh_now.devices.size)}dev"
+                ):
+                    # Test-only device-loss drill (inert in production):
+                    # fires while the armed plan's lost device is still
+                    # part of this mesh, host-level, before any trace.
+                    faults.maybe_lose_device(list(mesh_now.devices.flat))
+                    # Bind by value: an abandoned (stalled) worker must
+                    # not read a mesh the caller has since replaced.
+                    ys = run_with_deadline(
+                        lambda m=mesh_now: dispatch_on(m),
+                        deadline,
+                        label="sharded_batch",
+                        attempt=len(degradations),
+                    )
             break
         except BaseException as exc:  # noqa: BLE001 — classified below
             typed = classify_failure(exc)
@@ -288,6 +298,9 @@ def simulate_batch_sharded(
                 reason=type(typed).__name__,
             )
             degradations.append(record)
+            get_registry().counter(
+                "mesh_shrinks", help="elastic mesh degradations"
+            ).inc()
             log_event(
                 logger,
                 "mesh_degraded",

@@ -34,6 +34,8 @@ from yuma_simulation_tpu.resilience.errors import (
     EngineLadderExhausted,
     classify_failure,
 )
+from yuma_simulation_tpu.telemetry.metrics import get_registry
+from yuma_simulation_tpu.telemetry.runctx import span as telemetry_span
 from yuma_simulation_tpu.utils.logging import log_event
 
 logger = logging.getLogger(__name__)
@@ -145,22 +147,28 @@ def run_ladder(
         last_failure = None
         for attempt in range(policy.max_attempts_per_rung):
             try:
-                if deadline is None:
-                    return dispatch(rung), rung, demotions
-                from yuma_simulation_tpu.resilience.watchdog import (
-                    run_with_deadline,
-                )
+                # One telemetry span per rung attempt — the innermost
+                # level of the supervisor's sweep -> unit -> attempt ->
+                # engine-rung chain (no-op without an active RunContext).
+                with telemetry_span(
+                    f"engine:{rung}", attempt=attempt + 1
+                ):
+                    if deadline is None:
+                        return dispatch(rung), rung, demotions
+                    from yuma_simulation_tpu.resilience.watchdog import (
+                        run_with_deadline,
+                    )
 
-                result = run_with_deadline(
-                    # Bind by value: an abandoned (stalled) worker that
-                    # wakes later must not dispatch whatever rung the
-                    # ladder has since advanced to.
-                    lambda r=rung: dispatch(r),
-                    deadline,
-                    label=f"{label}:{rung}" if label else rung,
-                    attempt=attempt,
-                )
-                return result, rung, demotions
+                    result = run_with_deadline(
+                        # Bind by value: an abandoned (stalled) worker
+                        # that wakes later must not dispatch whatever
+                        # rung the ladder has since advanced to.
+                        lambda r=rung: dispatch(r),
+                        deadline,
+                        label=f"{label}:{rung}" if label else rung,
+                        attempt=attempt,
+                    )
+                    return result, rung, demotions
             except BaseException as exc:  # noqa: BLE001 — classified below
                 typed = classify_failure(exc)
                 if typed is None:
@@ -170,6 +178,9 @@ def run_ladder(
                 last_failure = typed
                 retries_left = policy.max_attempts_per_rung - attempt - 1
                 if retries_left:
+                    get_registry().counter(
+                        "engine_retries", help="same-rung ladder retries"
+                    ).inc()
                     delay = policy.backoff_seconds(attempt, rng)
                     log_event(
                         logger,
@@ -192,6 +203,9 @@ def run_ladder(
                 message=str(last_failure),
             )
             demotions.append(record)
+            get_registry().counter(
+                "engine_demotions", help="engine-ladder demotions"
+            ).inc()
             log_event(
                 logger,
                 "engine_demoted",

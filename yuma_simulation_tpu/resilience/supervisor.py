@@ -46,6 +46,7 @@ import dataclasses
 import json
 import logging
 import pathlib
+import time
 from typing import Callable, Optional, Sequence
 
 import jax.numpy as jnp
@@ -126,8 +127,23 @@ class FailureLedger:
 
     def append(self, event: str, **fields) -> dict:
         """Append one outcome record and (if durable) publish the
-        updated ledger atomically. Returns the record."""
+        updated ledger atomically. Returns the record.
+
+        Records are stamped with a wall-clock ``t`` and — when a
+        telemetry :class:`..telemetry.runctx.RunContext` is active —
+        ``run_id``/``span_id``/``parent_id``, the join key against the
+        flight recorder's span tree and the `event=` log stream.
+        Purely ADDITIVE keys: pre-telemetry ledger readers still parse
+        every record (caller-passed fields of the same name win)."""
         record = {"event": event, **fields}
+        record.setdefault("t", round(time.time(), 6))
+        try:
+            from yuma_simulation_tpu.telemetry.runctx import current_fields
+
+            for key, value in current_fields().items():
+                record.setdefault(key, value)
+        except Exception:
+            pass
         self._entries.append(record)
         if self.path is not None:
             payload = "".join(
@@ -468,6 +484,15 @@ class SweepSupervisor:
         tag: str,
         config_fingerprint: dict,
     ) -> dict:
+        from yuma_simulation_tpu.telemetry import (
+            FlightRecorder,
+            ensure_run,
+            get_registry,
+            record_device_telemetry,
+            record_epoch_rate,
+            span,
+        )
+
         directory = (
             pathlib.Path(self.directory) if self.directory is not None else None
         )
@@ -485,101 +510,170 @@ class SweepSupervisor:
 
         def unit_fn(idx: int) -> dict:
             lo, hi = units[idx]
-            executions[idx] = executions.get(idx, 0) + 1
-            if executions[idx] > 1:
-                # Re-entry within one run = the checkpoint layer
-                # requeued this unit (torn/corrupt chunk detected).
+            with span(f"unit{idx}", lanes=[lo, hi]):
+                executions[idx] = executions.get(idx, 0) + 1
+                if executions[idx] > 1:
+                    # Re-entry within one run = the checkpoint layer
+                    # requeued this unit (torn/corrupt chunk detected).
+                    ledger.append(
+                        "unit_requeued", unit=idx, executions=executions[idx]
+                    )
+                outcome = _UnitOutcome(idx, ledger)
+                outcomes.setdefault(idx, []).append(outcome)
+                last = None
+                for attempt in range(self.retry_policy.max_attempts_per_rung):
+                    outcome.attempts = attempt + 1
+                    try:
+                        with span(f"attempt{attempt + 1}"):
+                            ys = dispatch_unit(idx, lo, hi, attempt, outcome)
+                            return self._accept_unit(
+                                idx, lo, hi, ys, outcome, ledger
+                            )
+                    except BaseException as exc:  # noqa: BLE001 — classified
+                        typed = classify_failure(exc)
+                        if typed is None:
+                            ledger.append(
+                                "unit_failed",
+                                unit=idx,
+                                error=type(exc).__name__,
+                                message=str(exc)[:500],
+                            )
+                            raise
+                        last = typed
+                        if isinstance(typed, EngineStall):
+                            outcome.record_stall(
+                                attempt=attempt + 1,
+                                budget_s=typed.budget_seconds,
+                            )
+                        else:
+                            ledger.append(
+                                "unit_retry",
+                                unit=idx,
+                                attempt=attempt + 1,
+                                error=type(typed).__name__,
+                            )
                 ledger.append(
-                    "unit_requeued", unit=idx, executions=executions[idx]
+                    "unit_failed",
+                    unit=idx,
+                    error=type(last).__name__,
+                    message=str(last)[:500],
                 )
-            outcome = _UnitOutcome(idx, ledger)
-            outcomes.setdefault(idx, []).append(outcome)
-            last = None
-            for attempt in range(self.retry_policy.max_attempts_per_rung):
-                outcome.attempts = attempt + 1
-                try:
-                    ys = dispatch_unit(idx, lo, hi, attempt, outcome)
-                    return self._accept_unit(idx, lo, hi, ys, outcome, ledger)
-                except BaseException as exc:  # noqa: BLE001 — classified
-                    typed = classify_failure(exc)
-                    if typed is None:
-                        ledger.append(
-                            "unit_failed",
-                            unit=idx,
-                            error=type(exc).__name__,
-                            message=str(exc)[:500],
+                assert last is not None
+                raise last
+
+        registry = get_registry()
+        with ensure_run() as run:
+            report = None
+            t0 = time.perf_counter()
+            try:
+                # The span chain under one run: sweep -> unit -> attempt
+                # -> engine rung (the rung span lives in run_ladder).
+                # Every ledger append above happens under one of these,
+                # so obsreport resolves each record to a span.
+                with span(f"sweep:{tag}", units=len(units), lanes=num_lanes):
+                    if directory is not None:
+                        from yuma_simulation_tpu.utils.checkpoint import (
+                            CheckpointedSweep,
                         )
-                        raise
-                    last = typed
-                    if isinstance(typed, EngineStall):
-                        outcome.record_stall(
-                            attempt=attempt + 1,
-                            budget_s=typed.budget_seconds,
+
+                        sweep = CheckpointedSweep(
+                            directory,
+                            num_chunks=len(units),
+                            tag=tag,
+                            config=config_fingerprint,
+                        )
+                        dividends = sweep.run(
+                            lambda i: unit_fn(i)["dividends"]
                         )
                     else:
-                        ledger.append(
-                            "unit_retry",
-                            unit=idx,
-                            attempt=attempt + 1,
-                            error=type(typed).__name__,
+                        dividends = np.concatenate(
+                            [
+                                unit_fn(i)["dividends"]
+                                for i in range(len(units))
+                            ],
+                            axis=0,
                         )
-            ledger.append(
-                "unit_failed",
-                unit=idx,
-                error=type(last).__name__,
-                message=str(last)[:500],
-            )
-            assert last is not None
-            raise last
+                    resumed = sum(
+                        1 for i in range(len(units)) if i not in executions
+                    )
 
-        if directory is not None:
-            from yuma_simulation_tpu.utils.checkpoint import CheckpointedSweep
-
-            sweep = CheckpointedSweep(
-                directory,
-                num_chunks=len(units),
-                tag=tag,
-                config=config_fingerprint,
-            )
-            dividends = sweep.run(lambda i: unit_fn(i)["dividends"])
-        else:
-            dividends = np.concatenate(
-                [unit_fn(i)["dividends"] for i in range(len(units))], axis=0
-            )
-        resumed = sum(1 for i in range(len(units)) if i not in executions)
-
-        # Quarantine provenance comes from each unit's LAST execution —
-        # the one whose result stands in the output. Units satisfied
-        # from a prior run's chunks did not execute here, but their
-        # chunks still carry any zero-masked lanes: recover their
-        # provenance from the ledger's unit_ok records, or the caller
-        # would treat masked zeros as genuine dividends.
-        entries: list = []
-        for idx in range(len(units)):
-            if idx in outcomes:
-                entries.extend(outcomes[idx][-1].quarantine_entries)
-            else:
-                entries.extend(_ledger_quarantine_entries(ledger, idx))
-        quarantine = QuarantineReport(
-            entries=tuple(entries), num_cases=num_lanes
-        )
-        report = self._build_report(
-            units, outcomes, executions, resumed, len(entries), directory
-        )
-        log_event(
-            logger,
-            "sweep_supervised",
-            level=logging.INFO,
-            tag=tag,
-            units=report.units_total,
-            resumed=report.units_resumed,
-            retried=report.units_retried,
-            requeued=report.units_requeued,
-            stalls=report.stalls_killed,
-            demotions=report.engine_demotions,
-            mesh_shrinks=report.mesh_shrinks,
-            quarantined=report.lanes_quarantined,
-        )
+                    # Quarantine provenance comes from each unit's LAST
+                    # execution — the one whose result stands in the
+                    # output. Units satisfied from a prior run's chunks
+                    # did not execute here, but their chunks still carry
+                    # any zero-masked lanes: recover their provenance
+                    # from the ledger's unit_ok records, or the caller
+                    # would treat masked zeros as genuine dividends.
+                    entries: list = []
+                    for idx in range(len(units)):
+                        if idx in outcomes:
+                            entries.extend(
+                                outcomes[idx][-1].quarantine_entries
+                            )
+                        else:
+                            entries.extend(
+                                _ledger_quarantine_entries(ledger, idx)
+                            )
+                    quarantine = QuarantineReport(
+                        entries=tuple(entries), num_cases=num_lanes
+                    )
+                    report = self._build_report(
+                        units, outcomes, executions, resumed, len(entries),
+                        directory,
+                    )
+                    # Metrics the supervisor owns (the per-action
+                    # counters — stalls, demotions, shrinks, retries —
+                    # are incremented at their sources in the watchdog/
+                    # ladder/elastic layers, exactly once each).
+                    if entries:
+                        registry.counter(
+                            "quarantined_lanes",
+                            help="non-finite lanes masked by the guard",
+                        ).inc(len(entries))
+                    shape = np.shape(dividends)
+                    epochs = (
+                        int(shape[0] * shape[1]) if len(shape) >= 2 else None
+                    )
+                    record_epoch_rate(
+                        tag,
+                        epochs=epochs,
+                        seconds=time.perf_counter() - t0,
+                        registry=registry,
+                        logger_=logger,
+                    )
+                    # Device/compile sample at the sweep boundary —
+                    # host-level, after every dispatch completed.
+                    record_device_telemetry(registry)
+                    log_event(
+                        logger,
+                        "sweep_supervised",
+                        level=logging.INFO,
+                        tag=tag,
+                        units=report.units_total,
+                        resumed=report.units_resumed,
+                        retried=report.units_retried,
+                        requeued=report.units_requeued,
+                        stalls=report.stalls_killed,
+                        demotions=report.engine_demotions,
+                        mesh_shrinks=report.mesh_shrinks,
+                        quarantined=report.lanes_quarantined,
+                    )
+            finally:
+                # The flight bundle publishes on failure too: a crashed
+                # sweep's spans are exactly the ones worth keeping, and
+                # every ledger record written so far must stay
+                # resolvable for obsreport --check.
+                if directory is not None:
+                    try:
+                        FlightRecorder(directory).record(
+                            run, registry=registry, report=report
+                        )
+                    except Exception:
+                        logger.warning(
+                            "flight-recorder bundle publish failed for %s",
+                            directory,
+                            exc_info=True,
+                        )
         return {
             "dividends": dividends,
             "quarantine": quarantine,
@@ -698,12 +792,15 @@ def _batch_on_rung(W, S, ri, re, config, spec, rung, quarantine) -> dict:
     import jax
 
     from yuma_simulation_tpu.simulation.sweep import simulate_batch
+    from yuma_simulation_tpu.telemetry.runctx import dispatch_annotation
 
-    return jax.block_until_ready(
-        simulate_batch(
-            W, S, ri, re, config, spec, epoch_impl=rung, quarantine=quarantine
+    with dispatch_annotation(f"supervised_batch:{rung}"):
+        return jax.block_until_ready(
+            simulate_batch(
+                W, S, ri, re, config, spec, epoch_impl=rung,
+                quarantine=quarantine,
+            )
         )
-    )
 
 
 def _grid_on_xla(scenario, yuma_version, configs, quarantine) -> dict:
@@ -712,7 +809,11 @@ def _grid_on_xla(scenario, yuma_version, configs, quarantine) -> dict:
     import jax
 
     from yuma_simulation_tpu.simulation.sweep import sweep_hyperparams
+    from yuma_simulation_tpu.telemetry.runctx import dispatch_annotation
 
-    return jax.block_until_ready(
-        sweep_hyperparams(scenario, yuma_version, configs, quarantine=quarantine)
-    )
+    with dispatch_annotation("supervised_grid:xla"):
+        return jax.block_until_ready(
+            sweep_hyperparams(
+                scenario, yuma_version, configs, quarantine=quarantine
+            )
+        )

@@ -37,6 +37,7 @@ budget).
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import logging
 import threading
@@ -144,8 +145,12 @@ def run_with_deadline(
             # result was already complete — a burned retry.
             state.done.set()
 
+    # Contextvars do NOT flow into a bare Thread: copy the caller's
+    # context so records emitted from the worker (fault hooks, engine
+    # log_events) carry the caller's telemetry run/span identity.
+    ctx = contextvars.copy_context()
     thread = threading.Thread(
-        target=worker,
+        target=lambda: ctx.run(worker),
         name=f"yuma-watchdog-{label or 'dispatch'}",
         daemon=True,
     )
@@ -155,6 +160,11 @@ def run_with_deadline(
             if not state.done.is_set():
                 state.expired = True
         if state.expired:
+            from yuma_simulation_tpu.telemetry.metrics import get_registry
+
+            get_registry().counter(
+                "stalls_killed", help="watchdog deadline kills"
+            ).inc()
             log_event(
                 logger,
                 "engine_stalled",

@@ -722,6 +722,16 @@ def simulate(
     )
 
     def _dispatch(rung: str):
+        # Host-side profiler step annotation: each engine dispatch gets
+        # a process-monotonic step number recorded on the open telemetry
+        # span, so a Perfetto trace's step lanes join against the
+        # ledger/span tree (inert when no profiler/trace is active).
+        from yuma_simulation_tpu.telemetry.runctx import dispatch_annotation
+
+        with dispatch_annotation(f"simulate:{rung}"):
+            return _dispatch_engine(rung)
+
+    def _dispatch_engine(rung: str):
         if rung in ("fused_scan", "fused_scan_mxu"):
             faults.maybe_fail_fused_dispatch()
             out = _simulate_case_fused(
@@ -778,24 +788,33 @@ def simulate(
             out = jax.block_until_ready(out)
         return out
 
+    from yuma_simulation_tpu.utils.profiling import timed
+
     demotions = None
-    if retry_policy is None and deadline is None:
-        ys = _dispatch(epoch_impl)
-    elif retry_policy is None:
-        from yuma_simulation_tpu.resilience.watchdog import run_with_deadline
+    # The one epoch-rate record per run (satellite of the telemetry
+    # tentpole): dispatch + host fetch timed together, routed through
+    # the metrics registry (`epochs_total`/`epochs_per_sec`) and emitted
+    # as one `event=epoch_rate` line by `timed` on clean exit.
+    with timed(f"simulate:{yuma_version}", epochs=E_):
+        if retry_policy is None and deadline is None:
+            ys = _dispatch(epoch_impl)
+        elif retry_policy is None:
+            from yuma_simulation_tpu.resilience.watchdog import (
+                run_with_deadline,
+            )
 
-        ys = run_with_deadline(
-            lambda: _dispatch(epoch_impl), deadline, label=yuma_version
-        )
-    else:
-        from yuma_simulation_tpu.resilience.retry import run_ladder
+            ys = run_with_deadline(
+                lambda: _dispatch(epoch_impl), deadline, label=yuma_version
+            )
+        else:
+            from yuma_simulation_tpu.resilience.retry import run_ladder
 
-        ys, _, records = run_ladder(
-            _dispatch, epoch_impl, retry_policy, label=yuma_version,
-            deadline=deadline,
-        )
-        demotions = tuple(records) or None
-    ys = jax.device_get(ys)
+            ys, _, records = run_ladder(
+                _dispatch, epoch_impl, retry_policy, label=yuma_version,
+                deadline=deadline,
+            )
+            demotions = tuple(records) or None
+        ys = jax.device_get(ys)
     return SimulationResult(
         dividends=ys["dividends"],
         bonds=ys.get("bonds"),

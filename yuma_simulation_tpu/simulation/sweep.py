@@ -269,6 +269,16 @@ def simulate_batch(
         )
 
     def _dispatch(rung: str):
+        # Profiler step annotation for Perfetto<->ledger alignment.
+        # Self-guarded against trace time: the sharded shard_map body
+        # re-enters this wrapper while being traced, where annotating
+        # would be noise (see telemetry.runctx.dispatch_annotation).
+        from yuma_simulation_tpu.telemetry.runctx import dispatch_annotation
+
+        with dispatch_annotation(f"simulate_batch:{rung}"):
+            return _dispatch_engine(rung)
+
+    def _dispatch_engine(rung: str):
         if rung in ("fused_scan", "fused_scan_mxu"):
             faults.maybe_fail_fused_dispatch()
             from yuma_simulation_tpu.simulation.engine import (

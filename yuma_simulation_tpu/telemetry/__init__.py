@@ -1,0 +1,56 @@
+"""Telemetry layer: run-scoped tracing, metrics, device/compile
+telemetry, and the sweep flight recorder.
+
+The observability substrate under the resilience tier (SURVEY.md §5:
+the reference has bare prints; PRs 1-3 added recovery but no identity
+or rates). Four modules:
+
+- :mod:`.runctx` — `RunContext` + nested `span` timers; every
+  `log_event` record and `FailureLedger` line is stamped with
+  ``run_id``/``span_id``, and `dispatch_annotation` lines Perfetto
+  traces up with the span tree;
+- :mod:`.metrics` — the process-local counters/gauges/histograms
+  registry with JSONL snapshot and Prometheus text sinks;
+- :mod:`.device` — HBM/live-buffer/jit-cache sampling at span
+  boundaries (graceful None on CPU);
+- :mod:`.flight` — the per-run on-disk bundle (ledger + spans +
+  metrics + report) and its loader/consistency checks, rendered by
+  ``python -m tools.obsreport``.
+
+Everything is host-side: the layer adds zero compiles (the warm-repeat
+budgets of tests/unit/test_recompilation.py stay at 0) and no reads
+from inside traced code.
+"""
+
+from yuma_simulation_tpu.telemetry.device import (  # noqa: F401
+    CompileTracker,
+    record_device_telemetry,
+    sample_device_telemetry,
+)
+from yuma_simulation_tpu.telemetry.flight import (  # noqa: F401
+    Bundle,
+    FlightRecorder,
+    build_timeline,
+    check_bundle,
+    ledger_counts,
+    load_bundle,
+)
+from yuma_simulation_tpu.telemetry.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    record_epoch_rate,
+)
+from yuma_simulation_tpu.telemetry.runctx import (  # noqa: F401
+    RunContext,
+    Span,
+    current_fields,
+    current_run,
+    current_span,
+    dispatch_annotation,
+    ensure_run,
+    new_run_id,
+    span,
+)

@@ -1,0 +1,138 @@
+"""Device/compile telemetry: HBM, live buffers, jit-cache deltas.
+
+A sweep's memory and compile story is invisible in the log stream: HBM
+peaks live in ``device.memory_stats()`` (TPU/GPU only — CPU returns
+None), buffer leaks in ``jax.live_arrays()``, and silent re-traces in
+the jit caches :class:`..utils.profiling.RecompilationSentinel` watches.
+This module samples all three AT SPAN BOUNDARIES — host-level, between
+dispatches, never inside traced code — so the numbers land in the
+metrics registry and the flight-recorder bundle without perturbing the
+zero-warm-repeat compile budgets.
+
+Everything degrades gracefully off-TPU: absent/None ``memory_stats``
+yields ``device_peak_bytes=None`` in the sample (and leaves the gauge
+untouched), a single-device CPU mesh is just `num_devices=1`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from yuma_simulation_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    get_registry,
+)
+
+
+def sample_device_telemetry() -> dict:
+    """One host-side snapshot of the backend's memory/buffer state.
+
+    Returns a flat dict: ``backend``, ``num_devices``,
+    ``device_peak_bytes`` (max over devices, None when no device
+    exposes memory stats — every CPU build), ``device_bytes_in_use``
+    (sum, same None contract) and ``live_buffers`` (live `jax.Array`
+    count, None when introspection is unavailable). Never raises: a
+    backend probe failure degrades to the all-None sample.
+    """
+    sample: dict = {
+        "backend": None,
+        "num_devices": 0,
+        "device_peak_bytes": None,
+        "device_bytes_in_use": None,
+        "live_buffers": None,
+    }
+    try:
+        import jax
+
+        devices = jax.devices()
+        sample["backend"] = jax.default_backend()
+        sample["num_devices"] = len(devices)
+    except Exception:
+        return sample
+    peaks: list[int] = []
+    in_use: list[int] = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue  # CPU devices report None — the graceful path
+        peaks.append(int(stats.get("peak_bytes_in_use", 0)))
+        in_use.append(int(stats.get("bytes_in_use", 0)))
+    if peaks:
+        sample["device_peak_bytes"] = max(peaks)
+        sample["device_bytes_in_use"] = sum(in_use)
+    try:
+        sample["live_buffers"] = len(jax.live_arrays())
+    except Exception:
+        pass
+    return sample
+
+
+def record_device_telemetry(
+    registry: Optional[MetricsRegistry] = None,
+) -> dict:
+    """Sample and fold into the registry: ``device_peak_bytes`` /
+    ``device_bytes_in_use`` / ``live_buffers`` gauges (None samples
+    leave the gauges untouched rather than zeroing a real prior
+    reading). Returns the raw sample."""
+    reg = registry if registry is not None else get_registry()
+    sample = sample_device_telemetry()
+    if sample["device_peak_bytes"] is not None:
+        reg.gauge(
+            "device_peak_bytes", help="max per-device peak_bytes_in_use"
+        ).set(sample["device_peak_bytes"])
+    if sample["device_bytes_in_use"] is not None:
+        reg.gauge(
+            "device_bytes_in_use", help="sum of per-device bytes_in_use"
+        ).set(sample["device_bytes_in_use"])
+    if sample["live_buffers"] is not None:
+        reg.gauge(
+            "live_buffers", help="live jax.Array count at last sample"
+        ).set(sample["live_buffers"])
+    return sample
+
+
+class CompileTracker:
+    """Incremental jit-cache growth observer — the observability sibling
+    of :class:`..utils.profiling.RecompilationSentinel` (which ENFORCES
+    a budget; this only counts). Track the jitted entry points of a hot
+    path, call :meth:`record` at span boundaries, and every new cache
+    entry since the previous call lands on the ``recompiles`` counter.
+
+    Per-function positive deltas only (an eviction elsewhere must not
+    hide a genuine re-trace), same as the sentinel.
+    """
+
+    def __init__(self, *functions, registry: Optional[MetricsRegistry] = None):
+        if not functions:
+            raise ValueError("CompileTracker needs at least one jitted fn")
+        for fn in functions:
+            if not hasattr(fn, "_cache_size"):
+                raise TypeError(
+                    f"{getattr(fn, '__name__', fn)!r} exposes no "
+                    "_cache_size(); pass the jax.jit-wrapped callable"
+                )
+        self._functions = functions
+        self._registry = registry
+        self._baseline = [fn._cache_size() for fn in functions]
+
+    def record(self) -> int:
+        """New cache entries since the last call (or construction);
+        increments the ``recompiles`` counter by that delta."""
+        current = [fn._cache_size() for fn in self._functions]
+        new = sum(
+            max(0, a - b) for a, b in zip(current, self._baseline)
+        )
+        self._baseline = current
+        if new:
+            reg = (
+                self._registry
+                if self._registry is not None
+                else get_registry()
+            )
+            reg.counter(
+                "recompiles", help="new jit-cache entries observed"
+            ).inc(new)
+        return new

@@ -1,0 +1,289 @@
+"""The sweep flight recorder: one on-disk bundle per supervised run.
+
+A supervised sweep already leaves a crash-safe ledger
+(:class:`..resilience.supervisor.FailureLedger`); this module adds the
+two sides the ledger cannot tell on its own — WHEN everything happened
+(the span tree) and HOW FAST/BIG it was (metrics snapshots) — and the
+loader/consistency half that `tools/obsreport.py` renders.
+
+Bundle layout (inside the supervisor's checkpoint `directory`):
+
+- ``ledger.jsonl``  — per-unit outcomes (the supervisor writes it live,
+  each record stamped with ``run_id``/``span_id``/``t``);
+- ``spans.jsonl``   — every closed span of every run, close order
+  (appended per run, atomic whole-file republish);
+- ``metrics.jsonl`` — one registry snapshot line per run;
+- ``report.json``   — the LAST run's :class:`SweepHealthReport` (plus
+  its ``run_id``), for the ledger<->report cross-check.
+
+All four accumulate across resumes — the bundle is the full history of
+the directory, grouped by ``run_id``. Every sink publishes atomically
+(temp + fsync + rename) and every loader tolerates torn/undecodable
+lines, matching the ledger's crash-safety contract; the formats are
+ADDITIVE over PR 3's (old readers still parse — new keys only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import pathlib
+from typing import Optional, Union
+
+from yuma_simulation_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    get_registry,
+)
+from yuma_simulation_tpu.telemetry.runctx import RunContext
+
+logger = logging.getLogger(__name__)
+
+LEDGER_NAME = "ledger.jsonl"
+SPANS_NAME = "spans.jsonl"
+METRICS_NAME = "metrics.jsonl"
+REPORT_NAME = "report.json"
+
+#: The SweepHealthReport action counts the ledger must reproduce exactly
+#: (report field -> derivation, see :func:`ledger_counts`).
+CROSS_CHECKED_COUNTS = (
+    "stalls_killed",
+    "units_requeued",
+    "engine_demotions",
+    "mesh_shrinks",
+    "lanes_quarantined",
+)
+
+
+def _read_jsonl(path: pathlib.Path) -> list[dict]:
+    """The shared tolerant JSONL reader (see
+    :func:`..utils.checkpoint.read_jsonl_tolerant`) — lazy import to
+    keep this module import-light."""
+    from yuma_simulation_tpu.utils.checkpoint import read_jsonl_tolerant
+
+    return read_jsonl_tolerant(path)
+
+
+class FlightRecorder:
+    """Writes the per-run bundle. One instance per directory; `record`
+    is called once per run by the supervisor (success AND failure paths
+    — a crashed sweep's spans are exactly the ones worth keeping)."""
+
+    def __init__(self, directory: Union[str, pathlib.Path]):
+        self.directory = pathlib.Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+
+    def record(
+        self,
+        run: RunContext,
+        *,
+        registry: Optional[MetricsRegistry] = None,
+        report=None,
+    ) -> None:
+        """Append `run`'s spans to ``spans.jsonl``, one registry
+        snapshot line to ``metrics.jsonl``, and (when given) publish the
+        run's health report to ``report.json``.
+
+        Spans are merged by ``(run_id, span_id)``, newest wins: a
+        mid-run publish records still-open ancestors as
+        ``status="open"``, and a later publish of the same run (a second
+        supervised sweep under one operator RunContext) replaces them
+        with their closed form instead of duplicating them."""
+        from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+
+        spans_path = self.directory / SPANS_NAME
+        merged: dict[tuple, dict] = {}
+        for rec in _read_jsonl(spans_path) + run.span_records():
+            merged[(rec.get("run_id"), rec.get("span_id"))] = rec
+        payload = "".join(
+            json.dumps(s, sort_keys=True) + "\n" for s in merged.values()
+        )
+        publish_atomic(spans_path, payload.encode())
+
+        reg = registry if registry is not None else get_registry()
+        reg.publish_snapshot(
+            self.directory / METRICS_NAME, run_id=run.run_id
+        )
+
+        if report is not None:
+            publish_atomic(
+                self.directory / REPORT_NAME,
+                json.dumps(
+                    {
+                        "run_id": run.run_id,
+                        "report": dataclasses.asdict(report),
+                    },
+                    sort_keys=True,
+                ).encode(),
+            )
+
+
+@dataclasses.dataclass
+class Bundle:
+    """A loaded flight-recorder bundle (see the module docstring)."""
+
+    directory: pathlib.Path
+    spans: list
+    metrics: list
+    ledger: list
+    report: Optional[dict] = None
+
+    def run_ids(self) -> list[str]:
+        """Distinct run ids, first-seen order (spans then ledger)."""
+        seen: dict[str, None] = {}
+        for rec in list(self.spans) + list(self.ledger):
+            rid = rec.get("run_id")
+            if rid:
+                seen.setdefault(rid, None)
+        return list(seen)
+
+    def latest_run_id(self) -> Optional[str]:
+        ids = self.run_ids()
+        return ids[-1] if ids else None
+
+
+def load_bundle(directory: Union[str, pathlib.Path]) -> Bundle:
+    directory = pathlib.Path(directory)
+    report = None
+    report_path = directory / REPORT_NAME
+    if report_path.exists():
+        try:
+            report = json.loads(report_path.read_text())
+        except json.JSONDecodeError:
+            logger.warning("undecodable %s in %s", REPORT_NAME, directory)
+    return Bundle(
+        directory=directory,
+        spans=_read_jsonl(directory / SPANS_NAME),
+        metrics=_read_jsonl(directory / METRICS_NAME),
+        ledger=_read_jsonl(directory / LEDGER_NAME),
+        report=report,
+    )
+
+
+def ledger_counts(ledger: list, run_id: str) -> dict:
+    """The ledger-derived twin of the :class:`SweepHealthReport` action
+    counts for one run. Quarantine provenance follows the supervisor's
+    resume rule: the RETURNED output carries each unit's LAST `unit_ok`
+    record across the whole ledger, resumed units included."""
+    this_run = [r for r in ledger if r.get("run_id") == run_id]
+    oks = [r for r in this_run if r.get("event") == "unit_ok"]
+    last_ok: dict = {}
+    for r in ledger:
+        if r.get("event") == "unit_ok" and "unit" in r:
+            last_ok[r["unit"]] = r
+    return {
+        "stalls_killed": sum(
+            1 for r in this_run if r.get("event") == "unit_stalled"
+        ),
+        # DISTINCT units, matching SweepHealthReport.units_requeued: a
+        # unit torn twice emits one unit_requeued record per re-entry
+        # but counts once in the report.
+        "units_requeued": len(
+            {
+                r.get("unit")
+                for r in this_run
+                if r.get("event") == "unit_requeued"
+            }
+        ),
+        "engine_demotions": sum(int(r.get("demotions", 0)) for r in oks),
+        "mesh_shrinks": sum(int(r.get("mesh_shrinks", 0)) for r in oks),
+        "lanes_quarantined": sum(
+            len(r.get("quarantined", ())) for r in last_ok.values()
+        ),
+    }
+
+
+def check_bundle(bundle: Bundle) -> list[str]:
+    """Consistency problems in a bundle (empty list = sound):
+
+    - every ledger record must carry ``run_id``/``span_id`` resolving to
+      a recorded span of that run (the obsreport ``--check`` gate);
+    - every span's ``parent_id`` must resolve within its run;
+    - when ``report.json`` is present, its action counts must match the
+      ledger-derived counts exactly (:data:`CROSS_CHECKED_COUNTS`).
+    """
+    problems: list[str] = []
+    spans_by_run: dict[str, set] = {}
+    for s in bundle.spans:
+        spans_by_run.setdefault(s.get("run_id", ""), set()).add(
+            s.get("span_id")
+        )
+    for s in bundle.spans:
+        parent = s.get("parent_id", "")
+        if parent and parent not in spans_by_run.get(s.get("run_id", ""), ()):
+            problems.append(
+                f"span {s.get('span_id')} (run {s.get('run_id')}) has "
+                f"unresolvable parent {parent!r}"
+            )
+    for i, rec in enumerate(bundle.ledger):
+        event = rec.get("event", "?")
+        rid, sid = rec.get("run_id"), rec.get("span_id")
+        if not rid or not sid:
+            problems.append(
+                f"ledger[{i}] event={event} lacks run/span identity "
+                f"(run_id={rid!r} span_id={sid!r})"
+            )
+            continue
+        if sid not in spans_by_run.get(rid, ()):
+            problems.append(
+                f"ledger[{i}] event={event} span {sid} does not resolve "
+                f"in run {rid}"
+            )
+    if bundle.report is not None:
+        rid = bundle.report.get("run_id")
+        fields = bundle.report.get("report", {})
+        if rid is None:
+            problems.append("report.json carries no run_id")
+        else:
+            derived = ledger_counts(bundle.ledger, rid)
+            for key in CROSS_CHECKED_COUNTS:
+                if key in fields and int(fields[key]) != int(derived[key]):
+                    problems.append(
+                        f"report.{key}={fields[key]} but the ledger "
+                        f"derives {derived[key]} for run {rid}"
+                    )
+    return problems
+
+
+def build_timeline(bundle: Bundle, run_id: str) -> dict:
+    """One run's recovery timeline: the span tree (children in start
+    order) with each span's ledger records attached.
+
+    Returns ``{"run_id", "spans": {span_id: span}, "roots": [span_id],
+    "children": {span_id: [span_id]}, "records": {span_id: [ledger
+    record]}}`` — everything obsreport needs to render, nothing
+    presentation-specific."""
+    spans = {
+        s["span_id"]: s
+        for s in bundle.spans
+        if s.get("run_id") == run_id and s.get("span_id")
+    }
+    children: dict[str, list] = {sid: [] for sid in spans}
+    roots: list[str] = []
+    for sid, s in spans.items():
+        parent = s.get("parent_id", "")
+        if parent and parent in spans:
+            children[parent].append(sid)
+        else:
+            roots.append(sid)
+
+    def start(sid: str) -> float:
+        return float(spans[sid].get("t_start") or 0.0)
+
+    for sid in children:
+        children[sid].sort(key=start)
+    roots.sort(key=start)
+    records: dict[str, list] = {}
+    for rec in bundle.ledger:
+        if rec.get("run_id") != run_id:
+            continue
+        records.setdefault(rec.get("span_id", ""), []).append(rec)
+    for recs in records.values():
+        recs.sort(key=lambda r: float(r.get("t") or 0.0))
+    return {
+        "run_id": run_id,
+        "spans": spans,
+        "roots": roots,
+        "children": children,
+        "records": records,
+    }

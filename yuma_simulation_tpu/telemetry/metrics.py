@@ -1,0 +1,333 @@
+"""Process-local metrics registry: counters, gauges, histograms.
+
+The BENCH trajectory and any serious perf work need machine-readable
+rate/compile/memory counters attached to every run — not log prose. This
+is the minimal, dependency-free substrate:
+
+- :class:`Counter` / :class:`Gauge` / :class:`Histogram` with
+  thread-safe mutation (the watchdog/supervisor callbacks increment from
+  worker threads);
+- :class:`MetricsRegistry` — get-or-create by name, a point-in-time
+  :meth:`~MetricsRegistry.snapshot`, a crash-safe JSONL snapshot sink
+  (:meth:`~MetricsRegistry.publish_snapshot`, atomic via
+  :func:`..utils.checkpoint.publish_atomic`), and Prometheus text
+  exposition (:meth:`~MetricsRegistry.prometheus_text`) for scraping;
+- the process singleton via :func:`get_registry` — what the resilience
+  tier feeds without any plumbing.
+
+Well-known series (incremented at their SOURCE, exactly once):
+
+======================  ====================================================
+``epochs_total``        simulated epochs (lanes x E), from the epoch-rate
+                        reporters (`utils.profiling.timed`, the supervisor)
+``epochs_per_sec``      gauge, last observed rate (`event=epoch_rate` twin)
+``engine_demotions``    ladder demotions (`resilience.retry.run_ladder`)
+``engine_retries``      same-rung retries (`resilience.retry.run_ladder`)
+``stalls_killed``       watchdog deadline kills (`resilience.watchdog`)
+``mesh_shrinks``        elastic degradations (`parallel.sharded`)
+``quarantined_lanes``   non-finite lanes masked (the supervisor)
+``recompiles``          new jit-cache entries observed by
+                        `utils.profiling.RecompilationSentinel` regions
+``checkpoint_bytes``    bytes of published checkpoint chunk snapshots
+``device_peak_bytes``   gauge, from `telemetry.device` (None-safe on CPU)
+``live_buffers``        gauge, live jax.Array count at last sample
+======================  ====================================================
+
+Host-side ONLY: nothing here may be called from inside traced code (the
+zero-warm-repeat compile budgets of tests/unit/test_recompilation.py and
+jaxlint's impurity rules stay authoritative) — every producer above sits
+on the host side of a dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import pathlib
+import re
+import threading
+import time
+from typing import Optional, Sequence, Union
+
+logger = logging.getLogger(__name__)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+#: Default histogram boundaries: wall-clock seconds from 1 ms to ~15 min,
+#: the span of a unit dispatch (compile included) on any supported
+#: backend.
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.025, 0.1, 0.5, 2.0, 10.0, 60.0, 300.0, 900.0,
+)
+
+
+def _check_name(name: str) -> str:
+    if not _NAME_RE.match(name):
+        raise ValueError(
+            f"metric name {name!r} is not Prometheus-compatible "
+            "([a-zA-Z_:][a-zA-Z0-9_:]*)"
+        )
+    return name
+
+
+class Counter:
+    """Monotonic counter. `inc` is thread-safe; negative increments are
+    rejected (a counter that can go down is a gauge)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = _check_name(name)
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: Union[int, float] = 1) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value; `set`/`inc` thread-safe."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = _check_name(name)
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: Union[int, float]) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: Union[int, float] = 1) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-boundary histogram with Prometheus cumulative-bucket
+    semantics (`le` upper bounds, implicit ``+Inf``)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        self.name = _check_name(name)
+        self.help = help
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.bounds = bounds
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(bounds) + 1)  # last = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: Union[int, float]) -> None:
+        v = float(value)
+        idx = len(self.bounds)
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        """`{"count", "sum", "buckets": {le_str: cumulative_count}}`."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cumulative: dict[str, int] = {}
+        acc = 0
+        for b, c in zip(self.bounds, counts):
+            acc += c
+            cumulative[repr(b)] = acc
+        cumulative["+Inf"] = total
+        return {"count": total, "sum": s, "buckets": cumulative}
+
+
+class MetricsRegistry:
+    """Name -> metric, get-or-create, with snapshot/exposition sinks.
+
+    Not a singleton by construction — tests build throwaway registries —
+    but production code shares the process registry via
+    :func:`get_registry`.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Union[Counter, Gauge, Histogram]] = {}
+
+    # -- get-or-create --------------------------------------------------
+
+    def _get_or_create(self, cls, name: str, **kwargs):
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = cls(name, **kwargs)
+                self._metrics[name] = metric
+                return metric
+        if not isinstance(metric, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {metric.kind}, "
+                f"not {cls.kind}"
+            )
+        return metric
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help=help)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(Histogram, name, help=help, buckets=buckets)
+
+    def reset(self) -> None:
+        """Drop every registered metric (tests; never production)."""
+        with self._lock:
+            self._metrics.clear()
+
+    # -- sinks -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Point-in-time `{"counters": {...}, "gauges": {...},
+        "histograms": {...}}` of every registered series."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in sorted(metrics.items()):
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.value
+            else:
+                out["histograms"][name] = m.snapshot()
+        return out
+
+    def publish_snapshot(
+        self, path: Union[str, pathlib.Path], **meta
+    ) -> dict:
+        """Append one snapshot line to the JSONL sink at `path` under the
+        crash-safety contract (whole-file atomic republish via
+        :func:`..utils.checkpoint.publish_atomic` — the ledger's
+        pattern): at every instant the sink is a complete parseable
+        prefix. Undecodable lines from a pre-atomic writer are dropped
+        with a warning (the shared
+        :func:`..utils.checkpoint.read_jsonl_tolerant` reader). `meta`
+        (e.g. ``run_id=...``) rides the line. Returns the appended
+        record."""
+        from yuma_simulation_tpu.utils.checkpoint import (
+            publish_atomic,
+            read_jsonl_tolerant,
+        )
+
+        path = pathlib.Path(path)
+        record = {"t": round(time.time(), 6), **meta, **self.snapshot()}
+        records = read_jsonl_tolerant(path)
+        records.append(record)
+        payload = "".join(
+            json.dumps(r, sort_keys=True) + "\n" for r in records
+        )
+        publish_atomic(path, payload.encode())
+        return record
+
+    def prometheus_text(self) -> str:
+        """The registry in Prometheus text exposition format (0.0.4) —
+        serve or dump this for scraping; no client library needed."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        out: list[str] = []
+        for name, m in sorted(metrics.items()):
+            if m.help:
+                out.append(f"# HELP {name} {m.help}")
+            out.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, (Counter, Gauge)):
+                out.append(f"{name} {_fmt_value(m.value)}")
+            else:
+                snap = m.snapshot()
+                for le, c in snap["buckets"].items():
+                    out.append(f'{name}_bucket{{le="{le}"}} {c}')
+                out.append(f"{name}_sum {_fmt_value(snap['sum'])}")
+                out.append(f"{name}_count {snap['count']}")
+        return "\n".join(out) + ("\n" if out else "")
+
+
+def _fmt_value(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(v)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-local registry every production producer feeds."""
+    return _REGISTRY
+
+
+def record_epoch_rate(
+    label: str,
+    *,
+    epochs: Optional[int] = None,
+    seconds: Optional[float] = None,
+    epochs_per_sec: Optional[float] = None,
+    registry: Optional[MetricsRegistry] = None,
+    logger_: Optional[logging.Logger] = None,
+) -> Optional[float]:
+    """The one epoch-rate reporting path (`simulate`, `bench.py`, the
+    supervisor): feeds ``epochs_total``/``epochs_per_sec`` in the
+    registry and emits exactly one ``event=epoch_rate`` record. Pass
+    either a precomputed `epochs_per_sec` or `epochs` + `seconds`.
+    Returns the rate (None when it cannot be derived)."""
+    from yuma_simulation_tpu.utils.logging import log_event
+
+    reg = registry if registry is not None else get_registry()
+    if epochs_per_sec is None and epochs is not None and seconds:
+        epochs_per_sec = epochs / seconds
+    if epochs:
+        reg.counter(
+            "epochs_total", help="simulated epochs (lanes x E)"
+        ).inc(epochs)
+    if epochs_per_sec is not None:
+        reg.gauge(
+            "epochs_per_sec", help="last observed simulated epochs/sec"
+        ).set(epochs_per_sec)
+    log_event(
+        logger_ if logger_ is not None else logger,
+        "epoch_rate",
+        level=logging.INFO,
+        label=label,
+        epochs="" if epochs is None else epochs,
+        seconds="" if seconds is None else f"{seconds:.3f}",
+        epochs_per_sec=(
+            "" if epochs_per_sec is None else f"{epochs_per_sec:.1f}"
+        ),
+    )
+    return epochs_per_sec

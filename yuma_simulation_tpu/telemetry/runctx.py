@@ -1,0 +1,273 @@
+"""Run-scoped tracing: one `RunContext` per sweep, nested `span` timers.
+
+The resilience tier (PRs 1-3) emits one structured record per recovery
+action, but the records carry no run identity: two concurrent sweeps —
+or a sweep and its later resume — interleave indistinguishably in the
+log stream and the :class:`..resilience.supervisor.FailureLedger`. This
+module provides the identity substrate:
+
+- :class:`RunContext` mints a process-unique ``run_id`` and collects the
+  run's closed spans; it is installed in a :mod:`contextvars` context
+  variable, so nested libraries need no plumbing to find it;
+- :func:`span` opens one named, timed span under the innermost open span
+  (sweep -> unit -> attempt -> engine rung is the supervisor's chain);
+  span records carry ``span_id`` / ``parent_id`` and land on the owning
+  run at close;
+- :func:`current_fields` returns the ``{run_id, span_id, parent_id}``
+  mapping that :func:`..utils.logging.log_event` and
+  ``FailureLedger.append`` stamp into every record they emit — the join
+  key between the log stream, the ledger, and the span tree;
+- :func:`dispatch_annotation` wraps a host-level engine dispatch in
+  ``jax.profiler.StepTraceAnnotation`` with a process-monotonic step
+  number (recorded on the open span), so a Perfetto trace's step lanes
+  line up with the ledger's span ids.
+
+Host-level ONLY, by construction: everything here is wall-clock + dict
+bookkeeping on the Python side of a dispatch. Nothing touches traced
+values, and :func:`dispatch_annotation` self-guards with the same
+is-tracing check as the fault hooks (a `shard_map` body re-enters
+`simulate_batch` at trace time; annotating a trace would be noise and
+the step counter an impurity baked into nothing useful). The telemetry
+layer therefore adds zero compiles — pinned by
+tests/unit/test_recompilation.py's existing zero budgets.
+
+Thread note: `contextvars` do NOT flow into a bare `threading.Thread`;
+the deadline watchdog (the one place this framework hops threads)
+copies the caller's context into its worker explicitly, so records
+emitted from a supervised dispatch carry the caller's run/span identity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+_CURRENT_RUN: contextvars.ContextVar[Optional["RunContext"]] = (
+    contextvars.ContextVar("yuma_telemetry_run", default=None)
+)
+_CURRENT_SPAN: contextvars.ContextVar[Optional["Span"]] = (
+    contextvars.ContextVar("yuma_telemetry_span", default=None)
+)
+
+#: Process-monotonic dispatch step counter for
+#: :func:`dispatch_annotation` (itertools.count is atomic in CPython).
+_DISPATCH_STEP = itertools.count()
+
+
+def new_run_id() -> str:
+    """A process-unique, human-greppable run identifier."""
+    return "run-" + uuid.uuid4().hex[:12]
+
+
+def _tracing_now() -> bool:
+    """Whether a jax trace is executing this host code (same fail-closed
+    probe as :mod:`..resilience.faults`)."""
+    try:
+        from jax import core
+
+        return not core.trace_state_clean()
+    except Exception:
+        return True
+
+
+@dataclass
+class Span:
+    """One closed-interval timer in a run's span tree. ``parent_id`` is
+    empty for a root span. Times are wall-clock (`time.time()`) so the
+    flight recorder's timeline is human-readable; durations at this
+    layer are unit/attempt scale (ms and up), not kernel scale."""
+
+    span_id: str
+    parent_id: str
+    name: str
+    t_start: float
+    t_end: Optional[float] = None
+    status: str = "ok"
+    #: host-side annotations (e.g. the profiler step numbers of the
+    #: dispatches issued under this span) — flat JSON-able values only.
+    attrs: dict = field(default_factory=dict)
+
+    def to_record(self, run_id: str) -> dict:
+        rec = {
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "run_id": run_id,
+            "t_start": round(self.t_start, 6),
+            "t_end": None if self.t_end is None else round(self.t_end, 6),
+            "status": self.status,
+        }
+        if self.attrs:
+            rec["attrs"] = dict(self.attrs)
+        return rec
+
+
+class RunContext:
+    """The identity scope for one run (a sweep, a CLI invocation, a
+    bench). Enter it as a context manager; everything executed inside —
+    any thread the watchdog copies the context into included — stamps
+    this ``run_id`` on its records.
+
+    Span ids are minted per run (`s0001`, `s0002`, ...) under a lock, so
+    a span tree is readable in ledger order and safe to grow from the
+    watchdog's worker threads.
+    """
+
+    def __init__(self, run_id: Optional[str] = None):
+        self.run_id = run_id if run_id else new_run_id()
+        self.t_start = time.time()
+        self._lock = threading.Lock()
+        self._next = itertools.count(1)
+        self._closed: list[Span] = []
+        self._open: dict[str, Span] = {}
+        self._token: Optional[contextvars.Token] = None
+
+    # -- context management --------------------------------------------
+
+    def __enter__(self) -> "RunContext":
+        if self._token is not None:
+            raise RuntimeError(f"RunContext {self.run_id} already entered")
+        self._token = _CURRENT_RUN.set(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        assert self._token is not None
+        _CURRENT_RUN.reset(self._token)
+        self._token = None
+
+    # -- span bookkeeping (called by :func:`span`) ---------------------
+
+    def _open_span(self, name: str, parent: Optional[Span]) -> Span:
+        s = Span(
+            span_id="",
+            parent_id=parent.span_id if parent is not None else "",
+            name=name,
+            t_start=time.time(),
+        )
+        with self._lock:
+            s.span_id = f"s{next(self._next):04d}"
+            self._open[s.span_id] = s
+        return s
+
+    def _close_span(self, s: Span) -> None:
+        s.t_end = time.time()
+        with self._lock:
+            self._open.pop(s.span_id, None)
+            self._closed.append(s)
+
+    def span_records(self) -> list[dict]:
+        """All spans of this run as flat dicts: closed spans in close
+        order, then any still-OPEN spans (serialized with
+        ``status="open"`` and no ``t_end``). Open ancestors must be
+        included because the flight recorder publishes mid-run — the
+        supervisor's ``finally`` fires while an operator-opened outer
+        span is still live, and a bundle whose sweep span references an
+        unrecorded parent would fail its own ``obsreport --check``
+        (:func:`..flight.FlightRecorder.record` replaces the open
+        record with the closed form on a later publish)."""
+        with self._lock:
+            records = [s.to_record(self.run_id) for s in self._closed]
+            open_spans = sorted(
+                self._open.values(), key=lambda s: s.t_start
+            )
+        for s in open_spans:
+            rec = s.to_record(self.run_id)
+            if rec["status"] == "ok":
+                rec["status"] = "open"
+            records.append(rec)
+        return records
+
+
+def current_run() -> Optional[RunContext]:
+    """The innermost active :class:`RunContext`, or None."""
+    return _CURRENT_RUN.get()
+
+
+def current_span() -> Optional[Span]:
+    """The innermost OPEN span, or None."""
+    return _CURRENT_SPAN.get()
+
+
+def current_fields() -> dict:
+    """The identity fields every telemetry-aware record carries:
+    ``{"run_id": ...}`` plus ``span_id``/``parent_id`` when a span is
+    open. Empty dict when no run is active — the zero-overhead
+    production-off state (one ContextVar read)."""
+    run = _CURRENT_RUN.get()
+    if run is None:
+        return {}
+    fields = {"run_id": run.run_id}
+    s = _CURRENT_SPAN.get()
+    if s is not None:
+        fields["span_id"] = s.span_id
+        if s.parent_id:
+            fields["parent_id"] = s.parent_id
+    return fields
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs) -> Iterator[Optional[Span]]:
+    """Open one named span under the innermost open span of the active
+    run. No active run -> a no-op yielding None (library code can span
+    unconditionally). An exception inside the span marks it
+    ``status="error"`` and propagates; the span always closes."""
+    run = _CURRENT_RUN.get()
+    if run is None:
+        yield None
+        return
+    s = run._open_span(name, _CURRENT_SPAN.get())
+    if attrs:
+        s.attrs.update(attrs)
+    token = _CURRENT_SPAN.set(s)
+    try:
+        yield s
+    except BaseException:
+        s.status = "error"
+        raise
+    finally:
+        _CURRENT_SPAN.reset(token)
+        run._close_span(s)
+
+
+@contextlib.contextmanager
+def ensure_run(run_id: Optional[str] = None) -> Iterator[RunContext]:
+    """The active run, or a fresh one entered for the duration of the
+    block — how the supervisor joins an operator-opened CLI run instead
+    of forking a second run_id for the same work."""
+    run = _CURRENT_RUN.get()
+    if run is not None:
+        yield run
+        return
+    with RunContext(run_id) as run:
+        yield run
+
+
+@contextlib.contextmanager
+def dispatch_annotation(name: str) -> Iterator[None]:
+    """Wrap one host-level engine dispatch in a
+    ``jax.profiler.StepTraceAnnotation`` with a process-monotonic step
+    number, so Perfetto step lanes join against the span tree (the step
+    number is appended to the open span's ``steps`` attr). Inert when a
+    trace is executing (the `shard_map` body calls `simulate_batch` at
+    trace time) and when the profiler is unavailable."""
+    if _tracing_now():
+        yield
+        return
+    step = next(_DISPATCH_STEP)
+    s = _CURRENT_SPAN.get()
+    if s is not None:
+        s.attrs.setdefault("steps", []).append(step)
+    try:
+        import jax.profiler
+
+        cm = jax.profiler.StepTraceAnnotation(name, step_num=step)
+    except Exception:
+        cm = contextlib.nullcontext()
+    with cm:
+        yield

@@ -78,6 +78,31 @@ def publish_atomic(path: pathlib.Path, data: bytes) -> None:
     _publish(tmp, path)
 
 
+def read_jsonl_tolerant(path: pathlib.Path) -> list[dict]:
+    """Decode a JSONL sink under the crash-safety contract's reader
+    half: torn/undecodable and non-dict lines are dropped with a
+    warning, never fatal — a sink written by a crashed or pre-atomic
+    writer must still load. The shared reader for every telemetry/
+    ledger-style sidecar (spans, metrics, flight bundles)."""
+    path = pathlib.Path(path)
+    if not path.exists():
+        return []
+    out: list[dict] = []
+    for lineno, line in enumerate(path.read_text().splitlines()):
+        if not line.strip():
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            logger.warning(
+                "dropping undecodable line %d in %s", lineno, path
+            )
+            continue
+        if isinstance(record, dict):
+            out.append(record)
+    return out
+
+
 def _file_sha256(path: pathlib.Path) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -263,8 +288,18 @@ class CheckpointedSweep:
         # published name always refers to durable bytes.
         _fsync_write(tmp, lambda f: np.savez(f, result=result))
         digest = _file_sha256(tmp)
+        published_bytes = tmp.stat().st_size
         _publish(tmp, self._chunk_path(i))
         self._record_checksum(i, digest)
+        try:
+            from yuma_simulation_tpu.telemetry.metrics import get_registry
+
+            get_registry().counter(
+                "checkpoint_bytes",
+                help="bytes of published checkpoint chunk snapshots",
+            ).inc(published_bytes)
+        except Exception:
+            pass
         # Test-only hook: deterministic post-publish corruption
         # (resilience fault injection) to exercise detect-and-requeue.
         from yuma_simulation_tpu.resilience import faults

@@ -24,7 +24,23 @@ def log_event(
     on one line, not multi-line prose. Values containing whitespace,
     `=` or quotes (free-text labels, error messages) are double-quoted
     with inner quotes escaped so a key=value tokenizer still parses the
-    record. Empty-string fields are dropped (optional labels)."""
+    record. Empty-string fields are dropped (optional labels).
+
+    When a telemetry :class:`..telemetry.runctx.RunContext` is active,
+    the record is additionally stamped with ``run_id`` (and
+    ``span_id``/``parent_id`` under an open span) — the join key between
+    the log stream, the FailureLedger and the flight-recorder span tree,
+    so concurrent or resumed sweeps no longer interleave
+    indistinguishably. Caller-passed fields of the same name win."""
+    try:
+        from yuma_simulation_tpu.telemetry.runctx import current_fields
+
+        for key, value in current_fields().items():
+            fields.setdefault(key, value)
+    except Exception:
+        # Telemetry must never break logging (import cycles during
+        # interpreter teardown, partial installs).
+        pass
 
     def fmt(v) -> str:
         s = str(v)

@@ -51,14 +51,21 @@ def profile_trace(log_dir: Optional[str]) -> Iterator[None]:
     """Wrap a region in a `jax.profiler` trace (Perfetto/XPlane dump).
 
     No-op when `log_dir` is None, so call sites can thread a CLI flag
-    straight through.
+    (both CLIs expose it as ``--profile-dir``) straight through.
+
+    The "trace written" pointer is logged in a ``finally``: the dump the
+    profiler flushed on the way out of a FAILING region is exactly the
+    one that explains the failure, and the exception must not eat the
+    only pointer to it.
     """
     if log_dir is None:
         yield
         return
-    with jax.profiler.trace(log_dir):
-        yield
-    logger.info("profiler trace written to %s", log_dir)
+    try:
+        with jax.profiler.trace(log_dir):
+            yield
+    finally:
+        logger.info("profiler trace written to %s", log_dir)
 
 
 class RecompilationBudgetExceeded(RuntimeError):
@@ -144,6 +151,19 @@ class RecompilationSentinel:
         self.new_entries = sum(
             max(0, a - b) for b, a in self.report.values()
         )
+        if self.new_entries:
+            # Observability side-channel: every new entry a sentinel
+            # region observes lands on the process `recompiles` counter
+            # (budget-busting ones included — the raise below must not
+            # hide them from the metrics snapshot).
+            try:
+                from yuma_simulation_tpu.telemetry.metrics import get_registry
+
+                get_registry().counter(
+                    "recompiles", help="new jit-cache entries observed"
+                ).inc(self.new_entries)
+            except Exception:
+                pass
         if self.new_entries > self.budget:
             detail = ", ".join(
                 f"{name}: {b}->{a}"
@@ -162,7 +182,13 @@ class RecompilationSentinel:
 
 @dataclass
 class timed:
-    """Context manager measuring a block; optionally derives epochs/sec.
+    """Context manager measuring a block; with `epochs` it IS the
+    epoch-rate reporting path: on clean exit the measurement routes
+    through the telemetry metrics registry
+    (:func:`..telemetry.metrics.record_epoch_rate` — `epochs_total`
+    counter + `epochs_per_sec` gauge) and emits exactly one
+    ``event=epoch_rate`` record, run/span-stamped like every other
+    structured record. Without `epochs` it is a plain labeled timer.
 
     >>> with timed("scan", epochs=10_000) as t:
     ...     run()
@@ -179,13 +205,19 @@ class timed:
 
     def __exit__(self, *exc) -> None:
         self.seconds = time.perf_counter() - self._t0
-        if exc[0] is None:
-            logger.info("%s: %.3fs%s", self.label, self.seconds, self._rate())
+        if exc[0] is not None:
+            return  # a failing block reports its failure, not a rate
+        if self.epochs is not None and self.seconds > 0:
+            from yuma_simulation_tpu.telemetry.metrics import record_epoch_rate
 
-    def _rate(self) -> str:
-        if self.epochs is None or self.seconds == 0:
-            return ""
-        return f" ({self.epochs / self.seconds:,.0f} epochs/s)"
+            record_epoch_rate(
+                self.label,
+                epochs=self.epochs,
+                seconds=self.seconds,
+                logger_=logger,
+            )
+        else:
+            logger.info("%s: %.3fs", self.label, self.seconds)
 
     @property
     def epochs_per_sec(self) -> Optional[float]:

@@ -146,34 +146,42 @@ def _simulate_suite(
         from yuma_simulation_tpu.resilience.supervisor import default_deadline
 
         deadline = default_deadline()
+    # Run-scoped telemetry: the whole suite build shares one run_id
+    # (joining an operator-opened CLI RunContext when present), and each
+    # version's batched dispatch is one span — every engine-demotion /
+    # stall record emitted below carries the run/span identity.
+    from yuma_simulation_tpu.telemetry import ensure_run, span
+
     out = {}
-    for yuma_version, yuma_params in yuma_versions:
-        config = YumaConfig(
-            simulation=yuma_hyperparameters, yuma_params=yuma_params
-        )
-        spec = _variant_for_version(yuma_version)
-        ys = _simulate_batch(
-            W, S, ri, re, config, spec,
-            save_bonds=True, save_incentives=True, miner_mask=mask,
-            retry_policy=default_retry_policy(), deadline=deadline,
-        )
-        div = np.asarray(ys["dividends"])  # [B, Ep, Vp]
-        bonds = np.asarray(ys["bonds"])  # [B, Ep, Vp, Mp]
-        inc = np.asarray(ys["incentives"])  # [B, Ep, Mp]
-        for i, case in enumerate(cases):
-            E, V, M = case.weights.shape
-            dividends = {
-                validator: [float(x) for x in div[i, :E, j]]
-                for j, validator in enumerate(case.validators)
-            }
-            out[(i, yuma_version)] = (
-                config,
-                (
-                    dividends,
-                    list(bonds[i, :E, :V, :M]),
-                    list(inc[i, :E, :M]),
-                ),
+    with ensure_run(), span("chart_suite", versions=len(yuma_versions)):
+        for yuma_version, yuma_params in yuma_versions:
+            config = YumaConfig(
+                simulation=yuma_hyperparameters, yuma_params=yuma_params
             )
+            spec = _variant_for_version(yuma_version)
+            with span(f"version:{yuma_version}"):
+                ys = _simulate_batch(
+                    W, S, ri, re, config, spec,
+                    save_bonds=True, save_incentives=True, miner_mask=mask,
+                    retry_policy=default_retry_policy(), deadline=deadline,
+                )
+            div = np.asarray(ys["dividends"])  # [B, Ep, Vp]
+            bonds = np.asarray(ys["bonds"])  # [B, Ep, Vp, Mp]
+            inc = np.asarray(ys["incentives"])  # [B, Ep, Mp]
+            for i, case in enumerate(cases):
+                E, V, M = case.weights.shape
+                dividends = {
+                    validator: [float(x) for x in div[i, :E, j]]
+                    for j, validator in enumerate(case.validators)
+                }
+                out[(i, yuma_version)] = (
+                    config,
+                    (
+                        dividends,
+                        list(bonds[i, :E, :V, :M]),
+                        list(inc[i, :E, :M]),
+                    ),
+                )
     return out
 
 
