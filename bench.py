@@ -35,9 +35,20 @@ Secondary metrics (same JSON line, `secondary` field):
   - constant_weights_scan / constant_weights_hoisted: continuity with r1
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "secondary"}.
+
+Every run ALSO appends one richer record to ``BENCH_HISTORY.jsonl``
+(``--history`` to relocate, ``--no-history`` to skip): the stdout fields
+plus per-metric timing dispersion (`cv`, from `utils.timing.time_best`),
+the AOT cost report for every engine rung (flops / bytes / peak memory /
+HLO fingerprint, nulls-with-reason on CPU — `telemetry.cost`) and the
+roofline verdicts. ``python -m tools.perfgate --check`` diffs the latest
+record against a noise-aware rolling baseline of that file; the CI perf
+lane runs it with ``--smoke`` (short timing windows) + ``--structural``.
 """
 
+import argparse
 import json
+import time
 from functools import partial
 
 import numpy as np
@@ -62,6 +73,7 @@ from yuma_simulation_tpu.simulation.engine import (
 )
 
 BASELINE_EPOCHS_PER_SEC = 0.54  # reference CPU, 256v x 4096m (BASELINE.md)
+BENCH_HISTORY = "BENCH_HISTORY.jsonl"  # beside the committed BENCH_r* lines
 V, M = 256, 4096
 EPOCHS = 4096
 MAX_EPOCHS = 65536
@@ -69,10 +81,26 @@ TRUE_E = 1024  # [TRUE_E, V, M] f32 = 4 GiB of genuinely per-epoch weights
 BATCH = 4  # largest scenario batch the VMEM-resident fused scan admits here
 
 
-def _time_best(run, n, max_n=MAX_EPOCHS, granularity=1):
+#: Per-metric timing dispersion of the current run, keyed by the
+#: secondary-metric name (+ "primary"): what perfgate reads to widen
+#: tolerance on noisy metrics instead of false-failing.
+_CVS: dict[str, float] = {}
+
+#: Timing-window overrides (set by --smoke): short windows measure
+#: dispatch more than throughput, so smoke records are flagged and
+#: perfgate never baselines a real capture against them.
+_WINDOW: dict = {}
+
+
+def _time_best(run, n, max_n=MAX_EPOCHS, granularity=1, label=None):
     """The shared timing discipline (see utils/timing.py): warm, grow the
-    epoch count until a timed run lasts >= 2 s, best-of-4."""
-    rate, _, _ = time_best(run, n, max_n=max_n, granularity=granularity)
+    epoch count until a timed run lasts >= 2 s, best-of-4. Stashes the
+    repeat dispersion under `label` for the history record."""
+    rate, _, _, cv = time_best(
+        run, n, max_n=max_n, granularity=granularity, **_WINDOW
+    )
+    if label is not None:
+        _CVS[label] = cv
     return rate
 
 
@@ -118,7 +146,43 @@ def _true_weights_reps(W_e, S_e, config, spec, reps, epoch_impl):
     return acc
 
 
-def main() -> None:
+#: Epoch count for the AOT cost capture. XLA's cost analysis amortizes
+#: scan bodies (counted once regardless of trip count — see the honesty
+#: note on `telemetry.cost.roofline`), so the choice mostly sizes the
+#: [E, V, M] argument bytes; it is FIXED so history records stay
+#: bitwise commit-to-commit comparable, which is what perfgate diffs.
+COST_EPOCHS = 512
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="short timing windows (0.25 s, best-of-2) for the CPU CI "
+        "perf lane; the history record is flagged smoke=true and "
+        "perfgate baselines smoke runs only against smoke runs",
+    )
+    parser.add_argument(
+        "--history",
+        default=BENCH_HISTORY,
+        help=f"JSONL perf-history sink (default {BENCH_HISTORY})",
+    )
+    parser.add_argument(
+        "--no-history",
+        action="store_true",
+        help="do not append to the history file",
+    )
+    parser.add_argument(
+        "--skip-costs",
+        action="store_true",
+        help="skip the AOT cost capture (it compiles each rung once); "
+        "note the perfgate structural gate fails a cost-less record by "
+        "design",
+    )
+    args = parser.parse_args(argv)
+    if args.smoke:
+        _WINDOW.update(target_seconds=0.25, reps=2)
     # Operator stream + run-scoped telemetry: the bench is a run like
     # any sweep — its epoch rate lands on the metrics registry
     # (`epochs_total`/`epochs_per_sec`) and is emitted as exactly one
@@ -126,10 +190,10 @@ def main() -> None:
     # line below stays byte-compatible).
     setup_logging()
     with RunContext():
-        _bench()
+        _bench(args)
 
 
-def _bench() -> None:
+def _bench(args) -> None:
     rng = np.random.default_rng(42)
     W = jnp.asarray(rng.random((V, M)), jnp.float32)
     S = jnp.asarray(rng.random((V,)) + 0.01, jnp.float32)
@@ -167,25 +231,40 @@ def _bench() -> None:
     # MXU support contraction (what epoch_impl="auto" selects on TPU —
     # bitwise the VPU scan; consensus bitwise across every engine).
     primary_impl = "fused_scan_mxu" if on_tpu else "xla"
-    primary = _time_best(varying(primary_impl), EPOCHS)
+    primary = _time_best(varying(primary_impl), EPOCHS, label="primary")
     # Off-TPU the primary already IS the XLA path; don't time it twice.
     xla_eps = (
-        _time_best(varying("xla"), EPOCHS) if primary_impl != "xla" else primary
+        _time_best(varying("xla"), EPOCHS, label="full_epoch_xla")
+        if primary_impl != "xla"
+        else primary
     )
+    if primary_impl == "xla":
+        _CVS["full_epoch_xla"] = _CVS["primary"]
     secondary = {
         "full_epoch_xla": round(xla_eps, 1),
-        "constant_weights_scan": round(_time_best(constant(False), EPOCHS), 1),
+        "constant_weights_scan": round(
+            _time_best(constant(False), EPOCHS, label="constant_weights_scan"),
+            1,
+        ),
         "constant_weights_hoisted": round(
-            _time_best(constant(True), 4 * EPOCHS), 1
+            _time_best(
+                constant(True), 4 * EPOCHS, label="constant_weights_hoisted"
+            ),
+            1,
         ),
     }
 
     if on_tpu:
         secondary["fused_scan_vpu"] = round(
-            _time_best(varying("fused_scan"), EPOCHS), 1
+            _time_best(varying("fused_scan"), EPOCHS, label="fused_scan_vpu"),
+            1,
         )
         secondary["liquid_fused_scan"] = round(
-            _time_best(varying("fused_scan_mxu", liquid_config), EPOCHS), 1
+            _time_best(
+                varying("fused_scan_mxu", liquid_config), EPOCHS,
+                label="liquid_fused_scan",
+            ),
+            1,
         )
 
         # Scenario batch: BATCH runs advanced together per grid step;
@@ -200,7 +279,12 @@ def _bench() -> None:
             return total
 
         secondary["batched_fused_scan_x4"] = round(
-            BATCH * _time_best(batched, EPOCHS, max_n=MAX_EPOCHS // BATCH), 1
+            BATCH
+            * _time_best(
+                batched, EPOCHS, max_n=MAX_EPOCHS // BATCH,
+                label="batched_fused_scan_x4",
+            ),
+            1,
         )
 
         # TRUE per-epoch weights: the reference's real workload shape.
@@ -219,12 +303,17 @@ def _bench() -> None:
 
         secondary["true_weights_fused_scan"] = round(
             _time_best(
-                true_weights("fused_scan_mxu"), 4 * TRUE_E, granularity=TRUE_E
+                true_weights("fused_scan_mxu"), 4 * TRUE_E,
+                granularity=TRUE_E, label="true_weights_fused_scan",
             ),
             1,
         )
         secondary["true_weights_xla"] = round(
-            _time_best(true_weights("xla"), TRUE_E, granularity=TRUE_E), 1
+            _time_best(
+                true_weights("xla"), TRUE_E, granularity=TRUE_E,
+                label="true_weights_xla",
+            ),
+            1,
         )
 
         # Chunked streaming (r4 verdict item 1): the beyond-HBM workload
@@ -259,7 +348,11 @@ def _bench() -> None:
             ).dividends
 
         secondary["streamed_true_weights_10k"] = round(
-            _time_best(streamed_host, 10 * TRUE_E, granularity=TRUE_E), 1
+            _time_best(
+                streamed_host, 10 * TRUE_E, granularity=TRUE_E,
+                label="streamed_true_weights_10k",
+            ),
+            1,
         )
 
         # Epoch-VARYING Monte-Carlo (r4 verdict item 4): 8 scenarios,
@@ -289,32 +382,98 @@ def _bench() -> None:
             )
 
         secondary["montecarlo_per_epoch_weights_x8"] = round(
-            _time_best(mc_varying, 4096, max_n=MAX_EPOCHS, granularity=MC_B),
+            _time_best(
+                mc_varying, 4096, max_n=MAX_EPOCHS, granularity=MC_B,
+                label="montecarlo_per_epoch_weights_x8",
+            ),
             1,
         )
 
-    record_epoch_rate("bench_primary", epochs_per_sec=primary)
+    record_epoch_rate(
+        "bench_primary", epochs_per_sec=primary, cv=_CVS.get("primary")
+    )
     # The secondary rates ride the registry snapshot as gauges so a
     # scrape of the bench process sees the full matrix, not just the
     # headline.
     registry = get_registry()
     for name, rate in secondary.items():
         registry.gauge(f"bench_{name}_epochs_per_sec").set(rate)
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"full-epoch simulated epochs/sec, {V}v x {M}m, weights "
-                    f"varying every epoch, Yuma 1 "
-                    f"({'single-Pallas-program epoch scan, exact MXU support (bitwise = VPU/XLA)' if on_tpu else 'XLA epoch kernel'})"
-                ),
-                "value": round(primary, 2),
-                "unit": "epochs/s",
-                "vs_baseline": round(primary / BASELINE_EPOCHS_PER_SEC, 1),
-                "secondary": secondary,
-            }
-        )
+    line = {
+        "metric": (
+            f"full-epoch simulated epochs/sec, {V}v x {M}m, weights "
+            f"varying every epoch, Yuma 1 "
+            f"({'single-Pallas-program epoch scan, exact MXU support (bitwise = VPU/XLA)' if on_tpu else 'XLA epoch kernel'})"
+        ),
+        "value": round(primary, 2),
+        "unit": "epochs/s",
+        "vs_baseline": round(primary / BASELINE_EPOCHS_PER_SEC, 1),
+        "secondary": secondary,
+    }
+    print(json.dumps(line))
+
+    if not args.no_history:
+        _append_history(line, primary_impl, primary, smoke=args.smoke,
+                        skip_costs=args.skip_costs, history=args.history)
+
+
+def _append_history(
+    line: dict,
+    primary_impl: str,
+    primary: float,
+    *,
+    smoke: bool,
+    skip_costs: bool,
+    history: str,
+) -> dict:
+    """One richer record per run into the JSONL history perfgate gates
+    on: the stdout fields + per-metric dispersion + the AOT cost report
+    and roofline verdicts for every engine rung. Crash-safe append
+    (whole-file atomic republish, tolerant reader — the ledger's
+    contract), so a killed bench never leaves a torn history."""
+    from yuma_simulation_tpu.telemetry.cost import (
+        capture_engine_costs,
+        resolve_device_spec,
+        roofline,
     )
+    from yuma_simulation_tpu.utils.checkpoint import (
+        publish_atomic,
+        read_jsonl_tolerant,
+    )
+
+    costs: dict = {}
+    rooflines: dict = {}
+    if not skip_costs:
+        spec = resolve_device_spec()
+        records = capture_engine_costs(V, M, COST_EPOCHS)
+        for engine, rec in records.items():
+            costs[engine] = rec.to_json()
+            rooflines[engine] = roofline(
+                rec,
+                spec,
+                measured_epochs_per_sec=(
+                    primary if engine == primary_impl else None
+                ),
+            ).to_json()
+    record = {
+        "t": round(time.time(), 3),
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+        "smoke": smoke,
+        **line,
+        "cv": {k: v for k, v in sorted(_CVS.items())},
+        "costs": costs,
+        "rooflines": rooflines,
+    }
+    import pathlib
+
+    path = pathlib.Path(history)
+    entries = read_jsonl_tolerant(path)
+    entries.append(record)
+    publish_atomic(
+        path,
+        "".join(json.dumps(e, sort_keys=True) + "\n" for e in entries).encode(),
+    )
+    return record
 
 
 if __name__ == "__main__":

@@ -133,6 +133,23 @@ def chaos(session: nox.Session) -> None:
     )
 
 
+@nox.session
+def perf(session: nox.Session) -> None:
+    """Perf lane (mirrors the CI `perf` job): CPU bench smoke capture
+    into a session-local history + the perfgate structural gate. The
+    committed BENCH_HISTORY.jsonl is untouched — run `python bench.py`
+    directly to append a real capture."""
+    import os
+
+    session.install("-e", ".[test]")
+    history = os.path.join(session.create_tmp(), "BENCH_HISTORY.jsonl")
+    session.run("python", "bench.py", "--smoke", "--history", history)
+    session.run(
+        "python", "-m", "tools.perfgate", "--check", "--structural",
+        "--history", history,
+    )
+
+
 @nox.session(python=PY_VERSIONS)
 def test_slow(session: nox.Session) -> None:
     """Slow lane: full 14x9 chart suite, f32-mode goldens, quickstart."""

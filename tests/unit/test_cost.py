@@ -1,0 +1,559 @@
+"""The AOT cost-model layer (ISSUE 5 tentpole): cost extraction on CPU
+(partial fields tolerated), roofline math, HBM preflight rejection of the
+known-overflow shape BEFORE compilation, perfgate verdicts on synthetic
+histories, and the costs.jsonl flight-recorder flow."""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.telemetry.cost import (
+    DEVICE_SPEC_ENV,
+    ENGINE_RUNGS,
+    PREFLIGHT_ENV,
+    CostRecord,
+    DeviceSpec,
+    HBMPreflightError,
+    _normalize_cost_analysis,
+    capture_engine_cost,
+    capture_engine_costs,
+    estimate_hbm_bytes,
+    preflight_hbm,
+    resolve_device_spec,
+    roofline,
+)
+
+SMALL_SPEC_ENV = json.dumps(
+    {"name": "test-16g", "peak_flops": 1.97e14,
+     "hbm_bandwidth": 8.19e11, "memory_bytes": 16 * 2**30}
+)
+
+
+# ---------------------------------------------------------------------------
+# Cost extraction on CPU
+
+
+def test_capture_xla_engine_cost_on_cpu():
+    """The XLA rung captures real flops/bytes/peak on CPU; the analysis
+    is normalized across jax versions (list- or dict-shaped)."""
+    rec = capture_engine_cost("xla", 16, 32, 8)
+    assert rec.engine == "xla" and rec.backend == "cpu"
+    assert rec.flops and rec.flops > 0
+    assert rec.bytes_accessed and rec.bytes_accessed > 0
+    assert rec.peak_bytes and rec.peak_bytes > 0
+    assert rec.peak_bytes_source in ("memory_analysis", "derived")
+    assert rec.argument_bytes and rec.output_bytes is not None
+    # [8, 16, 32] f32 weights + [8, 16] stakes + 2 int32 scalars.
+    assert rec.argument_bytes >= 8 * 16 * 32 * 4
+    assert rec.hlo_fingerprint and len(rec.hlo_fingerprint) == 16
+    assert rec.reason is None
+
+
+def test_fused_rungs_yield_explicit_null_with_reason_on_cpu():
+    """Acceptance: every rung in the cost report carries flops/bytes/
+    peak-memory fields — as numbers, or explicit null WITH a reason
+    (the fused Pallas rungs off-TPU)."""
+    costs = capture_engine_costs(16, 32, 8)
+    assert set(costs) == set(ENGINE_RUNGS)
+    for engine in ("fused_scan", "fused_scan_mxu"):
+        rec = costs[engine]
+        assert rec.flops is None and rec.bytes_accessed is None
+        assert rec.peak_bytes is None
+        assert rec.reason and "TPU" in rec.reason
+    as_json = costs["xla"].to_json()
+    for field in ("flops", "bytes_accessed", "peak_bytes",
+                  "hlo_fingerprint", "reason"):
+        assert field in as_json
+
+
+def test_hlo_fingerprint_tracks_the_program():
+    """Same shape -> same fingerprint (deterministic lowering); a
+    different shape is a different program."""
+    a = capture_engine_cost("xla", 16, 32, 8)
+    b = capture_engine_cost("xla", 16, 32, 8)
+    c = capture_engine_cost("xla", 16, 64, 8)
+    assert a.hlo_fingerprint == b.hlo_fingerprint
+    assert a.hlo_fingerprint != c.hlo_fingerprint
+
+
+def test_cost_analysis_scan_amortization_pinned():
+    """XLA's cost_analysis counts a scan body ONCE regardless of trip
+    count — the documented reason rooflines are ceilings, not
+    forecasts. If a jax upgrade starts scaling flops with E, this pin
+    flags it so the roofline docs (and perfgate baselines) follow."""
+    e8 = capture_engine_cost("xla", 16, 32, 8)
+    e32 = capture_engine_cost("xla", 16, 32, 32)
+    assert e8.flops == e32.flops  # amortized body
+    assert e32.argument_bytes > e8.argument_bytes  # the [E,V,M] stack grows
+
+
+def test_normalize_cost_analysis_shapes():
+    assert _normalize_cost_analysis(None) == {}
+    flat = _normalize_cost_analysis({"flops": 2.0, "bytes accessed": 3.0})
+    assert flat == {"flops": 2.0, "bytes accessed": 3.0}
+    summed = _normalize_cost_analysis(
+        [{"flops": 2.0}, {"flops": 1.0, "transcendentals": 4.0}]
+    )
+    assert summed["flops"] == 3.0 and summed["transcendentals"] == 4.0
+
+
+def test_unknown_engine_rejected():
+    with pytest.raises(ValueError, match="unknown engine"):
+        capture_engine_cost("warp_drive", 16, 32, 8)
+
+
+# ---------------------------------------------------------------------------
+# Roofline math
+
+
+def _rec(flops, bytes_accessed, epochs=100):
+    return CostRecord(
+        engine="xla", backend="tpu", V=16, M=32, epochs=epochs,
+        flops=flops, bytes_accessed=bytes_accessed,
+    )
+
+
+def test_roofline_memory_bound():
+    spec = DeviceSpec("t", peak_flops=1e12, hbm_bandwidth=1e9)
+    # intensity 0.1 << ridge 1000 -> memory bound; t = 1e9/1e9 = 1 s.
+    rl = roofline(_rec(1e8, 1e9), spec, measured_epochs_per_sec=50.0)
+    assert rl.bound == "memory"
+    assert rl.arithmetic_intensity == pytest.approx(0.1)
+    assert rl.ridge_intensity == pytest.approx(1000.0)
+    assert rl.predicted_seconds == pytest.approx(1.0)
+    assert rl.predicted_epochs_per_sec == pytest.approx(100.0)
+    assert rl.attained_fraction == pytest.approx(0.5)
+
+
+def test_roofline_compute_bound():
+    spec = DeviceSpec("t", peak_flops=1e12, hbm_bandwidth=1e12)
+    # intensity 100 >= ridge 1 -> compute bound; t = 1e14/1e12 = 100 s.
+    rl = roofline(_rec(1e14, 1e12), spec)
+    assert rl.bound == "compute"
+    assert rl.predicted_seconds == pytest.approx(100.0)
+    assert rl.attained_fraction is None
+
+
+def test_roofline_degrades_on_unknown_spec_and_null_record():
+    rl = roofline(_rec(1e8, 1e9), DeviceSpec("mystery"))
+    assert rl.bound is None and rl.predicted_epochs_per_sec is None
+    assert rl.arithmetic_intensity == pytest.approx(0.1)
+    null = CostRecord(engine="fused_scan", backend="cpu", V=16, M=32,
+                      epochs=8, reason="unavailable")
+    rl2 = roofline(null, DeviceSpec("t", 1e12, 1e9))
+    assert rl2.bound is None and rl2.predicted_seconds is None
+
+
+def test_resolve_device_spec_env_override(monkeypatch):
+    monkeypatch.setenv(DEVICE_SPEC_ENV, SMALL_SPEC_ENV)
+    spec = resolve_device_spec()
+    assert spec.name == "test-16g"
+    assert spec.memory_bytes == 16 * 2**30
+    monkeypatch.setenv(DEVICE_SPEC_ENV, "not json {")
+    assert resolve_device_spec().name != "test-16g"  # ignored, falls back
+    # explicit override beats env
+    monkeypatch.setenv(DEVICE_SPEC_ENV, SMALL_SPEC_ENV)
+    assert resolve_device_spec(DeviceSpec("explicit")).name == "explicit"
+
+
+# ---------------------------------------------------------------------------
+# Footprint + preflight
+
+
+def test_estimate_hbm_bytes_arithmetic():
+    base = estimate_hbm_bytes(8192, 131072, resident_epochs=0)
+    # 6 working-set [V, M] f32 buffers at 4 GiB each = 24 GiB.
+    assert base.total_bytes == 6 * 8192 * 131072 * 4
+    sharded = estimate_hbm_bytes(8192, 131072, resident_epochs=0,
+                                 miner_shards=4)
+    assert sharded.total_bytes == base.total_bytes // 4
+    stacked = estimate_hbm_bytes(64, 128, resident_epochs=10,
+                                 save_bonds=True)
+    assert stacked.breakdown["weights_stack"] == 10 * 64 * 128 * 4
+    assert stacked.breakdown["bonds_out"] == 10 * 64 * 128 * 4
+    lanes = estimate_hbm_bytes(64, 128, resident_epochs=10, batch_lanes=3)
+    assert lanes.total_bytes == 3 * estimate_hbm_bytes(
+        64, 128, resident_epochs=10
+    ).total_bytes
+
+
+def test_preflight_rejects_known_overflow_shape(caplog):
+    """Acceptance: 8192x131072 (the shape the memory envelope brackets
+    as failing at compile) rejects with a typed event BEFORE any
+    compile."""
+    from yuma_simulation_tpu.utils.logging import parse_event_line
+
+    spec = DeviceSpec("test-16g", memory_bytes=16 * 2**30)
+    est = estimate_hbm_bytes(8192, 131072, resident_epochs=0)
+    with caplog.at_level(logging.WARNING,
+                         "yuma_simulation_tpu.telemetry.cost"):
+        with pytest.raises(HBMPreflightError) as err:
+            preflight_hbm("envelope", est, spec=spec)
+    verdict = err.value.verdict
+    assert verdict.fits is False
+    assert verdict.predicted_bytes == est.total_bytes
+    assert "shard the miner axis" in (verdict.suggestion or "")
+    events = [parse_event_line(r.getMessage()) for r in caplog.records]
+    events = [e for e in events if e and e["event"] == "preflight_rejected"]
+    assert len(events) == 1
+    assert events[0]["V"] == "8192" and events[0]["M"] == "131072"
+    assert events[0]["device"] == "test-16g"
+
+
+def test_preflight_passes_fitting_and_unknown_capacity():
+    spec = DeviceSpec("test-16g", memory_bytes=16 * 2**30)
+    ok = preflight_hbm(
+        "envelope", estimate_hbm_bytes(1024, 16384, resident_epochs=0),
+        spec=spec,
+    )
+    assert ok.fits is True
+    unknown = preflight_hbm(
+        "envelope", estimate_hbm_bytes(8192, 131072, resident_epochs=0),
+        spec=DeviceSpec("cpu"),
+    )
+    assert unknown.fits is None  # open pass, no event, no raise
+
+
+def test_preflight_env_disable(monkeypatch):
+    monkeypatch.setenv(PREFLIGHT_ENV, "0")
+    spec = DeviceSpec("test-16g", memory_bytes=16 * 2**30)
+    v = preflight_hbm(
+        "envelope", estimate_hbm_bytes(8192, 131072, resident_epochs=0),
+        spec=spec,
+    )
+    assert v.fits is None
+
+
+def test_preflight_suggests_streaming_when_epoch_stack_dominates():
+    spec = DeviceSpec("test-16g", memory_bytes=16 * 2**30)
+    # 256x4096: working set 24 MiB; 65536 resident epochs = 256 GiB.
+    est = estimate_hbm_bytes(256, 4096, resident_epochs=65536)
+    v = preflight_hbm("simulate", est, spec=spec, raise_on_reject=False)
+    assert v.fits is False
+    assert "max_resident_epochs" in v.suggestion
+
+
+def test_simulate_constant_preflight_fires_before_any_allocation(monkeypatch):
+    """The engine advisor integration: the known-overflow shape is
+    rejected on ShapeDtypeStructs — no 4 GiB buffer is ever built, no
+    trace starts (a trace would TypeError on the abstract W first)."""
+    from yuma_simulation_tpu.models.config import YumaConfig
+    from yuma_simulation_tpu.models.variants import variant_for_version
+    from yuma_simulation_tpu.simulation.engine import simulate_constant
+
+    monkeypatch.setenv(DEVICE_SPEC_ENV, SMALL_SPEC_ENV)
+    W = jax.ShapeDtypeStruct((8192, 131072), jnp.float32)
+    S = jax.ShapeDtypeStruct((8192,), jnp.float32)
+    with pytest.raises(HBMPreflightError, match="simulate_constant"):
+        simulate_constant(
+            W, S, 10, YumaConfig(), variant_for_version("Yuma 1 (paper)")
+        )
+
+
+def test_simulate_preflight_rejects_under_tiny_spec(monkeypatch):
+    from yuma_simulation_tpu.scenarios import create_case
+    from yuma_simulation_tpu.simulation.engine import simulate
+
+    monkeypatch.setenv(
+        DEVICE_SPEC_ENV,
+        json.dumps({"name": "tiny", "memory_bytes": 512}),
+    )
+    case = create_case("Case 1")
+    with pytest.raises(HBMPreflightError, match="predicted peak HBM"):
+        simulate(case, "Yuma 1 (paper)")
+    # The same dispatch passes when the preflight is disabled.
+    monkeypatch.setenv(PREFLIGHT_ENV, "0")
+    out = simulate(case, "Yuma 1 (paper)")
+    assert np.isfinite(out.dividends).all()
+
+
+def test_sharded_batch_preflight_rejects_under_tiny_spec(monkeypatch):
+    from yuma_simulation_tpu.parallel import make_mesh
+    from yuma_simulation_tpu.parallel.sharded import simulate_batch_sharded
+    from yuma_simulation_tpu.scenarios import create_case
+
+    monkeypatch.setenv(
+        DEVICE_SPEC_ENV,
+        json.dumps({"name": "tiny", "memory_bytes": 512}),
+    )
+    cases = [create_case("Case 1"), create_case("Case 2")]
+    with pytest.raises(HBMPreflightError, match="sharded_batch"):
+        simulate_batch_sharded(cases, "Yuma 1 (paper)", mesh=make_mesh())
+
+
+def test_preflight_error_is_not_ladder_retryable():
+    """classify_failure must treat a preflight rejection as a caller
+    error (None), never as a retryable engine failure: no amount of
+    rung demotion changes the arithmetic."""
+    from yuma_simulation_tpu.resilience.errors import classify_failure
+
+    assert classify_failure(HBMPreflightError("no fit")) is None
+
+
+# ---------------------------------------------------------------------------
+# perfgate verdicts on synthetic histories
+
+
+def _history_record(value, cv=0.02, smoke=False, backend="cpu", t=0.0,
+                    secondary=None, **overrides):
+    costs = {
+        engine: {
+            "engine": engine, "backend": backend, "V": 256, "M": 4096,
+            "epochs": 512,
+            "flops": 1e8 if engine == "xla" else None,
+            "bytes_accessed": 2e8 if engine == "xla" else None,
+            "peak_bytes": 2**30 if engine == "xla" else None,
+            "reason": None if engine == "xla" else "TPU-only rung",
+        }
+        for engine in ENGINE_RUNGS
+    }
+    record = {
+        "t": t, "backend": backend, "smoke": smoke, "jax": "x",
+        "metric": "epochs/sec", "value": value, "unit": "epochs/s",
+        "secondary": dict(secondary or {}),
+        "cv": {"primary": cv}, "costs": costs, "rooflines": {},
+    }
+    record.update(overrides)
+    return record
+
+
+def _write_history(tmp_path, records):
+    path = tmp_path / "hist.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    return str(path)
+
+
+def test_perfgate_detects_regression(tmp_path, capsys):
+    from tools.perfgate import compare, main
+
+    records = [_history_record(100.0, t=i) for i in range(5)]
+    records.append(_history_record(70.0, t=5))
+    result = compare(records)
+    assert result["verdicts"]["primary"]["status"] == "regression"
+    path = _write_history(tmp_path, records)
+    assert main(["--history", path, "--check"]) == 1
+    assert main(["--history", path]) == 0  # report-only never gates
+    capsys.readouterr()
+
+
+def test_perfgate_improvement_and_flat(tmp_path):
+    from tools.perfgate import compare
+
+    records = [_history_record(100.0, t=i) for i in range(4)]
+    assert (
+        compare(records + [_history_record(140.0, t=9)])["verdicts"][
+            "primary"]["status"]
+        == "improvement"
+    )
+    assert (
+        compare(records + [_history_record(97.0, t=9)])["verdicts"][
+            "primary"]["status"]
+        == "flat"
+    )
+
+
+def test_perfgate_noisy_but_flat_widens_tolerance(tmp_path):
+    """A 25% drop under cv=0.15 (noise_mult 3 -> 45% tolerance) must NOT
+    false-fail; the same drop on a tight metric must."""
+    from tools.perfgate import compare
+
+    noisy = [_history_record(100.0, cv=0.15, t=i) for i in range(5)]
+    verdict = compare(noisy + [_history_record(75.0, cv=0.15, t=9)])[
+        "verdicts"]["primary"]
+    assert verdict["status"] == "flat"
+    assert verdict["tolerance"] == pytest.approx(0.45)
+    tight = [_history_record(100.0, cv=0.01, t=i) for i in range(5)]
+    assert (
+        compare(tight + [_history_record(75.0, cv=0.01, t=9)])["verdicts"][
+            "primary"]["status"]
+        == "regression"
+    )
+
+
+def test_perfgate_baselines_never_mix_backends_or_smoke(tmp_path):
+    from tools.perfgate import compare
+
+    history = [_history_record(100.0, backend="tpu", t=i) for i in range(5)]
+    history += [_history_record(100.0, smoke=True, t=i) for i in range(5)]
+    # A fresh real CPU capture has NO comparable baseline despite 10
+    # prior records.
+    verdict = compare(history + [_history_record(10.0, t=99)])["verdicts"][
+        "primary"]
+    assert verdict["status"] == "no_baseline"
+
+
+def test_perfgate_structural_gate(tmp_path):
+    from tools.perfgate import check_structure, main
+
+    sound = _history_record(100.0)
+    assert check_structure(sound) == []
+    # A null analysis field with no reason is schema rot.
+    broken = _history_record(100.0)
+    broken["costs"]["xla"]["flops"] = None
+    problems = check_structure(broken)
+    assert any("null with no reason" in p for p in problems)
+    # A missing rung is schema rot.
+    short = _history_record(100.0)
+    del short["costs"]["fused_scan"]
+    assert any("fused_scan" in p for p in check_structure(short))
+    # An EMPTY cost report is schema rot too (--skip-costs captures must
+    # not green the CI gate), and a non-dict rung entry must be reported
+    # rather than crash the gate.
+    empty_costs = _history_record(100.0)
+    empty_costs["costs"] = {}
+    assert len(check_structure(empty_costs)) == len(ENGINE_RUNGS)
+    mangled = _history_record(100.0)
+    mangled["costs"]["xla"] = 1
+    assert any("not an object" in p for p in check_structure(mangled))
+    path = _write_history(tmp_path, [broken])
+    assert main(["--history", path, "--check", "--structural"]) == 2
+    path2 = _write_history(tmp_path, [sound])
+    assert main(["--history", path2, "--check", "--structural"]) == 0
+    # Empty history is a structural failure, not a pass.
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert main(["--history", str(empty), "--check"]) == 2
+
+
+def test_perfgate_report_artifact(tmp_path):
+    from tools.perfgate import main
+
+    path = _write_history(
+        tmp_path,
+        [_history_record(100.0, t=i) for i in range(3)]
+        + [_history_record(101.0, t=9)],
+    )
+    report = tmp_path / "perfgate_report.json"
+    assert main(["--history", path, "--check", "--report",
+                 str(report)]) == 0
+    payload = json.loads(report.read_text())
+    assert payload["verdicts"]["primary"]["status"] == "flat"
+    assert payload["structural_problems"] == []
+
+
+# ---------------------------------------------------------------------------
+# costs.jsonl flight flow + obsreport perf section
+
+
+def test_flight_record_costs_merge_and_check(tmp_path):
+    from yuma_simulation_tpu.telemetry.flight import (
+        FlightRecorder,
+        check_bundle,
+        load_bundle,
+    )
+
+    recorder = FlightRecorder(tmp_path)
+    costs = capture_engine_costs(16, 32, 8)
+    recorder.record_costs(costs, run_id="run-a")
+    recorder.record_costs(costs, run_id="run-a")  # re-capture: no dupes
+    bundle = load_bundle(tmp_path)
+    assert len(bundle.costs) == len(ENGINE_RUNGS)
+    assert {r["engine"] for r in bundle.costs} == set(ENGINE_RUNGS)
+    assert all(r["run_id"] == "run-a" for r in bundle.costs)
+    assert check_bundle(bundle) == []
+    # A second run at another shape accumulates.
+    recorder.record_costs(
+        [capture_engine_cost("xla", 16, 64, 8)], run_id="run-b"
+    )
+    assert len(load_bundle(tmp_path).costs) == len(ENGINE_RUNGS) + 1
+
+
+def test_check_bundle_flags_null_cost_without_reason(tmp_path):
+    from yuma_simulation_tpu.telemetry.flight import (
+        FlightRecorder,
+        check_bundle,
+        load_bundle,
+    )
+
+    recorder = FlightRecorder(tmp_path)
+    bad = CostRecord(engine="xla", backend="cpu", V=1, M=1, epochs=1)
+    recorder.record_costs([bad])
+    problems = check_bundle(load_bundle(tmp_path))
+    assert any("null flops with no reason" in p for p in problems)
+
+
+def test_obsreport_renders_perf_section(tmp_path):
+    from tools.obsreport import render_perf
+    from yuma_simulation_tpu.telemetry.flight import (
+        FlightRecorder,
+        load_bundle,
+    )
+
+    FlightRecorder(tmp_path).record_costs(capture_engine_costs(16, 32, 8))
+    lines = render_perf(load_bundle(tmp_path))
+    text = "\n".join(lines)
+    assert "AOT cost report" in text
+    assert "xla [8x16x32]:" in text and "flops=" in text
+    assert "unavailable" in text  # the fused rungs on CPU, reason shown
+
+
+def test_obsreport_perf_tolerates_minimal_cost_lines(tmp_path):
+    """A check_bundle-valid but minimal costs.jsonl line (foreign
+    writer) must render, not crash the report."""
+    from tools.obsreport import render_perf
+    from yuma_simulation_tpu.telemetry.flight import (
+        COSTS_NAME,
+        check_bundle,
+        load_bundle,
+    )
+
+    (tmp_path / COSTS_NAME).write_text(
+        json.dumps({"engine": "xla", "flops": 1e9, "bytes_accessed": 2e9})
+        + "\n"
+    )
+    bundle = load_bundle(tmp_path)
+    assert check_bundle(bundle) == []
+    text = "\n".join(render_perf(bundle))
+    assert "xla" in text and "flops=1e+09" in text
+
+
+# ---------------------------------------------------------------------------
+# compile_seconds histogram (RecompilationSentinel satellite)
+
+
+def test_sentinel_records_compile_seconds_histogram():
+    from yuma_simulation_tpu.telemetry.metrics import get_registry
+    from yuma_simulation_tpu.utils.profiling import RecompilationSentinel
+
+    registry = get_registry()
+    before = registry.histogram("compile_seconds").snapshot()["count"]
+
+    @jax.jit
+    def fresh(x):
+        return x * jnp.asarray(2.0, jnp.float32)
+
+    with RecompilationSentinel(fresh, budget=1, label="cold"):
+        np.asarray(fresh(jnp.ones((4,), jnp.float32)))
+    after = registry.histogram("compile_seconds").snapshot()
+    assert after["count"] == before + 1
+    assert after["sum"] > 0
+    # A compile-free region must NOT observe (no phantom compile time).
+    with RecompilationSentinel(fresh, budget=0, label="warm"):
+        np.asarray(fresh(jnp.ones((4,), jnp.float32)))
+    assert registry.histogram("compile_seconds").snapshot()["count"] == (
+        before + 1
+    )
+
+
+def test_record_epoch_rate_cv_gauge_and_event(caplog):
+    from yuma_simulation_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        record_epoch_rate,
+    )
+    from yuma_simulation_tpu.utils.logging import parse_event_line
+
+    registry = MetricsRegistry()
+    with caplog.at_level(logging.INFO,
+                         "yuma_simulation_tpu.telemetry.metrics"):
+        record_epoch_rate(
+            "bench", epochs_per_sec=123.0, cv=0.07, registry=registry
+        )
+    assert registry.gauge("epochs_per_sec_cv").value == pytest.approx(0.07)
+    events = [parse_event_line(r.getMessage()) for r in caplog.records]
+    events = [e for e in events if e and e["event"] == "epoch_rate"]
+    assert events and events[0]["cv"] == "0.0700"

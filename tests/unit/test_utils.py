@@ -237,14 +237,15 @@ def test_time_best_counts_and_granularity():
         time.sleep(n * 1e-4)  # 10k "epochs" ~= 1 s
         return n
 
-    rate, n_timed, times = time_best(
+    rate, n_timed, times, cv = time_best(
         run, 7, max_n=100_000, granularity=7, target_seconds=0.05, reps=2
     )
     assert n_timed % 7 == 0 and n_timed > 7  # grew, on the granularity grid
     assert len(times) == 2 and rate > 0
+    assert cv >= 0.0  # dispersion across the two repeats
     assert all(n % 7 == 0 for n in executed)
     # A run already past the window is not grown.
-    rate2, n2, _ = time_best(
+    rate2, n2, _, _ = time_best(
         run, 1_000, max_n=100_000, target_seconds=0.05, reps=2
     )
     assert n2 == 1_000
@@ -261,10 +262,11 @@ def test_time_best_terminates_and_rounds_edge_cases():
 
     # max_n=20 is NOT a multiple of granularity=6: the floored cap (18)
     # must terminate the loop, not re-time 18 forever.
-    _, n_timed, _ = time_best(
+    _, n_timed, _, cv = time_best(
         instant, 6, max_n=20, granularity=6, target_seconds=10.0, reps=1
     )
     assert n_timed == 18
+    assert cv == 0.0  # single rep: no dispersion to report
     # The caller-supplied initial n is rounded onto the grid too.
     executed.clear()
     time_best(instant, 7, max_n=18, granularity=6, target_seconds=10.0, reps=1)

@@ -71,10 +71,13 @@ def _bench(run, n, unit_name, max_n=1 << 20, granularity=1):
     """The shared timing discipline (utils/timing.py): warm (compile),
     grow `n` iteratively until one timed run lasts >= MIN_SECONDS, then
     best-of-REPS. Returns (rate, methodology_dict)."""
-    rate, n, times = time_best(run, n, max_n=max_n, granularity=granularity)
+    rate, n, times, cv = time_best(
+        run, n, max_n=max_n, granularity=granularity
+    )
     return rate, {
         "reps": REPS,
         "times_s": times,
+        "cv": cv,
         unit_name: n,
         "method": f"best-of-{REPS}, >= {MIN_SECONDS}s per timed run",
     }

@@ -38,11 +38,32 @@ enable_compilation_cache()
 
 
 def peak_hbm_gib():
-    """Peak device memory in GiB, or None when the backend doesn't report
-    it (CPU) — None serializes as valid JSON null, NaN would not."""
+    """Peak device memory in GiB from the RUNTIME's allocator stats, or
+    None when the backend doesn't report them (CPU) — None serializes as
+    valid JSON null, NaN would not."""
     stats = jax.local_devices()[0].memory_stats() or {}
     peak = stats.get("peak_bytes_in_use")
     return round(peak / 2**30, 2) if peak else None
+
+
+def aot_peak_hbm_gib(run_aot) -> tuple:
+    """(peak GiB, source) from the COMPILED program's own memory
+    analysis (`telemetry.cost.capture_compiled`), lowered from
+    ShapeDtypeStructs — the backend-independent answer the allocator
+    stats can't give on CPU. Returns (None, reason) only when the
+    runtime truly reports no memory analysis."""
+    from yuma_simulation_tpu.telemetry.cost import capture_compiled
+
+    try:
+        lowered = run_aot()
+    except Exception as e:
+        return None, f"lowering failed: {str(e).splitlines()[0][:120]}"
+    rec = capture_compiled(lowered, engine="probe", V=0, M=0, epochs=0)
+    if rec.peak_bytes is None:
+        return None, rec.reason or "no memory analysis"
+    return round(rec.peak_bytes / 2**30, 2), (
+        f"aot_{rec.peak_bytes_source or 'memory_analysis'}"
+    )
 
 
 def probe(V: int, M: int, epochs: int, mesh=None) -> dict:
@@ -76,12 +97,32 @@ def probe(V: int, M: int, epochs: int, mesh=None) -> dict:
     out = run()
     dt = time.perf_counter() - t0
     assert np.isfinite(out).all()
+    # The runtime's allocator peak when it reports one (TPU/GPU); else
+    # the compiled program's own memory analysis (args+outputs+temps,
+    # or its explicit peak where the runtime exposes it) — so the CPU
+    # envelope carries a real number, with null reserved for runtimes
+    # that truly report neither.
+    peak, source = peak_hbm_gib(), "runtime"
+    if peak is None:
+
+        def run_aot():
+            Wspec = jax.ShapeDtypeStruct(W.shape, W.dtype)
+            Sspec = jax.ShapeDtypeStruct(S.shape, S.dtype)
+            return jax.jit(
+                lambda w, s: simulate_constant(
+                    w, s, epochs, cfg, spec, consensus_impl="bisect",
+                    mesh=mesh,
+                )[0]
+            ).lower(Wspec, Sspec)
+
+        peak, source = aot_peak_hbm_gib(run_aot)
     return {
         "V": V,
         "M": M,
         "epochs": epochs,
         "epochs_per_s": round(epochs / dt, 1),
-        "peak_hbm_gib": peak_hbm_gib(),
+        "peak_hbm_gib": peak,
+        "peak_hbm_source": source,
         "state_mib_per_vm_buffer": round(V * M * 4 / 2**20, 1),
     }
 

@@ -3,10 +3,12 @@
 The operator's one-stop answer to "what did this sweep actually do":
 loads the bundle a supervised sweep leaves in its checkpoint directory
 (``ledger.jsonl`` + ``spans.jsonl`` + ``metrics.jsonl`` +
-``report.json`` — see :mod:`yuma_simulation_tpu.telemetry.flight`) and
-renders the span tree with every ledger record — demotions, stalls,
-shrinks, requeues, quarantines — attributed to its span, cross-checked
-against the run's `SweepHealthReport`.
+``costs.jsonl`` + ``report.json`` — see
+:mod:`yuma_simulation_tpu.telemetry.flight`) and renders the span tree
+with every ledger record — demotions, stalls, shrinks, requeues,
+quarantines — attributed to its span, cross-checked against the run's
+`SweepHealthReport`, plus a perf section (AOT cost report + roofline
+verdicts) when the bundle carries cost records.
 
 Usage::
 
@@ -132,7 +134,86 @@ def render(bundle, run_id: str | None) -> str:
                 f"{k}={_num(v)}" for k, v in {**counters, **gauges}.items()
             )
         )
+    perf = render_perf(bundle)
+    if perf:
+        lines.append("")
+        lines.extend(perf)
     return "\n".join(lines)
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    return f"{n / 2**30:.2f}GiB" if n >= 2**30 else f"{n / 2**20:.1f}MiB"
+
+
+def render_perf(bundle) -> list[str]:
+    """The perf section: one line per AOT cost record (costs.jsonl) —
+    flops / bytes moved / peak memory / HLO fingerprint plus the
+    roofline verdict under the current host's device spec, with the
+    last snapshot's measured epochs/s alongside the predicted ceiling."""
+    if not bundle.costs:
+        return []
+    import dataclasses
+
+    from yuma_simulation_tpu.telemetry.cost import (
+        CostRecord,
+        resolve_device_spec,
+        roofline,
+    )
+
+    spec = resolve_device_spec()
+    # The last snapshot's measured rate belongs to whichever rung the
+    # sweep actually ran — the bundle doesn't say which, so it renders
+    # once in the header and is NOT attributed to any record's roofline
+    # (an attained% against the wrong rung's ceiling would be noise).
+    measured = None
+    if bundle.metrics:
+        g = bundle.metrics[-1].get("gauges", {})
+        measured = g.get("epochs_per_sec")
+    field_names = {f.name for f in dataclasses.fields(CostRecord)}
+    header = f"perf (AOT cost report, device spec: {spec.name}"
+    if measured is not None:
+        header += f", last measured rate: {measured:.3g}ep/s"
+    lines = [header + "):"]
+    defaults = {"engine": "?", "backend": None, "V": 0, "M": 0, "epochs": 0}
+    for raw in bundle.costs:
+        # Tolerant reconstruction: a minimal (or foreign-writer) line
+        # that passed check_bundle must render, not crash the report.
+        rec = CostRecord(
+            **{
+                **defaults,
+                **{k: v for k, v in raw.items() if k in field_names},
+            }
+        )
+        shape = f"[{rec.epochs}x{rec.V}x{rec.M}]"
+        if rec.flops is None and rec.bytes_accessed is None:
+            lines.append(
+                f"  {rec.engine} {shape}: unavailable"
+                + (f" ({rec.reason})" if rec.reason else "")
+            )
+            continue
+        rl = roofline(rec, spec)
+        parts = [
+            f"  {rec.engine} {shape}:",
+            f"flops={rec.flops:.3g}" if rec.flops is not None else "flops=?",
+            (
+                f"bytes={rec.bytes_accessed:.3g}"
+                if rec.bytes_accessed is not None
+                else "bytes=?"
+            ),
+            f"peak={_fmt_bytes(rec.peak_bytes)}"
+            + (f"({rec.peak_bytes_source})" if rec.peak_bytes_source else ""),
+            f"hlo={rec.hlo_fingerprint}" if rec.hlo_fingerprint else "",
+        ]
+        if rl.arithmetic_intensity is not None:
+            parts.append(f"intensity={rl.arithmetic_intensity:.3g}")
+        if rl.bound:
+            parts.append(f"bound={rl.bound}")
+        if rl.predicted_epochs_per_sec is not None:
+            parts.append(f"roofline={rl.predicted_epochs_per_sec:.3g}ep/s")
+        lines.append(" ".join(p for p in parts if p))
+    return lines
 
 
 def _num(v):
@@ -277,6 +358,7 @@ def main(argv: list[str] | None = None) -> int:
                     "spans": bundle.spans,
                     "ledger": bundle.ledger,
                     "metrics": bundle.metrics,
+                    "costs": bundle.costs,
                     "report": bundle.report,
                 },
                 indent=2,

@@ -1,0 +1,315 @@
+"""perfgate: the machine-checkable perf-regression gate over BENCH history.
+
+The BENCH_r* trajectory (115k -> 62k epochs/s on the headline metric
+between r4 and r5) regressed silently because nothing diffed one capture
+against the last. `bench.py` now appends every run — rates, per-metric
+timing dispersion (`cv`), the AOT cost report and roofline verdicts
+(`yuma_simulation_tpu.telemetry.cost`) — to ``BENCH_HISTORY.jsonl``;
+this CLI diffs the LATEST record against a noise-aware rolling baseline
+of the prior ones.
+
+Noise-awareness: a metric's tolerance is widened to
+``noise_mult x max(cv_latest, median baseline cv)`` when the timing
+dispersion exceeds the flat ``--tolerance`` — a noisy-but-flat metric
+must not false-fail, and a tight metric must not hide a real 10% drop
+behind a blanket 30% tolerance. Baselines never mix backends or smoke
+flags: a TPU capture is not a baseline for a CPU run, and a
+short-window ``--smoke`` capture is not a baseline for a real one.
+
+Usage::
+
+    python -m tools.perfgate                      # verdicts, exit 0
+    python -m tools.perfgate --check              # exit 1 on regression,
+                                                  # exit 2 on schema rot
+    python -m tools.perfgate --check --structural # schema gate only (the
+                                                  # CPU CI lane: absolute
+                                                  # rates are machine-
+                                                  # dependent, the record
+                                                  # SHAPE is not)
+    python -m tools.perfgate --json --report perfgate_report.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_HISTORY = "BENCH_HISTORY.jsonl"
+DEFAULT_WINDOW = 5
+DEFAULT_TOLERANCE = 0.15
+DEFAULT_NOISE_MULT = 3.0
+
+#: Fields every history record must carry (structural gate).
+REQUIRED_FIELDS = (
+    "t", "backend", "smoke", "metric", "value", "unit", "secondary",
+    "cv", "costs", "rooflines",
+)
+
+#: Every engine rung must appear in the cost report, and each must carry
+#: these analysis fields — as numbers, or as explicit nulls with a
+#: non-null ``reason`` (the CPU contract for the Pallas rungs).
+COST_FIELDS = ("flops", "bytes_accessed", "peak_bytes")
+
+
+def load_history(path: str) -> list[dict]:
+    from yuma_simulation_tpu.utils.checkpoint import read_jsonl_tolerant
+
+    return read_jsonl_tolerant(path)
+
+
+def check_structure(record: dict) -> list[str]:
+    """Schema problems in one history record (empty list = sound)."""
+    from yuma_simulation_tpu.telemetry.cost import ENGINE_RUNGS
+
+    problems: list[str] = []
+    for field in REQUIRED_FIELDS:
+        if field not in record:
+            problems.append(f"record lacks required field {field!r}")
+    value = record.get("value")
+    if not isinstance(value, (int, float)) or value <= 0:
+        problems.append(f"headline value must be a positive number, got "
+                        f"{value!r}")
+    for field in ("secondary", "cv", "costs", "rooflines"):
+        if field in record and not isinstance(record[field], dict):
+            problems.append(f"{field} must be an object")
+    costs = record.get("costs")
+    if isinstance(costs, dict):
+        # An empty report is schema rot, not a pass: the CI invariant is
+        # that every rung is present with its fields (a --skip-costs
+        # capture is fine locally but must not green the gate).
+        for engine in ENGINE_RUNGS:
+            rec = costs.get(engine)
+            if not isinstance(rec, dict):
+                problems.append(
+                    f"cost report lacks engine rung {engine!r}"
+                    if rec is None
+                    else f"costs[{engine}] is not an object"
+                )
+                continue
+            for field in COST_FIELDS:
+                if field not in rec:
+                    problems.append(f"costs[{engine}] lacks {field!r}")
+                elif rec[field] is None and not rec.get("reason"):
+                    problems.append(
+                        f"costs[{engine}].{field} is null with no reason"
+                    )
+    return problems
+
+
+def _baseline_records(history: list[dict], latest: dict, window: int):
+    """The comparable prior records: same backend, same smoke flag, same
+    unit, newest `window` of them."""
+    prior = [
+        r
+        for r in history[:-1]
+        if r.get("backend") == latest.get("backend")
+        and bool(r.get("smoke")) == bool(latest.get("smoke"))
+        and r.get("unit") == latest.get("unit")
+    ]
+    return prior[-window:]
+
+
+def _metric_values(record: dict) -> dict[str, float]:
+    """`{metric_key: rate}` for the headline (+ numeric secondaries).
+    The headline rides under "primary" — the same key its cv uses."""
+    out: dict[str, float] = {}
+    if isinstance(record.get("value"), (int, float)):
+        out["primary"] = float(record["value"])
+    for key, value in (record.get("secondary") or {}).items():
+        if isinstance(value, (int, float)):
+            out[key] = float(value)
+    return out
+
+
+def compare(
+    history: list[dict],
+    *,
+    window: int = DEFAULT_WINDOW,
+    tolerance: float = DEFAULT_TOLERANCE,
+    noise_mult: float = DEFAULT_NOISE_MULT,
+    min_baseline: int = 2,
+) -> dict:
+    """Diff the latest record against the rolling baseline.
+
+    Returns ``{"latest_t", "backend", "smoke", "baseline_runs",
+    "verdicts": {metric: {...}}}`` where each verdict carries the
+    latest/baseline rates, the relative delta, the effective tolerance
+    (noise-widened when the metric's cv demands it) and a status of
+    ``regression`` / ``improvement`` / ``flat`` / ``no_baseline``.
+    """
+    latest = history[-1]
+    baseline = _baseline_records(history, latest, window)
+    latest_metrics = _metric_values(latest)
+    latest_cv = latest.get("cv") or {}
+    verdicts: dict[str, dict] = {}
+    for key, value in sorted(latest_metrics.items()):
+        base_values = [
+            m[key] for m in (_metric_values(r) for r in baseline) if key in m
+        ]
+        if len(base_values) < min_baseline:
+            verdicts[key] = {
+                "status": "no_baseline",
+                "latest": value,
+                "baseline_runs": len(base_values),
+            }
+            continue
+        base = statistics.median(base_values)
+        base_cvs = [
+            float((r.get("cv") or {}).get(key))
+            for r in baseline
+            if isinstance((r.get("cv") or {}).get(key), (int, float))
+        ]
+        noise = max(
+            float(latest_cv.get(key) or 0.0),
+            statistics.median(base_cvs) if base_cvs else 0.0,
+        )
+        tol_eff = max(tolerance, noise_mult * noise)
+        rel = (value - base) / base if base else 0.0
+        if rel < -tol_eff:
+            status = "regression"
+        elif rel > tol_eff:
+            status = "improvement"
+        else:
+            status = "flat"
+        verdicts[key] = {
+            "status": status,
+            "latest": value,
+            "baseline": round(base, 2),
+            "baseline_runs": len(base_values),
+            "rel_delta": round(rel, 4),
+            "tolerance": round(tol_eff, 4),
+            "noise_cv": round(noise, 4),
+        }
+    return {
+        "latest_t": latest.get("t"),
+        "backend": latest.get("backend"),
+        "smoke": bool(latest.get("smoke")),
+        "baseline_runs": len(baseline),
+        "verdicts": verdicts,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="perfgate", description=__doc__.split("\n\n")[0]
+    )
+    parser.add_argument(
+        "--history", default=DEFAULT_HISTORY,
+        help=f"bench history JSONL (default {DEFAULT_HISTORY})",
+    )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="gate: exit 2 on structural problems, exit 1 on regressions",
+    )
+    parser.add_argument(
+        "--structural", action="store_true",
+        help="validate the record schema only — no baseline comparison "
+        "(the CPU CI lane)",
+    )
+    parser.add_argument("--window", type=int, default=DEFAULT_WINDOW)
+    parser.add_argument(
+        "--tolerance", type=float, default=DEFAULT_TOLERANCE,
+        help=f"flat relative tolerance (default {DEFAULT_TOLERANCE})",
+    )
+    parser.add_argument(
+        "--noise-mult", type=float, default=DEFAULT_NOISE_MULT,
+        help="tolerance widens to this multiple of the metric's timing "
+        f"CV when noisier than --tolerance (default {DEFAULT_NOISE_MULT})",
+    )
+    parser.add_argument(
+        "--min-baseline", type=int, default=2,
+        help="prior comparable runs required before verdicts fire",
+    )
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument(
+        "--report", default=None,
+        help="also write the JSON verdict to this path (CI artifact)",
+    )
+    args = parser.parse_args(argv)
+
+    history = load_history(args.history)
+    if not history:
+        print(
+            f"perfgate: no records in {args.history!r} (run bench.py first)",
+            file=sys.stderr,
+        )
+        return 2
+    latest = history[-1]
+    problems = check_structure(latest)
+    result: dict = {
+        "history": args.history,
+        "records": len(history),
+        "structural_problems": problems,
+    }
+    if not args.structural:
+        result.update(
+            compare(
+                history,
+                window=args.window,
+                tolerance=args.tolerance,
+                noise_mult=args.noise_mult,
+                min_baseline=args.min_baseline,
+            )
+        )
+    payload = json.dumps(result, indent=2, sort_keys=True)
+    if args.report:
+        from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+
+        publish_atomic(args.report, payload.encode())
+    if args.json:
+        print(payload)
+    else:
+        _render(result, latest)
+    if problems:
+        for p in problems:
+            print(f"perfgate: STRUCTURAL: {p}", file=sys.stderr)
+        if args.check:
+            return 2
+    regressions = [
+        k
+        for k, v in result.get("verdicts", {}).items()
+        if v["status"] == "regression"
+    ]
+    if regressions and args.check and not args.structural:
+        print(
+            f"perfgate: REGRESSION beyond tolerance: {', '.join(regressions)}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def _render(result: dict, latest: dict) -> None:
+    print(
+        f"perfgate: {result['records']} record(s) in {result['history']}, "
+        f"latest backend={latest.get('backend')} "
+        f"smoke={bool(latest.get('smoke'))}"
+    )
+    if result["structural_problems"]:
+        print(f"  schema: {len(result['structural_problems'])} problem(s)")
+    else:
+        print("  schema: sound")
+    verdicts = result.get("verdicts")
+    if verdicts is None:
+        return
+    for key, v in verdicts.items():
+        if v["status"] == "no_baseline":
+            print(
+                f"  {key}: no baseline ({v['baseline_runs']} comparable "
+                f"prior run(s)) latest={v['latest']}"
+            )
+        else:
+            print(
+                f"  {key}: {v['status'].upper()} latest={v['latest']} "
+                f"baseline={v['baseline']} delta={v['rel_delta']:+.1%} "
+                f"tol={v['tolerance']:.1%} (cv={v['noise_cv']})"
+            )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -190,6 +190,28 @@ def simulate_batch_sharded(
         shards = mesh_now.shape[DATA_AXIS]
         pad = _pad_batch(n, shards)
         padded = list(scenarios) + [scenarios[-1]] * pad
+        # HBM preflight (telemetry.cost) per mesh attempt: each device
+        # holds (n + pad) / shards scenario lanes, so a degraded mesh's
+        # fatter per-device slice is re-checked before the re-dispatch —
+        # analytic, pre-compile, typed event=preflight_rejected on
+        # reject (a caller error: shrinking further cannot fix it).
+        from yuma_simulation_tpu.telemetry.cost import (
+            estimate_hbm_bytes,
+            preflight_hbm,
+        )
+
+        E_, V_, M_ = np.shape(scenarios[0].weights)
+        preflight_hbm(
+            f"sharded_batch:{shards}dev",
+            estimate_hbm_bytes(
+                V_,
+                M_,
+                resident_epochs=E_,
+                itemsize=jnp.dtype(dtype).itemsize,
+                save_bonds=save_bonds,
+                batch_lanes=(n + pad) // shards,
+            ),
+        )
         W, S, ri, re = stack_scenarios(padded, dtype)
 
         sharding = NamedSharding(mesh_now, P(DATA_AXIS))

@@ -269,6 +269,13 @@ class SweepSupervisor:
     quarantine: bool = True
     elastic: bool = True
     engine: str = "xla"
+    #: Opt-in AOT cost capture (``telemetry.cost``): after the run (the
+    #: flight-bundle publish, failure paths included), lower+compile
+    #: each engine rung at the sweep's shape and append the
+    #: :class:`..telemetry.cost.CostRecord` lines to the bundle's
+    #: ``costs.jsonl``. Off by default — it compiles programs, which an
+    #: unattended production sweep may not want to pay twice.
+    capture_costs: bool = False
 
     def __post_init__(self) -> None:
         if self.unit_size < 1:
@@ -375,6 +382,17 @@ class SweepSupervisor:
                 "num_scenarios": len(scenarios),
                 "unit_size": self.unit_size,
             },
+            cost_request=(
+                dict(
+                    zip(
+                        ("epochs", "V", "M"),
+                        np.shape(scenarios[0].weights),
+                    ),
+                    yuma_version=yuma_version,
+                )
+                if scenarios
+                else None
+            ),
         )
 
     def run_grid(
@@ -426,6 +444,10 @@ class SweepSupervisor:
                 "num_points": num_points,
                 "unit_size": self.unit_size,
             },
+            cost_request=dict(
+                zip(("epochs", "V", "M"), np.shape(scenario.weights)),
+                yuma_version=yuma_version,
+            ),
         )
 
     # -- internals ------------------------------------------------------
@@ -483,6 +505,7 @@ class SweepSupervisor:
         num_lanes: int,
         tag: str,
         config_fingerprint: dict,
+        cost_request: Optional[dict] = None,
     ) -> dict:
         from yuma_simulation_tpu.telemetry import (
             FlightRecorder,
@@ -665,7 +688,8 @@ class SweepSupervisor:
                 # resolvable for obsreport --check.
                 if directory is not None:
                     try:
-                        FlightRecorder(directory).record(
+                        recorder = FlightRecorder(directory)
+                        recorder.record(
                             run, registry=registry, report=report
                         )
                     except Exception:
@@ -674,6 +698,39 @@ class SweepSupervisor:
                             directory,
                             exc_info=True,
                         )
+                    else:
+                        if self.capture_costs and cost_request is not None:
+                            # Opt-in AOT cost capture into costs.jsonl:
+                            # compiles each rung once, so it runs AFTER
+                            # the sweep (warm-path compile budgets are
+                            # unaffected) and rides the same crash-safe
+                            # bundle the report does. Its own guard: a
+                            # capture failure must not be misreported
+                            # as the bundle (spans/ledger/report) having
+                            # failed to publish — by here it published.
+                            try:
+                                from yuma_simulation_tpu.telemetry.cost import (  # noqa: E501
+                                    capture_engine_costs,
+                                )
+
+                                recorder.record_costs(
+                                    capture_engine_costs(
+                                        cost_request["V"],
+                                        cost_request["M"],
+                                        cost_request["epochs"],
+                                        yuma_version=cost_request[
+                                            "yuma_version"
+                                        ],
+                                    ),
+                                    run_id=run.run_id,
+                                )
+                            except Exception:
+                                logger.warning(
+                                    "AOT cost capture failed for %s (the "
+                                    "flight bundle itself published)",
+                                    directory,
+                                    exc_info=True,
+                                )
         return {
             "dividends": dividends,
             "quarantine": quarantine,

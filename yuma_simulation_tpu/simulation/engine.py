@@ -665,6 +665,39 @@ def simulate(
     save_incentives = _resolve_save(
         save_incentives, E_ * M_ * itemsize, "save_incentives"
     )
+    # HBM preflight (telemetry.cost): pure host arithmetic on shapes —
+    # zero compiles, zero allocation — that rejects a dispatch whose
+    # predicted peak footprint cannot fit the device BEFORE XLA starts
+    # the minutes-scale compile that would discover it the hard way.
+    # One typed `event=preflight_rejected` record + HBMPreflightError
+    # (a caller error: the ladder must not retry a shape that
+    # deterministically cannot fit). Unknown-capacity devices (every
+    # CPU build) pass open; YUMA_TPU_PREFLIGHT=0 disables.
+    from yuma_simulation_tpu.telemetry.cost import (
+        estimate_hbm_bytes,
+        preflight_hbm,
+    )
+
+    _miner_shard_count = (
+        1 if mesh is None else int(mesh.shape[mesh.axis_names[-1]])
+    )
+    preflight_hbm(
+        f"simulate:{yuma_version}",
+        estimate_hbm_bytes(
+            V_,
+            M_,
+            resident_epochs=(
+                min(E_, max_resident_epochs)
+                if max_resident_epochs is not None
+                else E_
+            ),
+            itemsize=itemsize,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=save_consensus,
+            miner_shards=_miner_shard_count,
+        ),
+    )
     if max_resident_epochs is not None and E_ > max_resident_epochs:
         if mesh is not None:
             raise ValueError(
@@ -1681,6 +1714,27 @@ def simulate_constant(
     from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
 
     consensus_impl = resolve_consensus_impl(consensus_impl, *W.shape)
+    # HBM preflight (telemetry.cost): analytic, pre-compile. The
+    # constant-weights paths hold no epoch stack — the footprint is the
+    # [V, M] working set (W, carry, intermediates), divided across the
+    # miner shards when a mesh is given. 8192x131072 on a 16 GiB part
+    # rejects HERE with a typed event, not minutes into a remote compile.
+    from yuma_simulation_tpu.telemetry.cost import (
+        estimate_hbm_bytes,
+        preflight_hbm,
+    )
+
+    preflight_hbm(
+        "simulate_constant",
+        estimate_hbm_bytes(
+            *W.shape,
+            resident_epochs=0,
+            itemsize=jnp.dtype(W.dtype).itemsize,
+            miner_shards=(
+                1 if mesh is None else int(mesh.shape[mesh.axis_names[-1]])
+            ),
+        ),
+    )
     if hoist_invariant:
         return _simulate_constant_hoisted(
             W, S, num_epochs, config, spec, consensus_impl, mesh

@@ -3,8 +3,13 @@ telemetry, and the sweep flight recorder.
 
 The observability substrate under the resilience tier (SURVEY.md §5:
 the reference has bare prints; PRs 1-3 added recovery but no identity
-or rates). Four modules:
+or rates). Five modules:
 
+- :mod:`.cost` — the compile-time half: AOT cost/memory capture per
+  engine rung (``cost_analysis``/``memory_analysis`` + HLO
+  fingerprint), the roofline estimator over an overridable
+  :class:`~.cost.DeviceSpec` table, and the analytic HBM preflight the
+  engine/sharding advisors run before every dispatch;
 - :mod:`.runctx` — `RunContext` + nested `span` timers; every
   `log_event` record and `FailureLedger` line is stamped with
   ``run_id``/``span_id``, and `dispatch_annotation` lines Perfetto
@@ -22,6 +27,23 @@ budgets of tests/unit/test_recompilation.py stay at 0) and no reads
 from inside traced code.
 """
 
+from yuma_simulation_tpu.telemetry.cost import (  # noqa: F401
+    DEVICE_SPECS,
+    ENGINE_RUNGS,
+    CostRecord,
+    DeviceSpec,
+    FootprintEstimate,
+    HBMPreflightError,
+    PreflightVerdict,
+    Roofline,
+    capture_compiled,
+    capture_engine_cost,
+    capture_engine_costs,
+    estimate_hbm_bytes,
+    preflight_hbm,
+    resolve_device_spec,
+    roofline,
+)
 from yuma_simulation_tpu.telemetry.device import (  # noqa: F401
     CompileTracker,
     record_device_telemetry,

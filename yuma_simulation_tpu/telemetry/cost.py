@@ -1,0 +1,756 @@
+"""Ahead-of-time cost models: XLA cost/memory capture, rooflines, HBM
+preflight.
+
+PR 4 made the sweep stack observable at runtime (spans, metrics, flight
+recorder); this module is the compile-time half. The ROADMAP north star —
+"as fast as the hardware allows" — is unverifiable without knowing what
+the hardware allows, and XLA already computes the answer at compile time:
+``compiled.cost_analysis()`` (flops, bytes moved, transcendentals) and
+``compiled.memory_analysis()`` (argument/output/temp/peak bytes). Three
+layers on top of that capture:
+
+- :func:`capture_engine_costs` — lower + AOT-compile each engine rung
+  (``fused_scan_mxu`` / ``fused_scan`` / ``xla``) at a given `[E, V, M]`
+  shape from ``jax.ShapeDtypeStruct`` specs (no device allocation) and
+  normalize the analyses into :class:`CostRecord` lines, HLO fingerprint
+  included. Backend-graceful: on CPU the fused Pallas rungs yield an
+  explicit-null record with a ``reason`` instead of pretending the
+  interpret-mode emulation is the chip program.
+- :func:`roofline` — classify a record compute- vs memory-bound against
+  a small overridable :class:`DeviceSpec` table (peak FLOP/s, HBM
+  bandwidth) and predict the epochs/s ceiling the rung should be
+  hitting, so BENCH numbers compare against physics, not vibes.
+- :func:`preflight_hbm` — the ANALYTIC (zero-compile) footprint check
+  the engine/sharding advisors run before every dispatch:
+  :func:`estimate_hbm_bytes` predicts peak resident bytes from shapes
+  alone, and a shape that cannot fit (e.g. 8192x131072 on a 16 GiB
+  part) is rejected with a typed :class:`HBMPreflightError` and one
+  ``event=preflight_rejected`` record BEFORE XLA ever starts the
+  minutes-scale compile that would discover it the hard way.
+
+Cost capture compiles programs by construction, so it is explicit-call
+only (bench, perfgate, obsreport, the supervisor's opt-in) — never on
+the hot path. The preflight IS on the hot path and therefore never
+compiles, traces, or allocates: pure host arithmetic on shapes. The
+zero-warm-repeat budgets of tests/unit/test_recompilation.py stay
+authoritative.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import json
+import logging
+import os
+from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+#: The engine ladder, best rung first — mirrors
+#: :data:`..resilience.retry.ENGINE_LADDER` (kept literal here so cost
+#: capture does not import the resilience tier).
+ENGINE_RUNGS = ("fused_scan_mxu", "fused_scan", "xla")
+
+#: Env var naming a JSON DeviceSpec override, e.g.
+#: ``{"name": "lab-v5e", "peak_flops": 1.97e14,
+#: "hbm_bandwidth": 8.19e11, "memory_bytes": 17179869184}``.
+DEVICE_SPEC_ENV = "YUMA_TPU_DEVICE_SPEC"
+
+#: Env var disabling the HBM preflight ("0"/"off"/"false").
+PREFLIGHT_ENV = "YUMA_TPU_PREFLIGHT"
+
+#: `[V, M]`-sized buffers the engines keep resident beyond the epoch
+#: stack itself: the bonds carry, the prev-weights carry, the normalized
+#: and consensus-clipped weight intermediates, plus XLA temp headroom.
+#: Deliberately a round upper bound — the preflight's job is to reject
+#: what CANNOT fit, not to flatter what barely might.
+WORKING_SET_VM_BUFFERS = 6
+
+#: Fraction of device memory the predicted footprint may claim before
+#: the preflight rejects: XLA's allocator reserves the rest.
+DEFAULT_MEMORY_FRACTION = 0.92
+
+
+# ---------------------------------------------------------------------------
+# Device specs
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """What one device can do: peak FLOP/s (dense matmul, native
+    precision), HBM bandwidth (bytes/s), and usable memory (bytes).
+    ``None`` fields mean "unknown" — every consumer degrades to a
+    null/unknown verdict rather than inventing numbers (the CPU spec is
+    all-None by design)."""
+
+    name: str
+    peak_flops: Optional[float] = None
+    hbm_bandwidth: Optional[float] = None
+    memory_bytes: Optional[int] = None
+
+
+#: device_kind substring (lowercase) -> spec. Public per-chip numbers;
+#: a lab with different parts overrides via ``YUMA_TPU_DEVICE_SPEC`` or
+#: an explicit ``spec=`` argument. Longest key wins, so "v5 lite"
+#: matches before "v5".
+DEVICE_SPECS: dict[str, DeviceSpec] = {
+    "v2": DeviceSpec("TPU v2", 45e12, 700e9, 8 * 2**30),
+    "v3": DeviceSpec("TPU v3", 123e12, 900e9, 16 * 2**30),
+    "v4": DeviceSpec("TPU v4", 275e12, 1228e9, 32 * 2**30),
+    "v5 lite": DeviceSpec("TPU v5e", 197e12, 819e9, 16 * 2**30),
+    "v5litepod": DeviceSpec("TPU v5e", 197e12, 819e9, 16 * 2**30),
+    "v5e": DeviceSpec("TPU v5e", 197e12, 819e9, 16 * 2**30),
+    "v5p": DeviceSpec("TPU v5p", 459e12, 2765e9, 95 * 2**30),
+    "v5": DeviceSpec("TPU v5p", 459e12, 2765e9, 95 * 2**30),
+    "v6 lite": DeviceSpec("TPU v6e", 918e12, 1640e9, 32 * 2**30),
+    "v6e": DeviceSpec("TPU v6e", 918e12, 1640e9, 32 * 2**30),
+    "cpu": DeviceSpec("cpu"),
+}
+
+
+#: Resolved-spec cache keyed on the env override value: the preflight
+#: runs per dispatch and must stay pure host arithmetic — the device
+#: probe (and env JSON parse) happens once per distinct override, not
+#: per call. Device kind and `bytes_limit` are process-invariant.
+_RESOLVED_SPECS: dict[str, DeviceSpec] = {}
+
+
+def resolve_device_spec(override: Optional[DeviceSpec] = None) -> DeviceSpec:
+    """The spec for the current backend: explicit `override` wins, then
+    the :data:`DEVICE_SPEC_ENV` JSON override, then the
+    :data:`DEVICE_SPECS` table keyed on ``device_kind`` (longest
+    matching substring), then the runtime's own ``memory_stats``
+    ``bytes_limit`` as a memory-only spec, then all-None."""
+    if override is not None:
+        return override
+    env = os.environ.get(DEVICE_SPEC_ENV)
+    cached = _RESOLVED_SPECS.get(env or "")
+    if cached is not None:
+        return cached
+    spec = _resolve_device_spec_uncached(env)
+    _RESOLVED_SPECS[env or ""] = spec
+    return spec
+
+
+def _resolve_device_spec_uncached(env: Optional[str]) -> DeviceSpec:
+    if env:
+        try:
+            fields = json.loads(env)
+            return DeviceSpec(
+                name=str(fields.get("name", "env-override")),
+                peak_flops=fields.get("peak_flops"),
+                hbm_bandwidth=fields.get("hbm_bandwidth"),
+                memory_bytes=fields.get("memory_bytes"),
+            )
+        except (ValueError, TypeError):
+            logger.warning(
+                "undecodable %s=%r ignored", DEVICE_SPEC_ENV, env
+            )
+    kind, bytes_limit = _probe_device()
+    if kind:
+        lowered = kind.lower()
+        for key in sorted(DEVICE_SPECS, key=len, reverse=True):
+            if key in lowered:
+                found = DEVICE_SPECS[key]
+                if found.memory_bytes is None and bytes_limit:
+                    return dataclasses.replace(
+                        found, memory_bytes=bytes_limit
+                    )
+                return found
+    if bytes_limit:
+        return DeviceSpec(name=kind or "unknown", memory_bytes=bytes_limit)
+    return DeviceSpec(name=kind or "unknown")
+
+
+def _probe_device() -> tuple[Optional[str], Optional[int]]:
+    """(device_kind, memory_stats bytes_limit) of device 0, best-effort
+    — a backend probe failure degrades to (None, None), never raises."""
+    try:
+        import jax
+
+        device = jax.local_devices()[0]
+        kind = getattr(device, "device_kind", None)
+    except Exception:
+        return None, None
+    try:
+        stats = device.memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        return kind, int(limit) if limit else None
+    except Exception:
+        return kind, None
+
+
+# ---------------------------------------------------------------------------
+# AOT cost capture
+
+
+@dataclasses.dataclass
+class CostRecord:
+    """One engine rung's compile-time cost surface at one shape. Every
+    analysis field is Optional: a null carries a non-null ``reason``
+    (CPU lacking the Pallas rung, a runtime not reporting a field) so a
+    schema gate can tell "unmeasured, and here is why" from "forgot"."""
+
+    engine: str
+    backend: Optional[str]
+    V: int
+    M: int
+    epochs: int
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    transcendentals: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    peak_bytes: Optional[int] = None
+    #: "memory_analysis" when the runtime reported an explicit peak,
+    #: "derived" when peak = arguments + outputs + temps.
+    peak_bytes_source: Optional[str] = None
+    generated_code_bytes: Optional[int] = None
+    hlo_fingerprint: Optional[str] = None
+    #: Why any of the above is null (capture failure, rung unavailable
+    #: on this backend, runtime not reporting the analysis).
+    reason: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @property
+    def flops_per_epoch(self) -> Optional[float]:
+        """`flops / epochs` — a LOWER bound per epoch: XLA's cost
+        analysis counts a scan body once regardless of trip count (see
+        :func:`roofline`'s honesty note)."""
+        if self.flops is None or self.epochs <= 0:
+            return None
+        return self.flops / self.epochs
+
+    @property
+    def bytes_per_epoch(self) -> Optional[float]:
+        """`bytes_accessed / epochs`, same scan-amortization caveat as
+        :attr:`flops_per_epoch`."""
+        if self.bytes_accessed is None or self.epochs <= 0:
+            return None
+        return self.bytes_accessed / self.epochs
+
+
+def _normalize_cost_analysis(analysis) -> dict:
+    """XLA's cost analysis across jax versions: a flat dict (new), a
+    list of per-computation dicts (old), or None. Returns the summed
+    flat dict; only the well-known keys are consumed downstream."""
+    if analysis is None:
+        return {}
+    entries = analysis if isinstance(analysis, (list, tuple)) else [analysis]
+    merged: dict = {}
+    for entry in entries:
+        for key, value in (entry or {}).items():
+            try:
+                merged[key] = merged.get(key, 0.0) + float(value)
+            except (TypeError, ValueError):
+                continue
+    return merged
+
+
+def capture_compiled(
+    lowered, *, engine: str, V: int, M: int, epochs: int
+) -> CostRecord:
+    """Compile a ``jax.stages.Lowered`` and normalize its cost/memory
+    analyses into a :class:`CostRecord`. Partial fields tolerated: a
+    runtime that reports neither analysis still yields the HLO
+    fingerprint, with ``reason`` naming what is missing."""
+    import jax
+
+    record = CostRecord(
+        engine=engine, backend=jax.default_backend(), V=V, M=M, epochs=epochs
+    )
+    try:
+        record.hlo_fingerprint = hashlib.sha256(
+            lowered.as_text().encode()
+        ).hexdigest()[:16]
+    except Exception as e:
+        record.reason = f"as_text failed: {e}"
+    try:
+        compiled = lowered.compile()
+    except Exception as e:
+        record.reason = f"compile failed: {_first_line(e)}"
+        return record
+    missing: list[str] = []
+    try:
+        cost = _normalize_cost_analysis(compiled.cost_analysis())
+    except Exception:
+        cost = {}
+    if cost:
+        record.flops = cost.get("flops")
+        record.bytes_accessed = cost.get("bytes accessed")
+        record.transcendentals = cost.get("transcendentals")
+    if record.flops is None or record.bytes_accessed is None:
+        missing.append("cost_analysis")
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        mem = None
+    if mem is not None:
+        record.argument_bytes = _opt_int(
+            getattr(mem, "argument_size_in_bytes", None)
+        )
+        record.output_bytes = _opt_int(
+            getattr(mem, "output_size_in_bytes", None)
+        )
+        record.temp_bytes = _opt_int(getattr(mem, "temp_size_in_bytes", None))
+        record.generated_code_bytes = _opt_int(
+            getattr(mem, "generated_code_size_in_bytes", None)
+        )
+        explicit_peak = _opt_int(getattr(mem, "peak_memory_in_bytes", None))
+        arg, out, tmp = (
+            record.argument_bytes, record.output_bytes, record.temp_bytes
+        )
+        if explicit_peak:
+            record.peak_bytes = explicit_peak
+            record.peak_bytes_source = "memory_analysis"
+        elif arg is not None and out is not None and tmp is not None:
+            # The static program footprint — what the runtime must hold
+            # simultaneously — when it reports no explicit peak (every
+            # CPU build): arguments + outputs + temps.
+            record.peak_bytes = arg + out + tmp
+            record.peak_bytes_source = "derived"
+    if record.peak_bytes is None:
+        missing.append("memory_analysis")
+    if missing and record.reason is None:
+        record.reason = (
+            f"runtime reported no {' or '.join(missing)} for this program"
+        )
+    return record
+
+
+def _opt_int(value) -> Optional[int]:
+    return None if value is None else int(value)
+
+
+def _first_line(exc: BaseException) -> str:
+    return (str(exc).splitlines() or ["<no message>"])[0][:200]
+
+
+def capture_engine_cost(
+    engine: str,
+    V: int,
+    M: int,
+    epochs: int,
+    *,
+    yuma_version: str = "Yuma 1 (paper)",
+    config=None,
+    dtype=None,
+    save_bonds: bool = False,
+    save_incentives: bool = False,
+) -> CostRecord:
+    """AOT-lower one engine rung at `[epochs, V, M]` from
+    ``ShapeDtypeStruct`` specs (nothing is allocated) and capture its
+    cost surface. The fused Pallas rungs are captured only on TPU — off
+    it they return the explicit-null record with a reason, because the
+    interpret-mode emulation's cost surface is not the chip program's.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.models.config import YumaConfig
+    from yuma_simulation_tpu.models.variants import variant_for_version
+
+    if engine not in ENGINE_RUNGS:
+        raise ValueError(
+            f"unknown engine {engine!r}; expected one of {ENGINE_RUNGS}"
+        )
+    config = config if config is not None else YumaConfig()
+    dtype = jnp.dtype(dtype if dtype is not None else jnp.float32)
+    spec = variant_for_version(yuma_version)
+    backend = jax.default_backend()
+    W = jax.ShapeDtypeStruct((epochs, V, M), dtype)
+    S = jax.ShapeDtypeStruct((epochs, V), dtype)
+    scalar_i32 = jax.ShapeDtypeStruct((), jnp.int32)
+
+    if engine in ("fused_scan", "fused_scan_mxu"):
+        if backend != "tpu":
+            return CostRecord(
+                engine=engine, backend=backend, V=V, M=M, epochs=epochs,
+                reason=(
+                    "fused Pallas rung lowers to the chip program only on "
+                    f"TPU (backend={backend}); interpret-mode cost is not "
+                    "comparable"
+                ),
+            )
+        try:
+            from yuma_simulation_tpu.simulation.engine import (
+                _simulate_case_fused,
+            )
+
+            fn = jax.jit(
+                functools.partial(
+                    _simulate_case_fused,
+                    config=config,
+                    spec=spec,
+                    save_bonds=save_bonds,
+                    save_incentives=save_incentives,
+                    mxu=engine == "fused_scan_mxu",
+                )
+            )
+            lowered = fn.lower(W, S, scalar_i32, scalar_i32)
+        except Exception as e:
+            return CostRecord(
+                engine=engine, backend=backend, V=V, M=M, epochs=epochs,
+                reason=f"lowering failed: {_first_line(e)}",
+            )
+        return capture_compiled(
+            lowered, engine=engine, V=V, M=M, epochs=epochs
+        )
+
+    try:
+        from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
+        from yuma_simulation_tpu.simulation.engine import _simulate_scan
+
+        lowered = _simulate_scan.lower(
+            W,
+            S,
+            scalar_i32,
+            scalar_i32,
+            config,
+            spec,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=False,
+            consensus_impl=resolve_consensus_impl("auto", V, M),
+        )
+    except Exception as e:
+        return CostRecord(
+            engine=engine, backend=backend, V=V, M=M, epochs=epochs,
+            reason=f"lowering failed: {_first_line(e)}",
+        )
+    return capture_compiled(lowered, engine=engine, V=V, M=M, epochs=epochs)
+
+
+def capture_engine_costs(
+    V: int,
+    M: int,
+    epochs: int,
+    *,
+    engines: Sequence[str] = ENGINE_RUNGS,
+    yuma_version: str = "Yuma 1 (paper)",
+    config=None,
+    dtype=None,
+    save_bonds: bool = False,
+    save_incentives: bool = False,
+) -> dict[str, CostRecord]:
+    """One :class:`CostRecord` per engine rung (null-with-reason where a
+    rung is unavailable) — the cost report's payload."""
+    return {
+        engine: capture_engine_cost(
+            engine, V, M, epochs,
+            yuma_version=yuma_version, config=config, dtype=dtype,
+            save_bonds=save_bonds, save_incentives=save_incentives,
+        )
+        for engine in engines
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rooflines
+
+
+@dataclasses.dataclass
+class Roofline:
+    """A rung's position against the device roofline. ``None`` fields
+    mean the spec or the record lacked the inputs (unknown device, null
+    cost capture)."""
+
+    engine: str
+    device: str
+    arithmetic_intensity: Optional[float] = None  # flops / byte
+    ridge_intensity: Optional[float] = None  # peak_flops / bandwidth
+    bound: Optional[str] = None  # "compute" | "memory"
+    predicted_seconds: Optional[float] = None
+    predicted_epochs_per_sec: Optional[float] = None
+    measured_epochs_per_sec: Optional[float] = None
+    #: measured / predicted — the fraction of the roofline actually hit.
+    attained_fraction: Optional[float] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def roofline(
+    record: CostRecord,
+    spec: Optional[DeviceSpec] = None,
+    *,
+    measured_epochs_per_sec: Optional[float] = None,
+) -> Roofline:
+    """Classify `record` compute- vs memory-bound under `spec` and
+    predict the epochs/s ceiling: ``t = max(flops/peak_flops,
+    bytes/bandwidth)`` (the classic roofline time model), epochs/s =
+    epochs / t. With a measured rate, reports the attained fraction of
+    the prediction — the number that says whether a BENCH regression is
+    a software problem or the hardware wall.
+
+    Honesty note: XLA's ``cost_analysis`` amortizes ``lax.scan``/while
+    bodies (the body is counted ONCE regardless of trip count — pinned
+    by tests/unit/test_cost.py), so for scan-shaped programs the
+    prediction is an OPTIMISTIC ceiling, not a forecast. That is still
+    the right tool for both consumers: a ceiling bounds what the rung
+    could ever do, and at a fixed shape the numbers are bitwise
+    commit-to-commit comparable, which is all perfgate needs."""
+    spec = resolve_device_spec(spec)
+    out = Roofline(
+        engine=record.engine,
+        device=spec.name,
+        measured_epochs_per_sec=measured_epochs_per_sec,
+    )
+    if record.flops is not None and record.bytes_accessed:
+        out.arithmetic_intensity = record.flops / record.bytes_accessed
+    if spec.peak_flops and spec.hbm_bandwidth:
+        out.ridge_intensity = spec.peak_flops / spec.hbm_bandwidth
+    if out.arithmetic_intensity is not None and out.ridge_intensity is not None:
+        out.bound = (
+            "compute"
+            if out.arithmetic_intensity >= out.ridge_intensity
+            else "memory"
+        )
+    t_compute = (
+        record.flops / spec.peak_flops
+        if record.flops is not None and spec.peak_flops
+        else None
+    )
+    t_memory = (
+        record.bytes_accessed / spec.hbm_bandwidth
+        if record.bytes_accessed is not None and spec.hbm_bandwidth
+        else None
+    )
+    candidates = [t for t in (t_compute, t_memory) if t is not None]
+    if candidates:
+        out.predicted_seconds = max(candidates)
+        if out.predicted_seconds > 0 and record.epochs > 0:
+            out.predicted_epochs_per_sec = (
+                record.epochs / out.predicted_seconds
+            )
+    if (
+        measured_epochs_per_sec is not None
+        and out.predicted_epochs_per_sec
+    ):
+        out.attained_fraction = (
+            measured_epochs_per_sec / out.predicted_epochs_per_sec
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HBM preflight (analytic — zero compiles, zero allocation)
+
+
+class HBMPreflightError(ValueError):
+    """The predicted peak HBM footprint exceeds the device capacity —
+    the dispatch was rejected BEFORE compilation. A ``ValueError``
+    deliberately: :func:`..resilience.errors.classify_failure` treats it
+    as a caller error, so the engine ladder never burns retries on a
+    shape that deterministically cannot fit (re-shape, shard, or stream
+    instead — the message says which would fit)."""
+
+    def __init__(
+        self,
+        message: str,
+        verdict: Optional["PreflightVerdict"] = None,
+    ):
+        super().__init__(message)
+        self.verdict = verdict
+
+
+@dataclasses.dataclass
+class FootprintEstimate:
+    """Predicted peak resident bytes for one dispatch, per device, with
+    the per-term breakdown (bytes)."""
+
+    total_bytes: int
+    breakdown: dict
+    V: int
+    M: int
+    resident_epochs: int
+    miner_shards: int
+    batch_lanes: int
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def estimate_hbm_bytes(
+    V: int,
+    M: int,
+    *,
+    resident_epochs: int = 0,
+    itemsize: int = 4,
+    save_bonds: bool = False,
+    save_incentives: bool = False,
+    save_consensus: bool = False,
+    miner_shards: int = 1,
+    batch_lanes: int = 1,
+) -> FootprintEstimate:
+    """Predict one dispatch's peak resident bytes PER DEVICE from shapes
+    alone. `resident_epochs` is the epoch-stack length materialized on
+    device (0 for the constant-weights paths, the chunk length under
+    streaming, E for monolithic `simulate`); `miner_shards` divides
+    every miner-axis buffer (the `[V, M]` working set and the `[*, M]`
+    streams), `batch_lanes` multiplies everything (scenario-batched
+    dispatches where each device holds `batch_lanes` lanes).
+
+    Deliberately an upper-bound model: the epoch stack + saved output
+    streams exactly, plus :data:`WORKING_SET_VM_BUFFERS` `[V, M]`
+    buffers for the carry/intermediates/XLA temps. It exists to reject
+    what cannot fit, not to certify what barely might.
+    """
+    ms = max(1, int(miner_shards))
+    lanes = max(1, int(batch_lanes))
+    m_local = -(-int(M) // ms)  # ceil: the widest shard pays the bill
+    vm = int(V) * m_local * itemsize
+    breakdown = {
+        "weights_stack": resident_epochs * vm,
+        "stakes_stack": resident_epochs * int(V) * itemsize,
+        "working_set": WORKING_SET_VM_BUFFERS * vm,
+        "dividends_out": resident_epochs * int(V) * itemsize,
+        "bonds_out": resident_epochs * vm if save_bonds else 0,
+        "incentives_out": (
+            resident_epochs * m_local * itemsize if save_incentives else 0
+        ),
+        "consensus_out": (
+            resident_epochs * m_local * itemsize if save_consensus else 0
+        ),
+    }
+    breakdown = {k: int(v) * lanes for k, v in breakdown.items()}
+    return FootprintEstimate(
+        total_bytes=sum(breakdown.values()),
+        breakdown=breakdown,
+        V=int(V),
+        M=int(M),
+        resident_epochs=int(resident_epochs),
+        miner_shards=ms,
+        batch_lanes=lanes,
+    )
+
+
+@dataclasses.dataclass
+class PreflightVerdict:
+    """One preflight decision. ``fits`` is None when the device capacity
+    is unknown (every CPU build without an override) — the preflight
+    passes open rather than guessing."""
+
+    label: str
+    fits: Optional[bool]
+    predicted_bytes: int
+    capacity_bytes: Optional[int]
+    fraction: float
+    device: str
+    suggestion: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def preflight_enabled() -> bool:
+    return os.environ.get(PREFLIGHT_ENV, "1").lower() not in (
+        "0", "off", "false",
+    )
+
+
+def preflight_hbm(
+    label: str,
+    estimate: FootprintEstimate,
+    *,
+    spec: Optional[DeviceSpec] = None,
+    fraction: float = DEFAULT_MEMORY_FRACTION,
+    raise_on_reject: bool = True,
+) -> PreflightVerdict:
+    """The advisor check: predicted peak bytes vs usable device memory.
+
+    A shape that fits (or an unknown-capacity device) returns the
+    verdict silently. A shape that cannot fit emits exactly one typed
+    ``event=preflight_rejected`` record — label, predicted vs capacity,
+    shard/stream suggestion — and raises :class:`HBMPreflightError`
+    (suppress with ``raise_on_reject=False`` to get the verdict back
+    for advisory flows). Disabled globally via ``YUMA_TPU_PREFLIGHT=0``.
+    """
+    spec = resolve_device_spec(spec)
+    if not preflight_enabled() or not spec.memory_bytes:
+        return PreflightVerdict(
+            label=label,
+            fits=None,
+            predicted_bytes=estimate.total_bytes,
+            capacity_bytes=spec.memory_bytes,
+            fraction=fraction,
+            device=spec.name,
+        )
+    budget = int(spec.memory_bytes * fraction)
+    if estimate.total_bytes <= budget:
+        return PreflightVerdict(
+            label=label,
+            fits=True,
+            predicted_bytes=estimate.total_bytes,
+            capacity_bytes=spec.memory_bytes,
+            fraction=fraction,
+            device=spec.name,
+        )
+    verdict = PreflightVerdict(
+        label=label,
+        fits=False,
+        predicted_bytes=estimate.total_bytes,
+        capacity_bytes=spec.memory_bytes,
+        fraction=fraction,
+        device=spec.name,
+        suggestion=_suggest(estimate, budget),
+    )
+    from yuma_simulation_tpu.utils.logging import log_event
+
+    log_event(
+        logger,
+        "preflight_rejected",
+        label=label,
+        V=estimate.V,
+        M=estimate.M,
+        resident_epochs=estimate.resident_epochs,
+        miner_shards=estimate.miner_shards,
+        batch_lanes=estimate.batch_lanes,
+        predicted_gib=round(estimate.total_bytes / 2**30, 2),
+        capacity_gib=round(spec.memory_bytes / 2**30, 2),
+        device=spec.name,
+        suggestion=verdict.suggestion or "",
+    )
+    if raise_on_reject:
+        raise HBMPreflightError(
+            f"{label}: predicted peak HBM "
+            f"{estimate.total_bytes / 2**30:.2f} GiB exceeds "
+            f"{fraction:.0%} of {spec.name} capacity "
+            f"({spec.memory_bytes / 2**30:.2f} GiB) for shape "
+            f"V={estimate.V} M={estimate.M} "
+            f"resident_epochs={estimate.resident_epochs}. "
+            f"{verdict.suggestion or ''}".rstrip(),
+            verdict,
+        )
+    return verdict
+
+
+def _suggest(estimate: FootprintEstimate, budget: int) -> Optional[str]:
+    """An actionable way out: the max_resident_epochs chunk length that
+    would fit (when the epoch stack dominates), else the miner-shard
+    count that would (when the working set does)."""
+    per_epoch = sum(
+        v // max(1, estimate.resident_epochs)
+        for k, v in estimate.breakdown.items()
+        if k.endswith("_stack") or k.endswith("_out")
+    ) if estimate.resident_epochs else 0
+    fixed = estimate.breakdown["working_set"]
+    if per_epoch and fixed < budget:
+        chunk = (budget - fixed) // per_epoch
+        if chunk >= 1:
+            return (
+                f"stream with max_resident_epochs<={chunk} or shard the "
+                "miner axis"
+            )
+    if fixed > budget:
+        shards = -(-fixed * estimate.miner_shards // budget)
+        return (
+            f"shard the miner axis over >= {shards} devices (or reduce "
+            "V x M)"
+        )
+    return "shard the miner axis or reduce the resident epoch stack"

@@ -13,6 +13,9 @@ Bundle layout (inside the supervisor's checkpoint `directory`):
 - ``spans.jsonl``   — every closed span of every run, close order
   (appended per run, atomic whole-file republish);
 - ``metrics.jsonl`` — one registry snapshot line per run;
+- ``costs.jsonl``   — AOT cost records (:class:`..cost.CostRecord`
+  lines, run-stamped) when anything captured them — the supervisor's
+  opt-in, bench, or an operator's explicit capture;
 - ``report.json``   — the LAST run's :class:`SweepHealthReport` (plus
   its ``run_id``), for the ledger<->report cross-check.
 
@@ -42,6 +45,7 @@ logger = logging.getLogger(__name__)
 LEDGER_NAME = "ledger.jsonl"
 SPANS_NAME = "spans.jsonl"
 METRICS_NAME = "metrics.jsonl"
+COSTS_NAME = "costs.jsonl"
 REPORT_NAME = "report.json"
 
 #: The SweepHealthReport action counts the ledger must reproduce exactly
@@ -117,6 +121,41 @@ class FlightRecorder:
                 ).encode(),
             )
 
+    def record_costs(self, records, *, run_id: Optional[str] = None) -> None:
+        """Append AOT cost records (``CostRecord`` instances or their
+        ``to_json`` dicts) to ``costs.jsonl``, each stamped with
+        `run_id`. Merged by ``(run_id, engine, V, M, epochs)``, newest
+        wins — a resumed run's re-capture replaces its prior line
+        instead of duplicating it; distinct shapes/runs accumulate."""
+        from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+
+        lines = []
+        for rec in (
+            records.values() if isinstance(records, dict) else records
+        ):
+            line = rec.to_json() if hasattr(rec, "to_json") else dict(rec)
+            if run_id is not None:
+                line["run_id"] = run_id
+            lines.append(line)
+        path = self.directory / COSTS_NAME
+        merged: dict[tuple, dict] = {}
+        for rec in _read_jsonl(path) + lines:
+            merged[
+                (
+                    rec.get("run_id"),
+                    rec.get("engine"),
+                    rec.get("V"),
+                    rec.get("M"),
+                    rec.get("epochs"),
+                )
+            ] = rec
+        publish_atomic(
+            path,
+            "".join(
+                json.dumps(r, sort_keys=True) + "\n" for r in merged.values()
+            ).encode(),
+        )
+
 
 @dataclasses.dataclass
 class Bundle:
@@ -127,6 +166,7 @@ class Bundle:
     metrics: list
     ledger: list
     report: Optional[dict] = None
+    costs: list = dataclasses.field(default_factory=list)
 
     def run_ids(self) -> list[str]:
         """Distinct run ids, first-seen order (spans then ledger)."""
@@ -157,6 +197,7 @@ def load_bundle(directory: Union[str, pathlib.Path]) -> Bundle:
         metrics=_read_jsonl(directory / METRICS_NAME),
         ledger=_read_jsonl(directory / LEDGER_NAME),
         report=report,
+        costs=_read_jsonl(directory / COSTS_NAME),
     )
 
 
@@ -200,9 +241,22 @@ def check_bundle(bundle: Bundle) -> list[str]:
       a recorded span of that run (the obsreport ``--check`` gate);
     - every span's ``parent_id`` must resolve within its run;
     - when ``report.json`` is present, its action counts must match the
-      ledger-derived counts exactly (:data:`CROSS_CHECKED_COUNTS`).
+      ledger-derived counts exactly (:data:`CROSS_CHECKED_COUNTS`);
+    - every ``costs.jsonl`` record must name its engine, and a null
+      analysis field must carry a ``reason`` (the explicit-null
+      contract of :class:`..cost.CostRecord`).
     """
     problems: list[str] = []
+    for i, rec in enumerate(bundle.costs):
+        if not rec.get("engine"):
+            problems.append(f"costs[{i}] names no engine")
+            continue
+        for field in ("flops", "bytes_accessed", "peak_bytes"):
+            if field in rec and rec[field] is None and not rec.get("reason"):
+                problems.append(
+                    f"costs[{i}] engine={rec['engine']} has null {field} "
+                    "with no reason"
+                )
     spans_by_run: dict[str, set] = {}
     for s in bundle.spans:
         spans_by_run.setdefault(s.get("run_id", ""), set()).add(

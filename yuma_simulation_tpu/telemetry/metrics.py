@@ -21,6 +21,9 @@ Well-known series (incremented at their SOURCE, exactly once):
 ``epochs_total``        simulated epochs (lanes x E), from the epoch-rate
                         reporters (`utils.profiling.timed`, the supervisor)
 ``epochs_per_sec``      gauge, last observed rate (`event=epoch_rate` twin)
+``epochs_per_sec_cv``   gauge, timing dispersion (CV) of the last rate
+``compile_seconds``     histogram, wall seconds of sentinel regions that
+                        added jit-cache entries (`utils.profiling`)
 ``engine_demotions``    ladder demotions (`resilience.retry.run_ladder`)
 ``engine_retries``      same-rung retries (`resilience.retry.run_ladder`)
 ``stalls_killed``       watchdog deadline kills (`resilience.watchdog`)
@@ -298,6 +301,7 @@ def record_epoch_rate(
     epochs: Optional[int] = None,
     seconds: Optional[float] = None,
     epochs_per_sec: Optional[float] = None,
+    cv: Optional[float] = None,
     registry: Optional[MetricsRegistry] = None,
     logger_: Optional[logging.Logger] = None,
 ) -> Optional[float]:
@@ -305,6 +309,10 @@ def record_epoch_rate(
     supervisor): feeds ``epochs_total``/``epochs_per_sec`` in the
     registry and emits exactly one ``event=epoch_rate`` record. Pass
     either a precomputed `epochs_per_sec` or `epochs` + `seconds`.
+    `cv` (timing dispersion across repeats, from
+    :func:`..utils.timing.time_best`) rides the record and the
+    ``epochs_per_sec_cv`` gauge so downstream regression gates
+    (`tools/perfgate.py`) can widen tolerance on noisy measurements.
     Returns the rate (None when it cannot be derived)."""
     from yuma_simulation_tpu.utils.logging import log_event
 
@@ -319,6 +327,11 @@ def record_epoch_rate(
         reg.gauge(
             "epochs_per_sec", help="last observed simulated epochs/sec"
         ).set(epochs_per_sec)
+    if cv is not None:
+        reg.gauge(
+            "epochs_per_sec_cv",
+            help="timing dispersion (CV across repeats) of the last rate",
+        ).set(cv)
     log_event(
         logger_ if logger_ is not None else logger,
         "epoch_rate",
@@ -329,5 +342,6 @@ def record_epoch_rate(
         epochs_per_sec=(
             "" if epochs_per_sec is None else f"{epochs_per_sec:.1f}"
         ),
+        cv="" if cv is None else f"{cv:.4f}",
     )
     return epochs_per_sec

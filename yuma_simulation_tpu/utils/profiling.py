@@ -133,11 +133,13 @@ class RecompilationSentinel:
 
     def __enter__(self) -> "RecompilationSentinel":
         self._before = [fn._cache_size() for fn in self._functions]
+        self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
         if exc_type is not None:
             return  # don't mask the region's own failure
+        wall_seconds = time.perf_counter() - self._t0
         after = [fn._cache_size() for fn in self._functions]
         self.report = {}
         for fn, b, a in zip(self._functions, self._before, after):
@@ -155,13 +157,25 @@ class RecompilationSentinel:
             # Observability side-channel: every new entry a sentinel
             # region observes lands on the process `recompiles` counter
             # (budget-busting ones included — the raise below must not
-            # hide them from the metrics snapshot).
+            # hide them from the metrics snapshot), and the region's
+            # wall time lands on the `compile_seconds` histogram — an
+            # upper bound on the compile cost (the region may also have
+            # dispatched), which is what makes a SLOW-compile regression
+            # visible in the snapshot, not just the cache-miss count.
             try:
                 from yuma_simulation_tpu.telemetry.metrics import get_registry
 
-                get_registry().counter(
+                registry = get_registry()
+                registry.counter(
                     "recompiles", help="new jit-cache entries observed"
                 ).inc(self.new_entries)
+                registry.histogram(
+                    "compile_seconds",
+                    help=(
+                        "wall seconds of sentinel regions that added "
+                        "jit-cache entries (compile-time upper bound)"
+                    ),
+                ).observe(wall_seconds)
             except Exception:
                 pass
         if self.new_entries > self.budget:

@@ -30,9 +30,13 @@ def time_best(
     granularity: int = 1,
     target_seconds: float = DEFAULT_TARGET_SECONDS,
     reps: int = DEFAULT_REPS,
-) -> tuple[float, int, list[float]]:
+) -> tuple[float, int, list[float], float]:
     """Time `run(n)` (which returns a device value; the fetch is forced
-    here) and return `(rate, n_timed, times_s)` where `rate = n / best`.
+    here) and return `(rate, n_timed, times_s, cv)` where
+    `rate = n / best` and `cv` is the coefficient of variation
+    (population stdev / mean) across the timed repeats — the dispersion
+    `tools/perfgate.py` uses to widen its regression tolerance on noisy
+    metrics instead of false-failing (0.0 when `reps == 1`).
 
     `granularity` rounds grown counts down to a multiple the runner can
     actually execute (e.g. whole passes of a fixed-length inner scan, or
@@ -75,4 +79,8 @@ def time_best(
         t0 = time.perf_counter()
         np.asarray(run(n))
         times.append(time.perf_counter() - t0)
-    return n / min(times), n, [round(t, 3) for t in times]
+    mean = sum(times) / len(times)
+    cv = (
+        float(np.std(times) / mean) if len(times) > 1 and mean > 0 else 0.0
+    )
+    return n / min(times), n, [round(t, 3) for t in times], round(cv, 4)
