@@ -312,11 +312,21 @@ def _history_record(value, cv=0.02, smoke=False, backend="cpu", t=0.0,
         }
         for engine in ENGINE_RUNGS
     }
+    # The 0.10.0 schema: the three per-epoch-weights lines are
+    # first-class tracked metrics, and every record declares its
+    # attained-fraction floors.
+    tracked = {
+        "true_weights_xla": value / 10,
+        "streamed_true_weights": value / 8,
+        "montecarlo_per_epoch_weights": value / 9,
+    }
+    tracked.update(secondary or {})
     record = {
         "t": t, "backend": backend, "smoke": smoke, "jax": "x",
         "metric": "epochs/sec", "value": value, "unit": "epochs/s",
-        "secondary": dict(secondary or {}),
+        "secondary": tracked,
         "cv": {"primary": cv}, "costs": costs, "rooflines": {},
+        "attained_floor": {"xla": 0.001},
     }
     record.update(overrides)
     return record
@@ -418,6 +428,82 @@ def test_perfgate_structural_gate(tmp_path):
     empty = tmp_path / "empty.jsonl"
     empty.write_text("")
     assert main(["--history", str(empty), "--check"]) == 2
+
+
+def test_perfgate_tracked_secondary_is_structural(tmp_path):
+    """ISSUE 6 satellite: the three per-epoch-weights lines are
+    first-class gated metrics — a record that drops one (or ships a
+    non-numeric value) is schema rot, exactly like a missing cost
+    rung."""
+    from tools.perfgate import TRACKED_SECONDARY, check_structure, main
+
+    for name in TRACKED_SECONDARY:
+        record = _history_record(100.0)
+        del record["secondary"][name]
+        assert any(name in p for p in check_structure(record)), name
+        record = _history_record(100.0)
+        record["secondary"][name] = "fast"
+        assert any(name in p for p in check_structure(record)), name
+    missing_floor = _history_record(100.0)
+    del missing_floor["attained_floor"]
+    assert any("attained_floor" in p for p in check_structure(missing_floor))
+    path = _write_history(tmp_path, [_history_record(100.0)])
+    assert main(["--history", path, "--check", "--structural"]) == 0
+
+
+def test_perfgate_attained_fraction_gate(tmp_path, capsys):
+    """ISSUE 6 tentpole (c): a rung whose measured rate drops below its
+    declared fraction of the roofline prediction fails --check — in
+    structural mode too — while null fractions (every CPU build) pass
+    vacuously and CLI floors override the record's declaration."""
+    from tools.perfgate import check_attained, main
+
+    def with_attained(frac, floor=0.25):
+        record = _history_record(100.0)
+        record["rooflines"] = {
+            "xla": {"engine": "xla", "attained_fraction": frac},
+            "fused_scan": {"engine": "fused_scan",
+                           "attained_fraction": None},
+        }
+        record["attained_floor"] = {"xla": floor}
+        return record
+
+    assert check_attained(with_attained(0.5)) == []
+    failures = check_attained(with_attained(0.1))
+    assert len(failures) == 1 and "xla" in failures[0]
+    # Null fractions never fail; un-floored rungs never fail.
+    assert check_attained(with_attained(None)) == []
+    # CLI override beats the record's declaration.
+    assert check_attained(with_attained(0.5), {"xla": 0.9})
+    path = _write_history(tmp_path, [with_attained(0.1)])
+    assert main(["--history", path, "--check", "--structural"]) == 1
+    assert main(["--history", path, "--check"]) == 1
+    # Report-only never gates; a passing floor exits 0.
+    assert main(["--history", path]) == 0
+    ok = _write_history(tmp_path, [with_attained(0.5)])
+    assert main(["--history", ok, "--check", "--structural"]) == 0
+    # The override can fail a record its own declaration passes.
+    assert main(
+        ["--history", ok, "--check", "--attained-floor", "xla=0.9"]
+    ) == 1
+    capsys.readouterr()
+
+
+def test_perfgate_attained_fraction_rides_baseline_diff():
+    """The distance-to-ceiling is also a baselined metric: a drop in
+    attained fraction regresses even when no floor is declared."""
+    from tools.perfgate import compare
+
+    def rec(frac, t):
+        record = _history_record(100.0, t=t)
+        record["rooflines"] = {
+            "xla": {"engine": "xla", "attained_fraction": frac}
+        }
+        return record
+
+    history = [rec(0.5, t=i) for i in range(5)] + [rec(0.2, t=9)]
+    verdict = compare(history)["verdicts"]["attained:xla"]
+    assert verdict["status"] == "regression"
 
 
 def test_perfgate_report_artifact(tmp_path):

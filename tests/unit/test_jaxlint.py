@@ -195,6 +195,32 @@ def run(xs, step, z):
     return out
 """,
     ),
+    "JX009": (
+        # device_put inside a jit scope — incl. a scan body nested in
+        # one — is never the async host->HBM transfer the caller meant.
+        """
+import jax
+from functools import partial
+from jax import lax
+
+@partial(jax.jit, static_argnames=("sharding",))
+def run(W, xs, sharding):
+    W = jax.device_put(W, sharding)
+    def step(carry, x):
+        return carry + jax.device_put(x), None
+    out, _ = lax.scan(step, W.sum(), xs)
+    return out
+""",
+        # host-level staging (the double-buffered streaming driver's
+        # pattern) is exactly what the rule steers toward
+        """
+import jax
+
+def stage(chunk, dispatch):
+    staged = jax.device_put(chunk)
+    return dispatch(staged)
+""",
+    ),
 }
 
 #: rules whose scope is path-filtered

@@ -1,0 +1,513 @@
+"""DispatchPlan (ISSUE 6 tentpole): one decision surface for engine
+rung, consensus, ladder, shape bucket and memory plan — plan-driven
+dispatch must match the legacy per-caller resolution exactly, plans
+must be deterministic pure values, donor packing and the chunked
+Monte-Carlo must be bitwise-invariant to how the planner slices them,
+and the streamed slab cap must not change results.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.models.config import YumaConfig, YumaParams
+from yuma_simulation_tpu.models.variants import variant_for_version
+from yuma_simulation_tpu.simulation.planner import (
+    ENGINE_LADDER,
+    LANE_TILE,
+    SUBLANE_TILE,
+    bucket_shape,
+    ladder_from,
+    plan_dispatch,
+    resolve_montecarlo_engine,
+    resolve_scaled_engine,
+)
+
+from tests.conftest import HAS_JAX_SHARD_MAP
+
+VERSION = "Yuma 1 (paper)"
+CFG = YumaConfig()
+
+
+# ---------------------------------------------------------------------------
+# plan determinism + shape
+
+
+def test_plan_is_deterministic_and_frozen():
+    args = ("t", (40, 3, 2), VERSION, CFG, jnp.float32)
+    a = plan_dispatch(*args)
+    b = plan_dispatch(*args)
+    assert a == b
+    assert json.dumps(a.to_json(), sort_keys=True) == json.dumps(
+        b.to_json(), sort_keys=True
+    )
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        a.engine = "xla"  # type: ignore[misc]
+
+
+def test_plan_determinism_property():
+    """Property sweep: equal inputs -> equal plans across a matrix of
+    shapes, versions and knobs (the planner is a pure host function)."""
+    for shape in [(1, 3, 2), (40, 6, 18), (5, 256, 300), (4, 10, 8, 16)]:
+        for version in (VERSION, "Yuma 2 (Adrian-Fish)"):
+            for save_bonds in (False, True):
+                kwargs = dict(save_bonds=save_bonds, streaming=True)
+                a = plan_dispatch(
+                    "p", shape, version, CFG, jnp.float32, **kwargs
+                )
+                b = plan_dispatch(
+                    "p", shape, version, CFG, jnp.float32, **kwargs
+                )
+                assert a == b, (shape, version, save_bonds)
+
+
+def test_plan_bad_shape_rejected():
+    with pytest.raises(ValueError, match="E, V, M"):
+        plan_dispatch("t", (3, 2), VERSION, CFG, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# engine resolution (the legacy `_resolve_case_engine` contract)
+
+
+def test_auto_resolves_to_xla_off_tpu():
+    plan = plan_dispatch("t", (10, 6, 18), VERSION, CFG, jnp.float32)
+    if jax.default_backend() == "tpu":
+        assert plan.engine in ("fused_scan_mxu", "fused_scan")
+    else:
+        assert plan.engine == "xla"
+        assert plan.consensus_impl in ("sorted", "bisect")
+    assert plan.ladder == ladder_from(plan.engine)
+
+
+def test_explicit_fused_preconditions_raise():
+    from yuma_simulation_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="bisection"):
+        plan_dispatch(
+            "t", (10, 6, 18), VERSION, CFG, jnp.float32,
+            epoch_impl="fused_scan", consensus_impl="sorted",
+        )
+    with pytest.raises(ValueError, match="single-core"):
+        plan_dispatch(
+            "t", (10, 6, 18), VERSION, CFG, jnp.float32,
+            epoch_impl="fused_scan", mesh=make_mesh(),
+        )
+    with pytest.raises(ValueError, match="quarantine"):
+        plan_dispatch(
+            "t", (2, 10, 6, 18), VERSION, CFG, jnp.float32,
+            epoch_impl="fused_scan", quarantine=True,
+        )
+    with pytest.raises(ValueError, match="miner"):
+        plan_dispatch(
+            "t", (2, 10, 6, 18), VERSION, CFG, jnp.float32,
+            epoch_impl="fused_scan", has_miner_mask=True,
+        )
+    with pytest.raises(ValueError, match="unknown epoch_impl"):
+        plan_dispatch(
+            "t", (10, 6, 18), VERSION, CFG, jnp.float32,
+            epoch_impl="warp",
+        )
+    with pytest.raises(ValueError, match="unknown consensus_impl"):
+        plan_dispatch(
+            "t", (10, 6, 18), VERSION, CFG, jnp.float32,
+            consensus_impl="median",
+        )
+
+
+def test_auto_forced_to_xla_by_guards():
+    for kwargs in (
+        dict(quarantine=True),
+        dict(has_miner_mask=True),
+        dict(consensus_impl="sorted"),
+    ):
+        plan = plan_dispatch(
+            "t", (2, 10, 6, 18), VERSION, CFG, jnp.float32, **kwargs
+        )
+        assert plan.engine == "xla", kwargs
+        assert any("auto->xla" in r for r in plan.reasons)
+
+
+def test_fallback_consensus_matches_direct_xla_resolution():
+    """A demotion off a fused rung must use exactly the consensus a
+    direct XLA request would have resolved to."""
+    direct = plan_dispatch(
+        "t", (10, 6, 18), VERSION, CFG, jnp.float32, epoch_impl="xla",
+        consensus_impl="auto",
+    )
+    fused = plan_dispatch(
+        "t", (10, 6, 18), VERSION, CFG, jnp.float32,
+        epoch_impl="fused_scan", consensus_impl="auto",
+    )
+    assert fused.fallback_consensus == direct.consensus_impl
+
+
+def test_ladder_ownership_shared_with_resilience():
+    """retry.py re-exports the planner's ladder — one owner for rung
+    ordering AND eligibility."""
+    from yuma_simulation_tpu.resilience import retry
+
+    assert retry.ENGINE_LADDER is ENGINE_LADDER
+    assert retry.ladder_from is ladder_from
+    assert ladder_from("fused_scan_mxu") == ENGINE_LADDER
+    assert ladder_from("hoisted") == ("hoisted",)
+
+
+def test_throughput_resolutions():
+    spec = variant_for_version(VERSION)
+    got = resolve_scaled_engine(
+        (6, 18), spec.bonds_mode, CFG, jnp.float32, 10
+    )
+    if jax.default_backend() == "tpu":
+        assert got in ("fused_scan_mxu", "fused_scan")
+    else:
+        assert got == "xla"
+    assert resolve_montecarlo_engine("auto", varying=True) == "xla"
+    assert resolve_montecarlo_engine("auto", varying=False) == "hoisted"
+    with pytest.raises(ValueError, match="hoistable"):
+        resolve_montecarlo_engine("hoisted", varying=True)
+    with pytest.raises(ValueError, match="unknown epoch_impl"):
+        resolve_montecarlo_engine("sorted", varying=False)
+
+
+# ---------------------------------------------------------------------------
+# shape bucket / donor packing
+
+
+def test_bucket_policy_tile_aligns():
+    b = bucket_shape(3, 2, epochs=40, batch=14)
+    assert (b.padded_V, b.padded_M) == (SUBLANE_TILE, LANE_TILE)
+    assert b.key == "b14e40v8m128"
+    # already-aligned shapes are their own bucket
+    b2 = bucket_shape(256, 4096)
+    assert (b2.padded_V, b2.padded_M) == (256, 4096)
+    # suites in the same bucket share a compiled-shape key
+    assert bucket_shape(5, 7, epochs=40).key == bucket_shape(
+        3, 2, epochs=40
+    ).key
+
+
+def test_pack_scenarios_fills_the_tile():
+    from yuma_simulation_tpu.scenarios import create_case
+    from yuma_simulation_tpu.simulation.sweep import pack_scenarios
+
+    cases = [create_case("Case 1"), create_case("Case 2")]  # 40e x 3v x 2m
+    W, S, ri, re, mask = pack_scenarios(cases)
+    assert W.shape == (2, 40, SUBLANE_TILE, LANE_TILE)
+    assert S.shape == (2, 40, SUBLANE_TILE)
+    np.testing.assert_array_equal(np.asarray(mask[0][:3]), [1.0, 1.0, 0.0])
+    assert float(np.asarray(mask).sum()) == 2 * 2  # 2 real miners per case
+
+
+def test_donor_packed_lanes_bitwise_match_per_case_dispatch():
+    """ISSUE 6 acceptance: donor-packed vs per-case dispatch, bitwise.
+    Each scenario dispatched ALONE through the same bucket must produce
+    bit-for-bit the lane the packed batch produced — packing a suite
+    together changes nothing but the batch axis."""
+    from yuma_simulation_tpu.models.variants import variant_for_version
+    from yuma_simulation_tpu.scenarios import create_case
+    from yuma_simulation_tpu.scenarios.synthetic import (
+        random_subnet_scenario,
+    )
+    from yuma_simulation_tpu.simulation.sweep import (
+        pack_scenarios,
+        simulate_batch,
+    )
+
+    suite = [
+        create_case("Case 1"),
+        random_subnet_scenario(
+            1, num_validators=5, num_miners=7, num_epochs=40
+        ),
+        create_case("Case 4"),  # reset case
+    ]
+    spec = variant_for_version(VERSION)
+    W, S, ri, re, mask = pack_scenarios(suite)
+    packed = simulate_batch(
+        W, S, ri, re, CFG, spec, miner_mask=mask, epoch_impl="xla"
+    )
+    for i in range(len(suite)):
+        solo = simulate_batch(
+            W[i : i + 1],
+            S[i : i + 1],
+            ri[i : i + 1],
+            re[i : i + 1],
+            CFG,
+            spec,
+            miner_mask=mask[i : i + 1],
+            epoch_impl="xla",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(packed["dividends"][i]),
+            np.asarray(solo["dividends"][0]),
+            err_msg=f"lane {i}",
+        )
+
+
+def test_donor_packed_totals_match_unpacked_simulate():
+    """Packing is inert per lane: totals through the packed batch agree
+    with each scenario simulated raw (same tolerance discipline as
+    test_padding — tile padding rides the identical mask mechanism)."""
+    from yuma_simulation_tpu.scenarios import create_case
+    from yuma_simulation_tpu.scenarios.synthetic import (
+        random_subnet_scenario,
+    )
+    from yuma_simulation_tpu.simulation.engine import simulate
+    from yuma_simulation_tpu.simulation.sweep import total_dividends_batch
+
+    suite = [
+        create_case("Case 1"),
+        create_case("Case 2"),
+        # heterogeneous member forces the packed (masked) route
+        random_subnet_scenario(
+            7, num_validators=5, num_miners=7, num_epochs=40
+        ),
+    ]
+    totals = total_dividends_batch(suite, VERSION)
+    assert totals.shape[1] == SUBLANE_TILE  # the packed bucket's V
+    for i, s in enumerate(suite):
+        solo = simulate(
+            s, VERSION, save_bonds=False, save_incentives=False
+        ).dividends.sum(axis=0)
+        v = len(s.validators)
+        np.testing.assert_allclose(
+            totals[i, :v], solo, rtol=2e-5, atol=2e-6, err_msg=f"lane {i}"
+        )
+        assert float(np.abs(totals[i, v:]).max()) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# memory plan / streamed slab cap
+
+SMALL_SPEC = json.dumps(
+    {"name": "tiny-dev", "memory_bytes": 300 * 1024 * 1024}
+)
+
+
+def test_memory_plan_monolithic_fit_has_no_chunking(monkeypatch):
+    from yuma_simulation_tpu.telemetry.cost import DEVICE_SPEC_ENV
+
+    monkeypatch.setenv(DEVICE_SPEC_ENV, SMALL_SPEC)
+    plan = plan_dispatch("t", (10, 6, 18), VERSION, CFG, jnp.float32)
+    assert plan.memory.fits is True
+    assert plan.memory.chunk_epochs is None
+
+
+def test_memory_plan_streaming_caps_slabs_instead_of_raising(monkeypatch):
+    """A stack that cannot fit monolithically still PLANS under
+    streaming=True: no HBMPreflightError, a finite slab cap sized for
+    two resident buffers."""
+    from yuma_simulation_tpu.telemetry.cost import (
+        DEVICE_SPEC_ENV,
+        HBMPreflightError,
+    )
+
+    monkeypatch.setenv(DEVICE_SPEC_ENV, SMALL_SPEC)
+    shape = (100_000, 256, 1024)  # ~100 GB stack on a 300 MiB "device"
+    with pytest.raises(HBMPreflightError):
+        plan_dispatch("t", shape, VERSION, CFG, jnp.float32)
+    plan = plan_dispatch(
+        "t", shape, VERSION, CFG, jnp.float32, streaming=True
+    )
+    assert plan.memory.fits is False
+    cap = plan.memory.chunk_epochs
+    assert cap is not None and 1 <= cap < 100_000
+    # Two slabs of the cap + the working set actually fit the budget.
+    from yuma_simulation_tpu.telemetry.cost import estimate_hbm_bytes
+
+    two_slabs = (
+        2 * estimate_hbm_bytes(256, 1024, resident_epochs=cap).total_bytes
+    )
+    assert two_slabs <= 300 * 1024 * 1024
+
+
+def test_streaming_still_rejects_unfittable_working_set(monkeypatch):
+    """Streaming fixes epoch-stack overflow, not working-set overflow:
+    when the fixed [V, M] state alone exceeds the budget, no slab
+    length helps — the plan must reject with the typed error, and
+    YUMA_TPU_PREFLIGHT=0 must disable BOTH the reject and the slab
+    re-slicing."""
+    from yuma_simulation_tpu.telemetry.cost import (
+        DEVICE_SPEC_ENV,
+        HBMPreflightError,
+        PREFLIGHT_ENV,
+    )
+
+    monkeypatch.setenv(
+        DEVICE_SPEC_ENV, json.dumps({"name": "dot", "memory_bytes": 512})
+    )
+    with pytest.raises(HBMPreflightError):
+        plan_dispatch(
+            "t", (100, 64, 128), VERSION, CFG, jnp.float32, streaming=True
+        )
+    monkeypatch.setenv(PREFLIGHT_ENV, "0")
+    plan = plan_dispatch(
+        "t", (100, 64, 128), VERSION, CFG, jnp.float32, streaming=True
+    )
+    assert plan.memory.chunk_epochs is None  # kill switch: no re-slicing
+
+
+def test_streamed_respects_plan_slab_cap_bitwise(monkeypatch):
+    """ISSUE 6 satellite 1 + acceptance: the streamed driver re-slices
+    incoming chunks to the plan's cap (visible as extra per-slab
+    dispatches) and the result stays BITWISE the monolithic scan."""
+    from tests.unit.test_fused_case_scan import _workload
+    from yuma_simulation_tpu.simulation.engine import (
+        _simulate_scan,
+        simulate_streamed,
+    )
+    from yuma_simulation_tpu.telemetry.cost import DEVICE_SPEC_ENV
+
+    W, S = _workload(seed=5, E=12)
+    spec = variant_for_version(VERSION)
+    mono = _simulate_scan(
+        W, S, jnp.asarray(2, jnp.int32), jnp.asarray(4, jnp.int32), CFG,
+        spec,
+    )
+    # A spec so tight the plan caps slabs at a couple of epochs: the
+    # single 12-epoch chunk below MUST be re-sliced to the cap.
+    monkeypatch.setenv(
+        DEVICE_SPEC_ENV,
+        json.dumps({"name": "nano", "memory_bytes": 7_000}),
+    )
+    plan = plan_dispatch(
+        "t", (12,) + W.shape[1:], VERSION, CFG, jnp.float32,
+        streaming=True,
+    )
+    assert plan.memory.chunk_epochs is not None
+    assert 1 <= plan.memory.chunk_epochs < 12
+    got = simulate_streamed(
+        [(W, S)],
+        VERSION,
+        CFG,
+        reset_bonds_index=2,
+        reset_bonds_epoch=4,
+        save_bonds=True,
+        save_incentives=True,
+        epoch_impl="xla",
+    )
+    np.testing.assert_array_equal(got.dividends, np.asarray(mono["dividends"]))
+    np.testing.assert_array_equal(got.bonds, np.asarray(mono["bonds"]))
+
+
+# ---------------------------------------------------------------------------
+# chunked per-epoch Monte-Carlo (the planned batched engine ride)
+
+
+def test_montecarlo_batched_chunk_invariant_bitwise():
+    from yuma_simulation_tpu.parallel.sharded import (
+        montecarlo_per_epoch_batched,
+    )
+
+    key = jax.random.PRNGKey(5)
+    args = (key, 5, 12, 4, 16, VERSION)
+    whole = montecarlo_per_epoch_batched(*args, consensus_impl="bisect")
+    for cap in (1, 5, 12):
+        chunked = montecarlo_per_epoch_batched(
+            *args, consensus_impl="bisect", chunk_epochs=cap
+        )
+        np.testing.assert_array_equal(whole, chunked, err_msg=f"cap={cap}")
+    assert whole.shape == (5, 4)
+    assert np.isfinite(whole).all()
+
+
+@pytest.mark.skipif(
+    not HAS_JAX_SHARD_MAP, reason="jax.shard_map not in this jax build"
+)
+def test_montecarlo_batched_bitwise_matches_shard_map_path():
+    """The batched XLA rung is the SAME step function as the shard_map
+    Monte-Carlo body (keys `split(split(key, 1)[0], B)`), so on one
+    device the two are bitwise-identical."""
+    from yuma_simulation_tpu.parallel import make_mesh
+    from yuma_simulation_tpu.parallel.sharded import (
+        montecarlo_per_epoch_batched,
+        montecarlo_total_dividends,
+    )
+
+    key = jax.random.PRNGKey(5)
+    mono = montecarlo_total_dividends(
+        key, 5, 12, 4, 16, VERSION, mesh=make_mesh(),
+        weights_mode="per_epoch", consensus_impl="bisect",
+    )
+    batched = montecarlo_per_epoch_batched(
+        key, 5, 12, 4, 16, VERSION, consensus_impl="bisect"
+    )
+    np.testing.assert_array_equal(mono, batched)
+
+
+def test_montecarlo_batched_fused_interpret_parity():
+    """The fused rung (interpret mode off-TPU) agrees with the XLA
+    oracle to reduction-order rounding and is itself chunk-invariant
+    (the epoch sum accumulates strictly in epoch order)."""
+    from yuma_simulation_tpu.parallel.sharded import (
+        montecarlo_per_epoch_batched,
+    )
+
+    key = jax.random.PRNGKey(3)
+    args = (key, 2, 6, 4, 8, VERSION)
+    fused = montecarlo_per_epoch_batched(
+        *args, epoch_impl="fused_scan", consensus_impl="bisect"
+    )
+    fused_chunked = montecarlo_per_epoch_batched(
+        *args, epoch_impl="fused_scan", consensus_impl="bisect",
+        chunk_epochs=2,
+    )
+    np.testing.assert_array_equal(fused, fused_chunked)
+    xla = montecarlo_per_epoch_batched(
+        *args, epoch_impl="xla", consensus_impl="bisect"
+    )
+    np.testing.assert_allclose(fused, xla, rtol=2e-5, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# recording
+
+
+def test_plan_record_stamps_span_and_event(caplog):
+    import logging
+
+    from yuma_simulation_tpu.telemetry.runctx import RunContext, span
+    from yuma_simulation_tpu.utils.logging import parse_event_line
+
+    plan = plan_dispatch("rec-test", (10, 6, 18), VERSION, CFG, jnp.float32)
+    with caplog.at_level(
+        logging.DEBUG, "yuma_simulation_tpu.simulation.planner"
+    ):
+        with RunContext("run-plan-test") as run:
+            with span("dispatch") as s:
+                plan.record()
+            assert s.attrs["plan"]["engine"] == plan.engine
+            assert s.attrs["plan"]["bucket"] == plan.bucket.key
+    events = [
+        parse_event_line(r.getMessage()) for r in caplog.records
+    ]
+    events = [e for e in events if e and e["event"] == "dispatch_planned"]
+    assert len(events) == 1
+    assert events[0]["label"] == "rec-test"
+    assert events[0]["engine"] == plan.engine
+    # the record carries the run/span identity for the flight bundle
+    assert events[0]["run_id"] == run.run_id
+
+
+def test_liquid_alpha_and_versions_plan_consistently():
+    """The plan agrees with what the engines actually accept: every
+    named version plans and simulates on the planned engine."""
+    from yuma_simulation_tpu.scenarios import create_case
+    from yuma_simulation_tpu.simulation.engine import simulate
+
+    case = create_case("Case 2")
+    cfg = YumaConfig(yuma_params=YumaParams(liquid_alpha=True))
+    for version in (VERSION, "Yuma 2 (Adrian-Fish)", "Yuma 3 (Rhef)"):
+        plan = plan_dispatch(
+            "t", np.shape(case.weights), version, cfg, jnp.float32
+        )
+        out = simulate(
+            case, version, cfg, save_bonds=False, save_incentives=False,
+            epoch_impl=plan.engine,
+        )
+        assert np.isfinite(out.dividends).all()

@@ -138,6 +138,56 @@ def test_telemetry_instrumented_sweep_is_compile_free(tmp_path):
     assert out["report"].units_resumed == 2  # warm run's chunks reused
 
 
+def test_planner_adds_zero_compiles_and_is_cached_stable():
+    """ISSUE 6 satellite: dispatch planning is pure host arithmetic —
+    a warm-repeat simulate() (which now plans, preflights and records
+    on every call) stays compile-free, and repeated planning returns
+    identical plans (no hash-unstable decision state)."""
+    from yuma_simulation_tpu.models.config import YumaConfig
+    from yuma_simulation_tpu.simulation.engine import simulate
+    from yuma_simulation_tpu.simulation.planner import plan_dispatch
+
+    case = create_case("Case 2")
+    simulate(case, "Yuma 1 (paper)")  # warm-up: the one cold compile
+    with RecompilationSentinel(
+        _simulate_scan, budget=0, label="planned simulate warm repeat"
+    ) as sentinel:
+        simulate(case, "Yuma 1 (paper)")
+        plans = [
+            plan_dispatch(
+                "pin",
+                np.shape(case.weights),
+                "Yuma 1 (paper)",
+                YumaConfig(),
+                jnp.float32,
+            )
+            for _ in range(3)
+        ]
+    assert sentinel.new_entries == 0
+    assert plans[0] == plans[1] == plans[2]
+
+
+def test_streamed_double_buffer_warm_repeat_is_compile_free():
+    """The double-buffered streamed driver compiles ONE chunk program
+    (plus none for the zero carry) and reuses it: a warm repeat over
+    the same chunk split adds zero entries to the donating engine."""
+    from yuma_simulation_tpu.simulation.engine import (
+        _simulate_scan_streamed,
+        simulate_streamed,
+    )
+
+    case = create_case("Case 2")
+    W = np.asarray(case.weights)
+    S = np.asarray(case.stakes)
+    chunks = [(W[:20], S[:20]), (W[20:], S[20:])]
+    simulate_streamed(list(chunks), "Yuma 1 (paper)", epoch_impl="xla")
+    with RecompilationSentinel(
+        _simulate_scan_streamed, budget=0, label="streamed warm repeat"
+    ) as sentinel:
+        simulate_streamed(list(chunks), "Yuma 1 (paper)", epoch_impl="xla")
+    assert sentinel.new_entries == 0
+
+
 class _IdentityHashedSpec:
     """A 'static' argument whose equality is object identity: every
     instance is a fresh jit-cache key — the silent-retrace bug the

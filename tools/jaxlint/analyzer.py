@@ -89,6 +89,15 @@ RULES: dict[str, tuple[str, str]] = {
         "engine carries must be registered pytree dataclasses "
         "(simulation/carry.py)",
     ),
+    "JX009": (
+        "device-put-in-trace",
+        "jax.device_put inside a scan/jit-traced region: under trace it "
+        "is a layout hint at best and a silent no-op at worst — the "
+        "transfer the caller meant to overlap with compute never "
+        "happens there; stage the buffer from the host-level dispatch "
+        "driver (the bug class the double-buffered streaming rewrite "
+        "removed)",
+    ),
 }
 
 #: Parse failures are reported under this pseudo-code (not suppressible).
@@ -745,6 +754,22 @@ class FileAnalyzer:
                     "body: numpy concretizes tracers to host arrays — use "
                     "the jnp equivalent",
                 )
+
+        # JX009: host->device staging belongs to the host-level driver.
+        # Any device_put spelling (jax.device_put, a bare alias import)
+        # inside a jit scope is flagged: traced, it cannot start the
+        # async transfer the call site exists for.
+        if leaf == "device_put":
+            self.add(
+                call,
+                "JX009",
+                f"{fname}() inside a jit-traced region: under trace "
+                "device_put is at best a layout constraint and never "
+                "the async host->HBM transfer the call site implies — "
+                "stage buffers from the host-level dispatch driver "
+                "(engine.simulate_streamed's double-buffer is the "
+                "pattern)",
+            )
 
         # JX004: fault hooks must stay host-level
         if leaf in FAULT_HOOKS:

@@ -72,7 +72,9 @@ def render_run(bundle, run_id: str) -> str:
         status = "" if s.get("status") == "ok" else f"  {s['status'].upper()}"
         attrs = s.get("attrs") or {}
         attr_txt = "".join(
-            f" {k}={json.dumps(v)}" for k, v in attrs.items() if k != "steps"
+            f" {k}={json.dumps(v)}"
+            for k, v in attrs.items()
+            if k not in ("steps", "plan")  # plans render in their own section
         )
         pad = "  " * (depth + 1)
         lines.append(
@@ -134,11 +136,53 @@ def render(bundle, run_id: str | None) -> str:
                 f"{k}={_num(v)}" for k, v in {**counters, **gauges}.items()
             )
         )
+    plans = render_plans(bundle, target)
+    if plans:
+        lines.append("")
+        lines.extend(plans)
     perf = render_perf(bundle)
     if perf:
         lines.append("")
         lines.extend(perf)
     return "\n".join(lines)
+
+
+def render_plans(bundle, run_id: str) -> list[str]:
+    """The dispatch-plan section: one line per recorded `DispatchPlan`
+    span attribute (`event=dispatch_planned`, simulation.planner) —
+    engine rung, shape bucket, sharding lanes, predicted HBM, slab cap
+    and the WHY, so a flight bundle answers "which engine ran, and on
+    what grounds" without replaying the sweep."""
+    seen: list[tuple[str, dict]] = []
+    for s in bundle.spans:
+        if s.get("run_id") != run_id:
+            continue
+        plan = (s.get("attrs") or {}).get("plan")
+        if isinstance(plan, dict):
+            seen.append((s.get("name", "?"), plan))
+    if not seen:
+        return []
+    lines = ["dispatch plans:"]
+    for name, plan in seen:
+        parts = [
+            f"  {name}:",
+            f"engine={plan.get('engine')}",
+            f"bucket={plan.get('bucket')}",
+        ]
+        if plan.get("shards", 1) != 1:
+            parts.append(f"shards={plan['shards']}")
+        if plan.get("lanes", 1) != 1:
+            parts.append(f"lanes={plan['lanes']}")
+        if plan.get("hbm_gib") is not None:
+            parts.append(f"hbm={plan['hbm_gib']}GiB")
+        if plan.get("fits") is not None:
+            parts.append(f"fits={plan['fits']}")
+        if plan.get("chunk_epochs") is not None:
+            parts.append(f"chunk_epochs={plan['chunk_epochs']}")
+        if plan.get("why"):
+            parts.append(f"({plan['why']})")
+        lines.append(" ".join(parts))
+    return lines
 
 
 def _fmt_bytes(n) -> str:

@@ -36,6 +36,7 @@ import json
 import os
 import statistics
 import sys
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -45,15 +46,30 @@ DEFAULT_TOLERANCE = 0.15
 DEFAULT_NOISE_MULT = 3.0
 
 #: Fields every history record must carry (structural gate).
+#: ``attained_floor`` (0.10.0) declares, per engine rung, the minimum
+#: measured/roofline fraction the attained gate enforces.
 REQUIRED_FIELDS = (
     "t", "backend", "smoke", "metric", "value", "unit", "secondary",
-    "cv", "costs", "rooflines",
+    "cv", "costs", "rooflines", "attained_floor",
 )
 
 #: Every engine rung must appear in the cost report, and each must carry
 #: these analysis fields — as numbers, or as explicit nulls with a
 #: non-null ``reason`` (the CPU contract for the Pallas rungs).
 COST_FIELDS = ("flops", "bytes_accessed", "peak_bytes")
+
+#: The per-epoch-weights metrics ROADMAP item 5 exists to close — the
+#: slowest BENCH lines — promoted to FIRST-CLASS tracked lines
+#: (0.10.0): a record missing one is schema rot, exactly like a missing
+#: cost rung, so none of them can silently drop out of the regression
+#: baseline again. bench.py records all three on every backend (CPU
+#: runs a scaled-down workload; rates only ever baseline against the
+#: same backend+smoke class).
+TRACKED_SECONDARY = (
+    "true_weights_xla",
+    "streamed_true_weights",
+    "montecarlo_per_epoch_weights",
+)
 
 
 def load_history(path: str) -> list[dict]:
@@ -77,6 +93,20 @@ def check_structure(record: dict) -> list[str]:
     for field in ("secondary", "cv", "costs", "rooflines"):
         if field in record and not isinstance(record[field], dict):
             problems.append(f"{field} must be an object")
+    secondary = record.get("secondary")
+    if isinstance(secondary, dict):
+        for name in TRACKED_SECONDARY:
+            value = secondary.get(name)
+            if not isinstance(value, (int, float)) or value <= 0:
+                problems.append(
+                    f"tracked secondary metric {name!r} is "
+                    + ("missing" if value is None else f"invalid ({value!r})")
+                    + " — the per-epoch-weights lines are first-class "
+                    "gated metrics"
+                )
+    floors = record.get("attained_floor")
+    if "attained_floor" in record and not isinstance(floors, dict):
+        problems.append("attained_floor must be an object")
     costs = record.get("costs")
     if isinstance(costs, dict):
         # An empty report is schema rot, not a pass: the CI invariant is
@@ -115,15 +145,55 @@ def _baseline_records(history: list[dict], latest: dict, window: int):
 
 
 def _metric_values(record: dict) -> dict[str, float]:
-    """`{metric_key: rate}` for the headline (+ numeric secondaries).
-    The headline rides under "primary" — the same key its cv uses."""
+    """`{metric_key: rate}` for the headline (+ numeric secondaries,
+    + per-rung attained roofline fractions). The headline rides under
+    "primary" — the same key its cv uses. Attained fractions ride as
+    ``attained:{engine}`` so the rolling-baseline diff gates the
+    distance-to-ceiling itself: an absolute-rate regression that the
+    noise tolerance absorbs still fails when the fraction of the
+    hardware roofline actually hit drops."""
     out: dict[str, float] = {}
     if isinstance(record.get("value"), (int, float)):
         out["primary"] = float(record["value"])
     for key, value in (record.get("secondary") or {}).items():
         if isinstance(value, (int, float)):
             out[key] = float(value)
+    for engine, rl in (record.get("rooflines") or {}).items():
+        attained = (rl or {}).get("attained_fraction")
+        if isinstance(attained, (int, float)):
+            out[f"attained:{engine}"] = float(attained)
     return out
+
+
+def check_attained(record: dict, floors: Optional[dict] = None) -> list[str]:
+    """The attained-fraction gate: one failure line per engine rung
+    whose measured/roofline fraction sits below its declared floor.
+
+    Floors come from the record's own ``attained_floor`` declaration
+    (bench.py writes conservative per-rung backstops — the roofline is
+    an amortization-optimistic CEILING, so floors catch collapses, and
+    the rolling-baseline diff on the ``attained:*`` metrics catches
+    finer drift), overridden per rung by ``floors`` (the
+    ``--attained-floor`` CLI). Rungs whose attained fraction is null
+    (no measured rate, unknown device spec — every CPU build) are
+    vacuously fine: the STRUCTURAL gate already demands the nulls be
+    explicable, and inventing a fraction would gate noise."""
+    declared = dict(record.get("attained_floor") or {})
+    declared.update(floors or {})
+    failures: list[str] = []
+    for engine, rl in (record.get("rooflines") or {}).items():
+        attained = (rl or {}).get("attained_fraction")
+        floor = declared.get(engine)
+        if (
+            isinstance(attained, (int, float))
+            and isinstance(floor, (int, float))
+            and attained < floor
+        ):
+            failures.append(
+                f"{engine}: attained {attained:.3g} of the roofline "
+                f"prediction, below the declared floor {floor:.3g}"
+            )
+    return failures
 
 
 def compare(
@@ -225,12 +295,27 @@ def main(argv=None) -> int:
         "--min-baseline", type=int, default=2,
         help="prior comparable runs required before verdicts fire",
     )
+    parser.add_argument(
+        "--attained-floor", action="append", default=[], metavar="ENGINE=F",
+        help="override an engine rung's attained-fraction floor (the "
+        "record's own attained_floor declaration is the default); a "
+        "rung whose measured/roofline fraction sits below its floor "
+        "fails --check — in structural mode too (the gate is vacuous "
+        "where the fraction is null, e.g. every CPU build)",
+    )
     parser.add_argument("--json", action="store_true")
     parser.add_argument(
         "--report", default=None,
         help="also write the JSON verdict to this path (CI artifact)",
     )
     args = parser.parse_args(argv)
+    floor_overrides: dict = {}
+    for item in args.attained_floor:
+        engine, _, value = item.partition("=")
+        try:
+            floor_overrides[engine] = float(value)
+        except ValueError:
+            parser.error(f"--attained-floor wants ENGINE=FLOAT, got {item!r}")
 
     history = load_history(args.history)
     if not history:
@@ -241,10 +326,12 @@ def main(argv=None) -> int:
         return 2
     latest = history[-1]
     problems = check_structure(latest)
+    attained_failures = check_attained(latest, floor_overrides)
     result: dict = {
         "history": args.history,
         "records": len(history),
         "structural_problems": problems,
+        "attained_failures": attained_failures,
     }
     if not args.structural:
         result.update(
@@ -270,6 +357,14 @@ def main(argv=None) -> int:
             print(f"perfgate: STRUCTURAL: {p}", file=sys.stderr)
         if args.check:
             return 2
+    if attained_failures:
+        # Active in --structural too: the floor is declared against the
+        # record's OWN roofline prediction, so no cross-run baseline is
+        # needed for the distance-to-ceiling to be gateable.
+        for f in attained_failures:
+            print(f"perfgate: ATTAINED-FRACTION: {f}", file=sys.stderr)
+        if args.check:
+            return 1
     regressions = [
         k
         for k, v in result.get("verdicts", {}).items()
@@ -294,6 +389,11 @@ def _render(result: dict, latest: dict) -> None:
         print(f"  schema: {len(result['structural_problems'])} problem(s)")
     else:
         print("  schema: sound")
+    attained = result.get("attained_failures", [])
+    if attained:
+        print(f"  attained-fraction: {len(attained)} rung(s) below floor")
+    elif latest.get("attained_floor"):
+        print("  attained-fraction: within declared floors")
     verdicts = result.get("verdicts")
     if verdicts is None:
         return

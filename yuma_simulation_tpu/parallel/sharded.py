@@ -190,28 +190,29 @@ def simulate_batch_sharded(
         shards = mesh_now.shape[DATA_AXIS]
         pad = _pad_batch(n, shards)
         padded = list(scenarios) + [scenarios[-1]] * pad
-        # HBM preflight (telemetry.cost) per mesh attempt: each device
-        # holds (n + pad) / shards scenario lanes, so a degraded mesh's
-        # fatter per-device slice is re-checked before the re-dispatch —
-        # analytic, pre-compile, typed event=preflight_rejected on
-        # reject (a caller error: shrinking further cannot fix it).
-        from yuma_simulation_tpu.telemetry.cost import (
-            estimate_hbm_bytes,
-            preflight_hbm,
-        )
+        # The dispatch plan per mesh attempt (simulation.planner): each
+        # device holds (n + pad) / shards scenario lanes, so a degraded
+        # mesh's fatter per-device slice is re-preflighted before the
+        # re-dispatch — analytic, pre-compile, typed
+        # event=preflight_rejected on reject (a caller error: shrinking
+        # further cannot fix it). The plan is recorded here, at the
+        # entry point that places the arrays; the shard_map body's
+        # trace-time re-entry of simulate_batch plans engine-only.
+        from yuma_simulation_tpu.simulation.planner import plan_dispatch
 
         E_, V_, M_ = np.shape(scenarios[0].weights)
-        preflight_hbm(
+        lanes = (n + pad) // shards
+        plan = plan_dispatch(
             f"sharded_batch:{shards}dev",
-            estimate_hbm_bytes(
-                V_,
-                M_,
-                resident_epochs=E_,
-                itemsize=jnp.dtype(dtype).itemsize,
-                save_bonds=save_bonds,
-                batch_lanes=(n + pad) // shards,
-            ),
+            (lanes, E_, V_, M_),
+            spec,
+            config,
+            dtype,
+            epoch_impl="xla",
+            save_bonds=save_bonds,
+            quarantine=quarantine,
         )
+        plan.record()
         W, S, ri, re = stack_scenarios(padded, dtype)
 
         sharding = NamedSharding(mesh_now, P(DATA_AXIS))
@@ -405,18 +406,11 @@ def montecarlo_total_dividends(
             "expected 'constant' or 'per_epoch'"
         )
     varying = weights_mode == "per_epoch"
-    if epoch_impl == "auto":
-        epoch_impl = "xla" if varying else "hoisted"
-    if epoch_impl not in ("hoisted", "xla"):
-        raise ValueError(
-            f"unknown epoch_impl {epoch_impl!r}; "
-            "expected 'auto', 'hoisted' or 'xla'"
-        )
-    if varying and epoch_impl == "hoisted":
-        raise ValueError(
-            "weights_mode='per_epoch' re-perturbs the weights every "
-            "epoch; nothing is hoistable — use epoch_impl='xla'/'auto'"
-        )
+    from yuma_simulation_tpu.simulation.planner import (
+        resolve_montecarlo_engine,
+    )
+
+    epoch_impl = resolve_montecarlo_engine(epoch_impl, varying)
     shards = mesh.shape[DATA_AXIS]
     # Pad-and-trim, the same contract as simulate_batch_sharded (r4
     # verdict weak item 6): extra scenarios are simulated (cheap, they
@@ -502,6 +496,80 @@ def _montecarlo_run(
     )(keys)
 
 
+def _mc_varying_step(
+    k, spec, config, base_weights, base_stakes, perturbation,
+    consensus_impl,
+):
+    """The per-epoch Monte-Carlo scan step for scenario key `k`: a
+    fresh perturbation per GLOBAL epoch index (`fold_in(k, epoch)`),
+    the full consensus kernel, dividends accumulated in the carry.
+    Shared verbatim by the `shard_map` body and the chunked batched
+    driver (:func:`montecarlo_per_epoch_batched`), so the two paths are
+    bitwise-identical by construction (pinned by
+    tests/unit/test_planner.py)."""
+    from yuma_simulation_tpu.models.epoch import BondsMode
+    from yuma_simulation_tpu.ops.normalize import normalize_weight_rows
+    from yuma_simulation_tpu.simulation.carry import TotalsCarry
+    from yuma_simulation_tpu.simulation.engine import _dividends_per_1k
+
+    V, M = base_weights.shape
+    dtype = base_weights.dtype
+
+    def step(carry, epoch):
+        B, W_prev = carry.bonds, carry.w_prev
+        eps = perturbation * jax.random.normal(
+            jax.random.fold_in(k, epoch), (V, M), dtype
+        )
+        W = jax.nn.relu(base_weights + eps)
+        first = epoch == 0
+        kernel_prev = None
+        if spec.bonds_mode is BondsMode.EMA_PREV:
+            kernel_prev = jnp.where(
+                first, normalize_weight_rows(W), W_prev
+            )
+        res = yuma_epoch(
+            W,
+            base_stakes,
+            B,
+            config,
+            bonds_mode=spec.bonds_mode,
+            W_prev=kernel_prev,
+            first_epoch=first,
+            consensus_impl=consensus_impl,
+        )
+        d = _dividends_per_1k(
+            res["validator_reward_normalized"],
+            base_stakes,
+            config,
+            dtype,
+        )
+        W_prev_next = (
+            res["weight"] if spec.carries_prev_weights else W_prev
+        )
+        return (
+            TotalsCarry(
+                bonds=res[spec.bond_state_key],
+                w_prev=W_prev_next,
+                consensus=res["server_consensus_weight"],
+                acc=carry.acc + d,
+            ),
+            None,
+        )
+
+    return step
+
+
+def _mc_zero_carry(V: int, M: int, dtype):
+    from yuma_simulation_tpu.simulation.carry import TotalsCarry
+
+    return TotalsCarry(
+        bonds=jnp.zeros((V, M), dtype),
+        w_prev=jnp.zeros((V, M), dtype),
+        consensus=jnp.zeros((M,), dtype),
+        acc=jnp.zeros((V,), dtype),
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -529,11 +597,6 @@ def _montecarlo_varying_run(
     del hoist_invariant  # nothing is hoistable with per-epoch weights
     from jax import lax
 
-    from yuma_simulation_tpu.models.epoch import BondsMode
-    from yuma_simulation_tpu.ops.normalize import normalize_weight_rows
-    from yuma_simulation_tpu.simulation.carry import TotalsCarry
-    from yuma_simulation_tpu.simulation.engine import _dividends_per_1k
-
     V, M = base_weights.shape
     dtype = base_weights.dtype
 
@@ -541,55 +604,14 @@ def _montecarlo_varying_run(
         shard_key = shard_keys[0]
 
         def one(k):
-            def step(carry, epoch):
-                B, W_prev = carry.bonds, carry.w_prev
-                eps = perturbation * jax.random.normal(
-                    jax.random.fold_in(k, epoch), (V, M), dtype
-                )
-                W = jax.nn.relu(base_weights + eps)
-                first = epoch == 0
-                kernel_prev = None
-                if spec.bonds_mode is BondsMode.EMA_PREV:
-                    kernel_prev = jnp.where(
-                        first, normalize_weight_rows(W), W_prev
-                    )
-                res = yuma_epoch(
-                    W,
-                    base_stakes,
-                    B,
-                    config,
-                    bonds_mode=spec.bonds_mode,
-                    W_prev=kernel_prev,
-                    first_epoch=first,
-                    consensus_impl=consensus_impl,
-                )
-                d = _dividends_per_1k(
-                    res["validator_reward_normalized"],
-                    base_stakes,
-                    config,
-                    dtype,
-                )
-                W_prev_next = (
-                    res["weight"] if spec.carries_prev_weights else W_prev
-                )
-                return (
-                    TotalsCarry(
-                        bonds=res[spec.bond_state_key],
-                        w_prev=W_prev_next,
-                        consensus=res["server_consensus_weight"],
-                        acc=carry.acc + d,
-                    ),
-                    None,
-                )
-
-            carry0 = TotalsCarry(
-                bonds=jnp.zeros((V, M), dtype),
-                w_prev=jnp.zeros((V, M), dtype),
-                consensus=jnp.zeros((M,), dtype),
-                acc=jnp.zeros((V,), dtype),
+            step = _mc_varying_step(
+                k, spec, config, base_weights, base_stakes, perturbation,
+                consensus_impl,
             )
             final, _ = lax.scan(
-                step, carry0, jnp.arange(num_epochs, dtype=jnp.int32)
+                step,
+                _mc_zero_carry(V, M, dtype),
+                jnp.arange(num_epochs, dtype=jnp.int32),
             )
             return final.acc  # [V]
 
@@ -602,6 +624,235 @@ def _montecarlo_varying_run(
         out_specs=P(DATA_AXIS),
         check_vma=False,
     )(keys)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("chunk_epochs", "spec", "consensus_impl"),
+    donate_argnames=("carry",),
+)
+def _montecarlo_varying_chunk(
+    keys, carry, epoch_lo, base_weights, base_stakes, perturbation,
+    config, *, chunk_epochs: int, spec: VariantSpec,
+    consensus_impl: str = "bisect",
+):
+    """One `[B]`-batched chunk of the per-epoch Monte-Carlo on the XLA
+    engine: each scenario advances `chunk_epochs` GLOBAL epochs from
+    `epoch_lo` with the full `TotalsCarry` state threaded (and donated)
+    between dispatches — the same step function as the monolithic
+    shard body, so chunked == monolithic bitwise."""
+    from jax import lax
+
+    def one(k, c):
+        step = _mc_varying_step(
+            k, spec, config, base_weights, base_stakes, perturbation,
+            consensus_impl,
+        )
+        final, _ = lax.scan(
+            step,
+            c,
+            jnp.asarray(epoch_lo, jnp.int32)
+            + jnp.arange(chunk_epochs, dtype=jnp.int32),
+        )
+        return final
+
+    return jax.vmap(one, in_axes=(0, 0))(keys, carry)
+
+
+@jax.jit
+def _mc_epoch_sum(totals, dividends):
+    """`totals + dividends summed over the epoch axis`, accumulated
+    STRICTLY in epoch order (a scan, not `jnp.sum` — whose reduction
+    order XLA may tree up differently per chunk length): the planner's
+    `chunk_epochs` cap must never change results, so the chunked total
+    is bitwise the monolithic one on the same engine."""
+    from jax import lax
+
+    return lax.scan(
+        lambda t, d: (t + d, None), totals, dividends.swapaxes(0, 1)
+    )[0]
+
+
+@partial(jax.jit, static_argnames=("chunk_epochs",))
+def _montecarlo_weight_slab(
+    keys, epoch_lo, base_weights, perturbation, *, chunk_epochs: int
+):
+    """`[B, CH, V, M]` genuinely-fresh per-epoch weights for the fused
+    batched scan — the SAME draws as the in-scan generation
+    (`fold_in(k, global_epoch)`), materialized one slab at a time so
+    the single-Pallas-program scan can stream them from HBM."""
+
+    def one(k):
+        def per_epoch(e):
+            eps = perturbation * jax.random.normal(
+                jax.random.fold_in(k, e),
+                base_weights.shape,
+                base_weights.dtype,
+            )
+            return jax.nn.relu(base_weights + eps)
+
+        return jax.vmap(per_epoch)(
+            jnp.asarray(epoch_lo, jnp.int32)
+            + jnp.arange(chunk_epochs, dtype=jnp.int32)
+        )
+
+    return jax.vmap(one)(keys)
+
+
+def montecarlo_per_epoch_batched(
+    key: jax.Array,
+    num_scenarios: int,
+    num_epochs: int,
+    num_validators: int,
+    num_miners: int,
+    yuma_version: str,
+    config: Optional[YumaConfig] = None,
+    *,
+    base_weights: Optional[jnp.ndarray] = None,
+    base_stakes: Optional[jnp.ndarray] = None,
+    perturbation: float = 0.05,
+    consensus_impl: str = "auto",
+    epoch_impl: str = "auto",
+    chunk_epochs: Optional[int] = None,
+    dtype=jnp.float32,
+) -> np.ndarray:
+    """The per-epoch-weights Monte-Carlo as ONE batched engine ride —
+    the donor-packed answer to BENCH's `montecarlo_per_epoch_weights`
+    gap (6.9k vs the 62k fused-scan line, ROADMAP item 5): instead of
+    `B` scenarios each scanning the unfused kernel, the whole batch
+    advances together through the planner-chosen engine.
+
+    Engine rungs (``epoch_impl``, planned by
+    :func:`..simulation.planner.plan_dispatch` on the `[B, CH, V, M]`
+    slab shape):
+
+    - ``fused_scan`` / ``fused_scan_mxu`` (what "auto" picks on TPU
+      when VMEM admits the batch): each chunk's fresh weights are
+      generated on device as one `[B, CH, V, M]` slab
+      (:func:`_montecarlo_weight_slab` — the SAME `fold_in(key,
+      global_epoch)` draws as the in-scan generation) and streamed
+      through the batched single-Pallas-program case scan with the
+      bond carry threaded (donated) between chunks. Only one slab plus
+      the in-flight generation is resident — HBM stays flat in E.
+    - ``xla`` (the CPU/ineligible fallback and the parity oracle): the
+      batched in-scan generation with the `TotalsCarry` threaded per
+      chunk — BITWISE the monolithic
+      :func:`montecarlo_total_dividends` shard body (same step
+      function, same keys; pinned by tests/unit/test_planner.py).
+
+    `chunk_epochs` (default: the plan's memory-plan slab cap, or the
+    whole run when capacity is unknown) trades dispatch count against
+    slab residency. Keys match ``montecarlo_total_dividends(...,
+    mesh=<1 device>)``: scenario keys are
+    ``split(split(key, 1)[0], B)``.
+
+    Returns `[num_scenarios, V]` total dividends as numpy.
+    """
+    from yuma_simulation_tpu.simulation.planner import plan_dispatch
+
+    config = config if config is not None else YumaConfig()
+    spec = variant_for_version(yuma_version)
+    V, M = num_validators, num_miners
+    if base_weights is None:
+        base_weights = jnp.ones((V, M), dtype)
+    if base_stakes is None:
+        base_stakes = jnp.ones((V,), dtype)
+    base_weights = jnp.asarray(base_weights, dtype)
+    base_stakes = jnp.asarray(base_stakes, dtype)
+    B = int(num_scenarios)
+    # The RAW consensus request goes to the planner so the contract
+    # matches every other entry point: auto+sorted falls back to the
+    # XLA rung, an explicit fused rung with "sorted" raises, and
+    # `plan.fallback_consensus` is the shape-gated resolution the XLA
+    # rung uses (same as montecarlo_total_dividends' own resolve).
+    plan = plan_dispatch(
+        f"montecarlo_batched:{yuma_version}",
+        (B, num_epochs, V, M),
+        spec,
+        config,
+        dtype,
+        epoch_impl=epoch_impl,
+        consensus_impl=consensus_impl,
+        streaming=True,
+    )
+    plan.record()
+    fused = plan.engine in ("fused_scan", "fused_scan_mxu")
+    if chunk_epochs is None:
+        # Only the fused rung materializes a slab; the XLA rung
+        # generates in-scan (HBM flat in E) and defaults to one
+        # dispatch over the whole run.
+        chunk_epochs = (
+            plan.memory.chunk_epochs or num_epochs
+        ) if fused else num_epochs
+    chunk_epochs = max(1, min(int(chunk_epochs), num_epochs))
+    keys = jax.random.split(jax.random.split(key, 1)[0], B)
+    perturbation = jnp.asarray(perturbation, dtype)
+
+    if fused:
+        from yuma_simulation_tpu.simulation.engine import (
+            _simulate_case_fused_streamed,
+        )
+
+        ri = jnp.asarray(-1, jnp.int32)
+        carry = {
+            "bonds": jnp.zeros((B, V, M), dtype),
+            "consensus": jnp.zeros((B, M), dtype),
+        }
+        if spec.carries_prev_weights:
+            carry["w_prev"] = jnp.zeros((B, V, M), dtype)
+        S_slab = jnp.broadcast_to(
+            base_stakes, (B, chunk_epochs, V)
+        )
+        totals = jnp.zeros((B, V), dtype)
+        nxt = _montecarlo_weight_slab(
+            keys, 0, base_weights, perturbation, chunk_epochs=chunk_epochs
+        )
+        for lo in range(0, num_epochs, chunk_epochs):
+            hi = min(lo + chunk_epochs, num_epochs)
+            W_slab = nxt
+            if hi - lo < chunk_epochs:
+                W_slab = W_slab[:, : hi - lo]
+                S_slab = S_slab[:, : hi - lo]
+            ys, carry = _simulate_case_fused_streamed(
+                W_slab,
+                S_slab,
+                ri,
+                ri,
+                config,
+                spec,
+                save_bonds=False,
+                save_incentives=False,
+                mxu=plan.engine == "fused_scan_mxu",
+                carry=carry,
+                epoch_offset=lo,
+                return_carry=True,
+            )
+            if hi < num_epochs:
+                # Double-buffer: next slab's generation is queued while
+                # the current chunk's scan runs.
+                nxt = _montecarlo_weight_slab(
+                    keys, hi, base_weights, perturbation,
+                    chunk_epochs=chunk_epochs,
+                )
+            totals = _mc_epoch_sum(totals, ys["dividends"])
+        return np.asarray(totals)
+
+    carry = jax.vmap(lambda _: _mc_zero_carry(V, M, dtype))(keys)
+    for lo in range(0, num_epochs, chunk_epochs):
+        hi = min(lo + chunk_epochs, num_epochs)
+        carry = _montecarlo_varying_chunk(
+            keys,
+            carry,
+            lo,
+            base_weights,
+            base_stakes,
+            perturbation,
+            config,
+            chunk_epochs=hi - lo,
+            spec=spec,
+            consensus_impl=plan.fallback_consensus,
+        )
+    return np.asarray(carry.acc)
 
 
 def shard_epoch_over_miners(

@@ -1,10 +1,12 @@
 """The engine-degradation ladder: bounded retry with explicit demotion.
 
-Engine selection today is resolve-once (`_resolve_case_engine`): "auto"
-picks the flagship fused Pallas case scan when eligible, and a failure
-at compile or dispatch time aborts the whole run. The ladder makes the
-fallback explicit and bounded instead: each case-scan engine has a fixed
-set of strictly-less-demanding rungs below it
+Engine selection is resolve-once (the dispatch planner,
+:func:`..simulation.planner.plan_dispatch`): "auto" picks the flagship
+fused Pallas case scan when eligible, and a failure at compile or
+dispatch time aborts the whole run. The ladder makes the fallback
+explicit and bounded instead: each case-scan engine has a fixed set of
+strictly-less-demanding rungs below it (`DispatchPlan.ladder` — the
+planner owns both the choice and the rungs beneath it)
 
     fused_scan_mxu  ->  fused_scan  ->  xla
 
@@ -34,17 +36,20 @@ from yuma_simulation_tpu.resilience.errors import (
     EngineLadderExhausted,
     classify_failure,
 )
+
+# Rung ordering/eligibility is owned by the dispatch planner since
+# 0.10.0 (one decision surface for engine choice AND the ladder below
+# it); re-exported here because the ladder is this module's vocabulary
+# and existing callers import it from resilience.
+from yuma_simulation_tpu.simulation.planner import (  # noqa: F401
+    ENGINE_LADDER,
+    ladder_from,
+)
 from yuma_simulation_tpu.telemetry.metrics import get_registry
 from yuma_simulation_tpu.telemetry.runctx import span as telemetry_span
 from yuma_simulation_tpu.utils.logging import log_event
 
 logger = logging.getLogger(__name__)
-
-#: The full case-scan ladder, most- to least-demanding. An explicitly
-#: requested engine starts at its own rung and may only walk DOWN —
-#: demotion must never silently upgrade a run onto an engine the caller
-#: did not ask for.
-ENGINE_LADDER = ("fused_scan_mxu", "fused_scan", "xla")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,16 +100,6 @@ class DemotionRecord:
     attempts: int  # attempts spent on `from_engine` before demoting
     error_type: str
     message: str
-
-
-def ladder_from(engine: str) -> tuple:
-    """The rungs at and below `engine`, in demotion order. Unknown
-    engines (e.g. the throughput paths' "fused"/"hoisted") get a
-    single-rung ladder: retry in place, never demote onto a path with
-    different output semantics."""
-    if engine in ENGINE_LADDER:
-        return ENGINE_LADDER[ENGINE_LADDER.index(engine):]
-    return (engine,)
 
 
 def run_ladder(

@@ -322,6 +322,31 @@ class SweepSupervisor:
         scenarios = list(scenarios)
         units = self._partition(len(scenarios))
 
+        # The sweep-level dispatch plan (simulation.planner), recorded
+        # on the sweep span so the flight bundle shows WHY the rung ran
+        # before any unit dispatches; each unit's engine-rung span gets
+        # its own per-dispatch plan from simulate_batch. Planning is
+        # pure host arithmetic — zero compiles (the recompilation pins
+        # cover this path).
+        plan = None
+        if scenarios:
+            from yuma_simulation_tpu.simulation.planner import (
+                plan_dispatch,
+            )
+
+            E0, V0, M0 = np.shape(scenarios[0].weights)
+            lanes0 = min(self.unit_size, len(scenarios))
+            plan = plan_dispatch(
+                f"supervised_batch:{yuma_version}",
+                (lanes0, E0, V0, M0),
+                spec,
+                config,
+                dtype,
+                epoch_impl=self.engine if mesh is None else "xla",
+                quarantine=self.quarantine,
+                check_memory=mesh is None,
+            )
+
         def dispatch_unit(
             idx: int, lo: int, hi: int, attempt: int, outcome: _UnitOutcome
         ) -> dict:
@@ -376,6 +401,7 @@ class SweepSupervisor:
             dispatch_unit,
             num_lanes=len(scenarios),
             tag=tag or f"batch:{yuma_version}",
+            plan=plan,
             config_fingerprint={
                 "driver": "run_batch",
                 "version": yuma_version,
@@ -417,6 +443,23 @@ class SweepSupervisor:
         )
         units = self._partition(num_points)
 
+        from yuma_simulation_tpu.models.config import YumaConfig
+        from yuma_simulation_tpu.simulation.planner import plan_dispatch
+
+        # Each unit vmaps up to unit_size grid lanes over ONE scenario,
+        # so the plan's lane count (and its memory footprint) is the
+        # unit's, not a single lane's.
+        lanes0 = min(self.unit_size, num_points)
+        plan = plan_dispatch(
+            f"supervised_grid:{yuma_version}",
+            (lanes0,) + tuple(np.shape(scenario.weights)),
+            yuma_version,
+            YumaConfig(),  # grid points vary floats; plan on defaults
+            jnp.float32,
+            epoch_impl="xla",
+            quarantine=self.quarantine,
+        )
+
         def dispatch_unit(
             idx: int, lo: int, hi: int, attempt: int, outcome: _UnitOutcome
         ) -> dict:
@@ -438,6 +481,7 @@ class SweepSupervisor:
             dispatch_unit,
             num_lanes=num_points,
             tag=tag or f"grid:{yuma_version}",
+            plan=plan,
             config_fingerprint={
                 "driver": "run_grid",
                 "version": yuma_version,
@@ -506,6 +550,7 @@ class SweepSupervisor:
         tag: str,
         config_fingerprint: dict,
         cost_request: Optional[dict] = None,
+        plan=None,
     ) -> dict:
         from yuma_simulation_tpu.telemetry import (
             FlightRecorder,
@@ -593,7 +638,15 @@ class SweepSupervisor:
                 # -> engine rung (the rung span lives in run_ladder).
                 # Every ledger append above happens under one of these,
                 # so obsreport resolves each record to a span.
-                with span(f"sweep:{tag}", units=len(units), lanes=num_lanes):
+                with span(
+                    f"sweep:{tag}", units=len(units), lanes=num_lanes
+                ) as sweep_span:
+                    if sweep_span is not None and plan is not None:
+                        # The typed dispatch-plan attribute: flight
+                        # bundles show WHY this sweep's rung ran
+                        # (obsreport renders a "dispatch plans" section
+                        # from these).
+                        sweep_span.attrs["plan"] = plan.span_attr()
                     if directory is not None:
                         from yuma_simulation_tpu.utils.checkpoint import (
                             CheckpointedSweep,

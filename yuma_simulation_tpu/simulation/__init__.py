@@ -8,8 +8,13 @@ from yuma_simulation_tpu.simulation.engine import (  # noqa: F401
     simulate_generated,
     simulate_streamed,
 )
+from yuma_simulation_tpu.simulation.planner import (  # noqa: F401
+    DispatchPlan,
+    plan_dispatch,
+)
 from yuma_simulation_tpu.simulation.sweep import (  # noqa: F401
     config_grid,
+    pack_scenarios,
     simulate_batch,
     sweep_hyperparams,
 )

@@ -49,6 +49,10 @@ from yuma_simulation_tpu.simulation.carry import (
     ScanCarry,
     TotalsCarry,
 )
+from yuma_simulation_tpu.simulation.planner import (
+    plan_dispatch,
+    resolve_scaled_engine,
+)
 
 
 @dataclass
@@ -146,79 +150,6 @@ def fused_hparams(config: YumaConfig) -> dict:
         override_consensus_low=config.override_consensus_low,
         precision=config.consensus_precision,
     )
-
-
-def _resolve_case_engine(
-    epoch_impl: str,
-    consensus_impl: str,
-    shape,
-    spec: VariantSpec,
-    config: YumaConfig,
-    dtype,
-    save_bonds: bool,
-    mesh: Optional[Mesh] = None,
-    streaming: bool = False,
-) -> tuple[str, str]:
-    """The ONE engine/consensus resolution for the case-scan entry points
-    (`simulate`, `simulate_streamed`, `simulate_generated`): "auto"
-    becomes the fused Pallas scan when eligible (MXU variant wherever the
-    exact limb split covers V) else the XLA scan; the fused engines
-    reject `consensus_impl="sorted"` (they bisect in-kernel) and any
-    miner-sharding mesh; the XLA engine resolves "auto" consensus to the
-    shape-gated sorted/bisect default. Returns `(epoch_impl,
-    consensus_impl)` fully resolved. Keeping this in one place stops the
-    three entry points drifting on the same-named knobs."""
-    if consensus_impl not in ("auto", "sorted", "bisect"):
-        raise ValueError(
-            f"unknown consensus_impl {consensus_impl!r}; "
-            "expected 'auto', 'sorted' or 'bisect'"
-        )
-    if epoch_impl == "auto":
-        from yuma_simulation_tpu.ops.pallas_epoch import (
-            exact_mxu_support_covers,
-            fused_case_scan_eligible,
-        )
-
-        if (
-            mesh is None
-            and consensus_impl in ("auto", "bisect")
-            and shape[0] >= 1
-            and fused_case_scan_eligible(
-                shape, spec.bonds_mode, config, dtype, save_bonds,
-                streaming=streaming,
-            )
-        ):
-            # Since r4 the MXU scan's consensus support is EXACT (the
-            # limb-split integer contraction, ~1.6x the VPU scan) and the
-            # whole scan is bitwise the VPU scan, so auto prefers it
-            # wherever the limb split covers V.
-            epoch_impl = (
-                "fused_scan_mxu"
-                if exact_mxu_support_covers(shape[-2])
-                else "fused_scan"
-            )
-        else:
-            epoch_impl = "xla"
-    if epoch_impl in ("fused_scan", "fused_scan_mxu"):
-        if mesh is not None:
-            raise ValueError(
-                "the fused case scan is a single-core Pallas program; "
-                "miner-axis sharding requires epoch_impl='xla'"
-            )
-        if consensus_impl == "sorted":
-            raise ValueError(
-                "the fused case scan computes consensus by bisection; "
-                "consensus_impl='sorted' requires epoch_impl='xla'"
-            )
-        return epoch_impl, consensus_impl
-    if epoch_impl != "xla":
-        raise ValueError(
-            f"unknown epoch_impl {epoch_impl!r}; "
-            "expected 'auto', 'xla', 'fused_scan' or 'fused_scan_mxu'"
-        )
-    from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
-
-    return "xla", resolve_consensus_impl(consensus_impl, *shape[-2:])
 
 
 def zero_carry(spec: VariantSpec, V: int, M: int, dtype) -> dict:
@@ -552,6 +483,45 @@ def _simulate_case_fused(
     return ys, carry_out
 
 
+#: Streaming twins of the two case engines, identical programs with the
+#: chunk carry DONATED: the `(bonds[, w_prev], consensus)` state is
+#: replaced wholesale every chunk, so its input buffers can back the
+#: next chunk's outputs instead of doubling the carry footprint while
+#: the next slab's host->HBM transfer is already in flight. Donation
+#: changes buffer lifetime only, never values — the streamed-vs-
+#: monolithic bitwise pins of tests/unit/test_streamed.py run through
+#: these. (Separate jit objects, not donate flags on the shared
+#: engines: `simulate_generated` traces the plain engines INSIDE its
+#: own jit, where donation annotations would be meaningless noise.)
+_simulate_scan_streamed = partial(
+    jax.jit,
+    static_argnames=(
+        "spec",
+        "save_bonds",
+        "save_incentives",
+        "save_consensus",
+        "consensus_impl",
+        "mesh",
+        "return_carry",
+        "guard_nonfinite",
+    ),
+    donate_argnames=("carry",),
+)(getattr(_simulate_scan, "__wrapped__"))
+
+_simulate_case_fused_streamed = partial(
+    jax.jit,
+    static_argnames=(
+        "spec",
+        "save_bonds",
+        "save_incentives",
+        "save_consensus",
+        "mxu",
+        "return_carry",
+    ),
+    donate_argnames=("carry",),
+)(getattr(_simulate_case_fused, "__wrapped__"))
+
+
 #: Above this many bytes for one saved per-epoch output stream the
 #: `save_bonds="auto"` / `save_incentives="auto"` defaults of
 #: :func:`simulate` resolve to False: materializing (and host-fetching)
@@ -615,12 +585,15 @@ def simulate(
     `max_resident_epochs`: when set and the scenario is longer, the epoch
     stack is processed in `[chunk, V, M]` slabs through the chunked
     drivers (:func:`simulate_streamed`) with the carry threaded between
-    dispatches — bitwise-identical results with only one chunk of
-    weights resident on device at a time (single-chip only). Compile
-    note: the chunk length is a static kernel parameter, so a run
-    compiles at most TWO programs (the full-size chunks and one
-    trailing remainder when `E % max_resident_epochs != 0`); pick a
-    divisor of E to compile one.
+    dispatches and slab `k+1`'s host->HBM transfer overlapping the scan
+    over slab `k` (the double buffer) — bitwise-identical results with
+    ~two slabs resident on device at a time (single-chip only). When
+    the device capacity is known, the dispatch plan may re-slice slabs
+    further to its `memory.chunk_epochs` cap so BOTH buffers fit.
+    Compile note: the chunk length is a static kernel parameter, so a
+    run compiles at most TWO programs per distinct slab length (the
+    full-size chunks and one trailing remainder when
+    `E % max_resident_epochs != 0`); pick a divisor of E to compile one.
 
     `epoch_impl`:
       - "auto" (default): run the whole epoch loop as a single Pallas
@@ -665,40 +638,37 @@ def simulate(
     save_incentives = _resolve_save(
         save_incentives, E_ * M_ * itemsize, "save_incentives"
     )
-    # HBM preflight (telemetry.cost): pure host arithmetic on shapes —
-    # zero compiles, zero allocation — that rejects a dispatch whose
-    # predicted peak footprint cannot fit the device BEFORE XLA starts
-    # the minutes-scale compile that would discover it the hard way.
-    # One typed `event=preflight_rejected` record + HBMPreflightError
-    # (a caller error: the ladder must not retry a shape that
-    # deterministically cannot fit). Unknown-capacity devices (every
-    # CPU build) pass open; YUMA_TPU_PREFLIGHT=0 disables.
-    from yuma_simulation_tpu.telemetry.cost import (
-        estimate_hbm_bytes,
-        preflight_hbm,
-    )
-
-    _miner_shard_count = (
-        1 if mesh is None else int(mesh.shape[mesh.axis_names[-1]])
-    )
-    preflight_hbm(
+    # The dispatch plan (simulation.planner): engine rung, consensus,
+    # ladder, shape bucket and the analytic memory plan in ONE decision.
+    # The embedded HBM preflight keeps its exact legacy contract: pure
+    # host arithmetic on shapes — zero compiles, zero allocation — that
+    # rejects a dispatch whose predicted peak footprint cannot fit the
+    # device BEFORE XLA starts the minutes-scale compile that would
+    # discover it the hard way, with one typed `event=preflight_rejected`
+    # record + HBMPreflightError (a caller error: the ladder must not
+    # retry a shape that deterministically cannot fit). Unknown-capacity
+    # devices (every CPU build) pass open; YUMA_TPU_PREFLIGHT=0 disables
+    # both the reject and the slab re-slicing. Streaming dispatches
+    # reject only when the FIXED [V, M] working set cannot fit (no slab
+    # length fixes that); an oversized epoch stack streams through the
+    # memory plan's slab cap instead (that is what streaming is FOR).
+    will_stream = max_resident_epochs is not None and E_ > max_resident_epochs
+    plan = plan_dispatch(
         f"simulate:{yuma_version}",
-        estimate_hbm_bytes(
-            V_,
-            M_,
-            resident_epochs=(
-                min(E_, max_resident_epochs)
-                if max_resident_epochs is not None
-                else E_
-            ),
-            itemsize=itemsize,
-            save_bonds=save_bonds,
-            save_incentives=save_incentives,
-            save_consensus=save_consensus,
-            miner_shards=_miner_shard_count,
-        ),
+        (E_, V_, M_),
+        spec,
+        config,
+        dtype,
+        epoch_impl=epoch_impl,
+        consensus_impl=consensus_impl,
+        save_bonds=save_bonds,
+        save_incentives=save_incentives,
+        save_consensus=save_consensus,
+        mesh=mesh,
+        streaming=will_stream,
+        max_resident_epochs=max_resident_epochs,
     )
-    if max_resident_epochs is not None and E_ > max_resident_epochs:
+    if will_stream:
         if mesh is not None:
             raise ValueError(
                 "max_resident_epochs streaming is single-chip; it cannot "
@@ -744,15 +714,13 @@ def simulate(
         jnp.int32,
     )
     # consensus_impl="auto" defers to the engine: the fused path (which
-    # computes by bisection) when epoch_impl selects it, else the
+    # computes by bisection) when the plan selects it, else the
     # shape-gated sorted/bisect default (the two are bitwise twins —
     # tests/unit/test_consensus_fuzz.py — so this is purely a
-    # compile/runtime-cost choice, ops/consensus.py).
-    consensus_req = consensus_impl
-    epoch_impl, consensus_impl = _resolve_case_engine(
-        epoch_impl, consensus_impl, weights.shape, spec, config, dtype,
-        save_bonds, mesh,
-    )
+    # compile/runtime-cost choice, ops/consensus.py). The plan also
+    # pre-resolves the XLA-rung consensus a ladder demotion needs.
+    plan.record()
+    epoch_impl, consensus_impl = plan.engine, plan.consensus_impl
 
     def _dispatch(rung: str):
         # Host-side profiler step annotation: each engine dispatch gets
@@ -780,16 +748,13 @@ def simulate(
                 mxu=rung == "fused_scan_mxu",
             )
         else:
-            cons = consensus_impl
-            if rung != epoch_impl:
-                # Demoted off a fused rung: the fused resolution left the
-                # consensus request untouched ("auto"/"bisect"); resolve
-                # it for the XLA engine exactly as a direct request would.
-                from yuma_simulation_tpu.ops.consensus import (
-                    resolve_consensus_impl,
-                )
-
-                cons = resolve_consensus_impl(consensus_req, V_, M_)
+            # Demoted off a fused rung: the plan pre-resolved the
+            # XLA-rung consensus exactly as a direct request would be.
+            cons = (
+                consensus_impl
+                if rung == epoch_impl
+                else plan.fallback_consensus
+            )
             W = weights
             if mesh is not None:
                 axis = mesh.axis_names[-1]
@@ -843,8 +808,8 @@ def simulate(
             from yuma_simulation_tpu.resilience.retry import run_ladder
 
             ys, _, records = run_ladder(
-                _dispatch, epoch_impl, retry_policy, label=yuma_version,
-                deadline=deadline,
+                _dispatch, epoch_impl, retry_policy, rungs=plan.ladder,
+                label=yuma_version, deadline=deadline,
             )
             demotions = tuple(records) or None
         ys = jax.device_get(ys)
@@ -1017,13 +982,16 @@ class _ReiterableChunks:
 
 class _CountingIter:
     """Iterator wrapper that counts consumed chunks and holds the most
-    recent one, so a failed first-chunk dispatch can be replayed on a
-    lower engine rung without re-materializing the stream."""
+    recent TWO (the double-buffered driver keeps one slab in flight
+    ahead of the one computing), so an early failure can be replayed on
+    a lower engine rung without re-materializing the stream."""
 
     def __init__(self, it):
+        import collections
+
         self._it = it
         self.consumed = 0
-        self.last = None
+        self.recent = collections.deque(maxlen=2)
 
     def __iter__(self):
         return self
@@ -1031,7 +999,7 @@ class _CountingIter:
     def __next__(self):
         item = next(self._it)
         self.consumed += 1
-        self.last = item
+        self.recent.append(item)
         return item
 
 
@@ -1056,7 +1024,7 @@ def _simulate_streamed_ladder(
     classified engine failure restart the stream on the next rung."""
     import itertools
 
-    from yuma_simulation_tpu.resilience.retry import ladder_from, run_ladder
+    from yuma_simulation_tpu.resilience.retry import run_ladder
 
     spec = variant_for_version(yuma_version)
     it = iter(chunks)
@@ -1067,16 +1035,26 @@ def _simulate_streamed_ladder(
     # Shape-only peek: jnp.asarray here would pin a duplicate
     # chunk-sized device buffer for the whole ladder run — an extra
     # [E_chunk, V, M] slab exactly on the path meant to survive
-    # RESOURCE_EXHAUSTED.
+    # RESOURCE_EXHAUSTED. check_memory=False: the rung choice is all
+    # the ladder needs; each attempt plans (and records) in full.
     shape0 = np.shape(first[0])
     if len(shape0) != 3:
         raise ValueError(
             f"streamed chunks must be [E_chunk, V, M], got {shape0}"
         )
-    impl0, _ = _resolve_case_engine(
-        epoch_impl, consensus_impl, shape0, spec, config, dtype,
-        save_bonds, streaming=True,
+    plan0 = plan_dispatch(
+        f"streamed:{yuma_version}",
+        shape0,
+        spec,
+        config,
+        dtype,
+        epoch_impl=epoch_impl,
+        consensus_impl=consensus_impl,
+        save_bonds=save_bonds,
+        streaming=True,
+        check_memory=False,
     )
+    impl0 = plan0.engine
     # Anything that is not its own iterator (lists, tuples, re-iterable
     # chunk factories like simulate()'s slab slicer) can restart from
     # chunk 0; a one-shot generator cannot.
@@ -1110,11 +1088,13 @@ def _simulate_streamed_ladder(
                 raise  # caller error: no replay bookkeeping needed
             if reiterable:
                 state["it"] = iter(chunks)
-            elif tracker.consumed <= 1:
-                # Only the chunk in hand was consumed; re-feed it ahead
+            elif tracker.consumed <= len(tracker.recent):
+                # Every consumed chunk is still held (at most the two
+                # the double-buffer had in flight); re-feed them ahead
                 # of the untouched remainder of the generator.
-                held = [tracker.last] if tracker.last is not None else []
-                state["it"] = itertools.chain(held, tracker._it)
+                state["it"] = itertools.chain(
+                    list(tracker.recent), tracker._it
+                )
             else:
                 raise ValueError(
                     "engine demotion needs to restart the stream from "
@@ -1129,7 +1109,7 @@ def _simulate_streamed_ladder(
         _dispatch,
         impl0,
         retry_policy,
-        rungs=ladder_from(impl0),
+        rungs=plan0.ladder,
         label=f"streamed:{yuma_version}",
     )
     result.demotions = tuple(records) or None
@@ -1152,10 +1132,28 @@ def _simulate_streamed_attempt(
     dtype,
     block_per_chunk: bool = False,
 ) -> SimulationResult:
-    """One engine-pinned pass over the stream — the pre-resilience body
-    of :func:`simulate_streamed`. `block_per_chunk` (ladder mode) waits
-    out each chunk's dispatch so device failures surface at the chunk
-    that caused them, inside the attempt's try."""
+    """One engine-pinned, DOUBLE-BUFFERED pass over the stream — the
+    pre-resilience body of :func:`simulate_streamed`.
+
+    Pipeline shape (the per-epoch-weights gap this closes — ROADMAP
+    item 5): slab `k` is dispatched asynchronously, then slab `k+1` is
+    pulled from the source and its `jax.device_put` host->HBM transfer
+    STARTED before anything waits on slab `k` — so the feed of the next
+    weights overlaps the scan over the current ones in every mode,
+    including the ladder's `block_per_chunk` (which previously
+    serialized transfer -> compute -> transfer). The chunk carry rides
+    the donating engine twins (`_simulate_scan_streamed` /
+    `_simulate_case_fused_streamed`), so threading it costs no second
+    copy of the `[V, M]` state. Incoming chunks larger than the memory
+    plan's slab cap (`DispatchPlan.memory.chunk_epochs` — sized so TWO
+    slabs fit the device together) are re-sliced to it, which is how
+    the streamed path respects the HBM preflight's chunk-size
+    suggestion instead of ignoring it.
+
+    `block_per_chunk` (ladder mode) still waits out each chunk's
+    dispatch so device failures surface at the chunk that caused them,
+    inside the attempt's try — the wait just happens AFTER the next
+    transfer is in flight."""
     from yuma_simulation_tpu.resilience import faults
 
     ri = jnp.asarray(
@@ -1164,10 +1162,7 @@ def _simulate_streamed_attempt(
     re_ = jnp.asarray(
         -1 if reset_bonds_epoch is None else reset_bonds_epoch, jnp.int32
     )
-    impl: Optional[str] = None
-    xla_consensus = consensus_impl
-    carry: Optional[dict] = None
-    offset = 0
+    state: dict = {}  # "plan": DispatchPlan, set on the first chunk
     host: dict[str, list] = {"dividends": []}
     if save_bonds:
         host["bonds"] = []
@@ -1175,43 +1170,59 @@ def _simulate_streamed_attempt(
         host["incentives"] = []
     if save_consensus:
         host["consensus"] = []
-    pending: Optional[dict] = None
 
-    def _flush(ys):
-        # Materialize a chunk's outputs to numpy, dropping the device
-        # buffers: keeping every chunk's [Ec, V, M] outputs alive as
-        # jax.Arrays until the end would accumulate exactly the
-        # beyond-HBM history streaming exists to avoid. The async copy
-        # was started when the chunk was dispatched, so this wait
-        # overlaps the NEXT chunk's compute, not this one's.
-        for k, acc in host.items():
-            acc.append(np.asarray(ys[k]))
+    def slabs():
+        """Validate incoming chunks, plan once on the first, and
+        re-slice anything longer than the plan's slab cap (host-side
+        views — no copy until the staged device_put)."""
+        for Wc, Sc in chunks:
+            if np.ndim(Wc) != 3:
+                raise ValueError(
+                    "streamed chunks must be [E_chunk, V, M], got "
+                    f"{np.shape(Wc)}"
+                )
+            if "plan" not in state:
+                # Same resolution as simulate(), decided once on the
+                # first chunk (eligibility depends on [V, M]/mode/
+                # config, not the chunk length) and pinned for the
+                # whole stream — mixing engines across chunks would
+                # break bitwise equality with the monolithic run.
+                plan = plan_dispatch(
+                    f"streamed:{yuma_version}",
+                    np.shape(Wc),
+                    spec,
+                    config,
+                    dtype,
+                    epoch_impl=epoch_impl,
+                    consensus_impl=consensus_impl,
+                    save_bonds=save_bonds,
+                    save_incentives=save_incentives,
+                    save_consensus=save_consensus,
+                    streaming=True,
+                )
+                plan.record()
+                state["plan"] = plan
+            cap = state["plan"].memory.chunk_epochs
+            n = int(np.shape(Wc)[0])
+            if cap is None or n <= cap:
+                yield Wc, Sc
+            else:
+                for lo in range(0, n, cap):
+                    yield Wc[lo : lo + cap], Sc[lo : lo + cap]
 
-    for Wc, Sc in chunks:
-        Wc = jnp.asarray(Wc, dtype)
-        Sc = jnp.asarray(Sc, dtype)
-        if Wc.ndim != 3:
-            raise ValueError(
-                f"streamed chunks must be [E_chunk, V, M], got {Wc.shape}"
-            )
-        if impl is None:
-            # Same resolution as simulate(), decided once on the first
-            # chunk (eligibility depends on [V, M]/mode/config, not the
-            # chunk length) and pinned for the whole stream — mixing
-            # engines across chunks would break bitwise equality with
-            # the monolithic run.
-            impl, xla_consensus = _resolve_case_engine(
-                epoch_impl, consensus_impl, Wc.shape, spec, config, dtype,
-                save_bonds, streaming=True,
-            )
-            # A zeros carry is bitwise the kernels' own epoch-0 init, and
-            # keeps chunk 0 on the SAME compiled program as every later
-            # chunk (a carry=None first dispatch would compile a second
-            # kernel variant for no numerical difference).
-            carry = zero_carry(spec, Wc.shape[-2], Wc.shape[-1], dtype)
+    def stage(pair):
+        """Start the host->HBM transfer of one slab NOW (async):
+        `jnp.asarray` commits the slab to the default device and kicks
+        off the copy — the transfer the double-buffer overlaps with the
+        in-flight scan."""
+        Wc, Sc = pair
+        return jnp.asarray(Wc, dtype), jnp.asarray(Sc, dtype)
+
+    def dispatch(Wc, Sc, carry, offset):
+        impl = state["plan"].engine
         if impl in ("fused_scan", "fused_scan_mxu"):
             faults.maybe_fail_fused_dispatch()
-            ys, carry = _simulate_case_fused(
+            return _simulate_case_fused_streamed(
                 Wc,
                 Sc,
                 ri,
@@ -1226,25 +1237,55 @@ def _simulate_streamed_attempt(
                 epoch_offset=offset,
                 return_carry=True,
             )
-        else:
-            ys, carry = _simulate_scan(
-                Wc,
-                Sc,
-                ri,
-                re_,
-                config,
-                spec,
-                save_bonds=save_bonds,
-                save_incentives=save_incentives,
-                save_consensus=save_consensus,
-                consensus_impl=xla_consensus,
-                carry=carry,
-                epoch_offset=offset,
-                return_carry=True,
-            )
+        return _simulate_scan_streamed(
+            Wc,
+            Sc,
+            ri,
+            re_,
+            config,
+            spec,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=save_consensus,
+            consensus_impl=state["plan"].consensus_impl,
+            carry=carry,
+            epoch_offset=offset,
+            return_carry=True,
+        )
+
+    def _flush(ys):
+        # Materialize a chunk's outputs to numpy, dropping the device
+        # buffers: keeping every chunk's [Ec, V, M] outputs alive as
+        # jax.Arrays until the end would accumulate exactly the
+        # beyond-HBM history streaming exists to avoid. The async copy
+        # was started when the chunk was dispatched, so this wait
+        # overlaps the NEXT chunk's compute, not this one's.
+        for k, acc in host.items():
+            acc.append(np.asarray(ys[k]))
+
+    it = slabs()
+    cur = next(it, None)
+    if cur is None:
+        raise ValueError("simulate_streamed received no chunks")
+    cur = stage(cur)
+    # A zeros carry is bitwise the kernels' own epoch-0 init, and keeps
+    # chunk 0 on the SAME compiled program as every later chunk (a
+    # carry=None first dispatch would compile a second kernel variant
+    # for no numerical difference).
+    carry = zero_carry(spec, cur[0].shape[-2], cur[0].shape[-1], dtype)
+    offset = 0
+    pending: Optional[dict] = None
+    while cur is not None:
+        Wc, Sc = cur
+        n_epochs = int(Wc.shape[0])
+        ys, carry = dispatch(Wc, Sc, carry, offset)  # async
+        cur = None  # drop our slab ref; the device frees it after use
+        nxt = next(it, None)  # may BUILD the next slab (host generator)
+        if nxt is not None:
+            nxt = stage(nxt)  # transfer k+1 overlaps the scan over k
         if block_per_chunk:
             ys, carry = jax.block_until_ready((ys, carry))
-        offset += Wc.shape[0]
+        offset += n_epochs
         for k in host:
             try:
                 ys[k].copy_to_host_async()
@@ -1253,9 +1294,7 @@ def _simulate_streamed_attempt(
         if pending is not None:
             _flush(pending)
         pending = ys
-
-    if impl is None:
-        raise ValueError("simulate_streamed received no chunks")
+        cur = nxt
     _flush(pending)
     cat = {k: np.concatenate(v) for k, v in host.items()}
     return SimulationResult(
@@ -1360,10 +1399,22 @@ def simulate_generated(
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
     W0, _ = jax.eval_shape(gen_fn, jnp.int32(0))
-    impl, consensus_impl = _resolve_case_engine(
-        epoch_impl, consensus_impl, W0.shape, spec, config, W0.dtype, False,
+    # check_memory=False: the generated chunks never exist on the host
+    # and XLA's buffer assignment holds one [CH, V, M] slab regardless
+    # of num_chunks — the preflight's epoch-stack model does not apply.
+    plan = plan_dispatch(
+        f"generated:{yuma_version}",
+        W0.shape,
+        spec,
+        config,
+        W0.dtype,
+        epoch_impl=epoch_impl,
+        consensus_impl=consensus_impl,
         streaming=True,
+        check_memory=False,
     )
+    plan.record()
+    impl, consensus_impl = plan.engine, plan.consensus_impl
     D, B = _simulate_generated_run(
         config, gen_fn, spec, num_chunks, impl, consensus_impl
     )
@@ -1437,25 +1488,13 @@ def simulate_scaled(
         return _dividends_per_1k(D_n, S, config, dtype)
 
     if epoch_impl == "auto":
-        from yuma_simulation_tpu.ops.pallas_epoch import (
-            exact_mxu_support_covers,
-            fused_scan_eligible,
+        # The planner's one scaled-path resolution (trace-time host
+        # arithmetic): the exact-MXU scan where the limb split covers V,
+        # the VPU scan where VMEM admits it, else XLA. E=0 falls back to
+        # XLA, which returns zeros.
+        epoch_impl = resolve_scaled_engine(
+            W.shape, spec.bonds_mode, config, W.dtype, scales.shape[0]
         )
-
-        # Since r4 the MXU scan's consensus support is EXACT (limb-split
-        # integer contraction) and the whole scan is bitwise the VPU
-        # scan, so auto prefers it wherever the limb split covers V.
-        # E=0 falls back to XLA, which returns zeros.
-        if scales.shape[0] >= 1 and fused_scan_eligible(
-            W.shape, spec.bonds_mode, config, W.dtype
-        ):
-            epoch_impl = (
-                "fused_scan_mxu"
-                if exact_mxu_support_covers(V)
-                else "fused_scan"
-            )
-        else:
-            epoch_impl = "xla"
 
     if epoch_impl in ("fused_scan", "fused_scan_mxu"):
         from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
@@ -1610,21 +1649,9 @@ def simulate_scaled_batch(
     consensus_impl = resolve_consensus_impl(consensus_impl, *W.shape[-2:])
     batched_cfg = config_is_batched(config)
     if epoch_impl == "auto":
-        from yuma_simulation_tpu.ops.pallas_epoch import (
-            exact_mxu_support_covers,
-            fused_scan_eligible,
+        epoch_impl = resolve_scaled_engine(
+            W.shape, spec.bonds_mode, config, W.dtype, scales.shape[0]
         )
-
-        if scales.shape[0] >= 1 and fused_scan_eligible(
-            W.shape, spec.bonds_mode, config, W.dtype
-        ):
-            epoch_impl = (
-                "fused_scan_mxu"
-                if exact_mxu_support_covers(W.shape[-2])
-                else "fused_scan"
-            )
-        else:
-            epoch_impl = "xla"
     if epoch_impl in ("fused_scan", "fused_scan_mxu"):
         from yuma_simulation_tpu.ops.pallas_epoch import fused_ema_scan
 

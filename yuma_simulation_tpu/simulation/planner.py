@@ -1,0 +1,635 @@
+"""DispatchPlan: the one dispatch-planning decision for every engine path.
+
+Before this module, the decisions that turn "a workload shape" into "a
+compiled program on a device" — engine-rung choice, fused-kernel VMEM
+admission, HBM preflight, ladder-rung eligibility, shape padding — were
+duplicated across `simulation/engine.py` (`_resolve_case_engine` + an
+inline preflight), `simulation/sweep.py` (a second auto-resolution block
+for the batched scan), `parallel/sharded.py` (a third preflight with
+different lane accounting), and `ops/pallas_epoch.py` (the eligibility
+predicates each caller re-combined by hand). Every consumer now asks
+:func:`plan_dispatch` once and receives a :class:`DispatchPlan`:
+
+    shape bucket -> engine rung -> sharding layout -> memory plan
+                 -> (optional) AOT cost estimate
+
+- **shape bucket** (:class:`ShapeBucket`): the tile-aligned `[Vp, Mp]`
+  target the donor-packing path pads small suites to (sublane 8 x lane
+  128 — one MXU tile minimum), so heterogeneous suites ride ONE batched
+  dispatch on a REUSED compiled shape instead of one program per ragged
+  shape. Epochs are deliberately not bucketed: the epoch axis is data
+  length, and masking it would change results.
+- **engine rung**: the single "auto" resolution (fused_scan_mxu ->
+  fused_scan -> xla) with every admission rule in one place, plus the
+  resolved consensus impl for the chosen rung AND the XLA fallback
+  consensus a ladder demotion needs.
+- **ladder**: the rungs at and below the chosen engine —
+  :func:`ladder_from` lives HERE now; `resilience.retry` re-exports it,
+  so rung eligibility has one owner.
+- **memory plan** (:class:`MemoryPlan`): the analytic HBM preflight
+  verdict (`telemetry.cost`, zero compiles) plus the slab length the
+  double-buffered streaming driver should use (`chunk_epochs`, sized so
+  TWO slabs — the one computing and the one transferring — fit the
+  device together).
+- **AOT cost estimate**: opt-in via :meth:`DispatchPlan.attach_cost`
+  (it compiles a program, so it never runs on the hot path).
+
+Plans are frozen, deterministic, pure-host values: the same inputs
+always produce an identical plan (pinned by
+tests/unit/test_planner.py), and planning adds zero compiles (pinned by
+tests/unit/test_recompilation.py). :meth:`DispatchPlan.record` emits
+one structured ``event=dispatch_planned`` record and stamps a compact
+summary on the open telemetry span, so flight bundles show *why* each
+rung ran — it self-guards with the is-tracing check, because
+`simulate_batch` re-enters planning inside the `shard_map` trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+#: The full case-scan ladder, most- to least-demanding. An explicitly
+#: requested engine starts at its own rung and may only walk DOWN —
+#: demotion must never silently upgrade a run onto an engine the caller
+#: did not ask for. (Moved here from `resilience.retry`, which
+#: re-exports it: rung eligibility and rung ordering are one decision.)
+ENGINE_LADDER = ("fused_scan_mxu", "fused_scan", "xla")
+
+#: Tile geometry the donor-packing bucket targets: the VPU/MXU operate
+#: on (8, 128) f32 tiles, so a padded batch below these bounds wastes
+#: the very lanes packing exists to fill.
+SUBLANE_TILE = 8
+LANE_TILE = 128
+
+#: How many epoch slabs the double-buffered streaming driver keeps live
+#: at once: the slab being scanned plus the slab being transferred.
+STREAM_BUFFERS = 2
+
+
+def ladder_from(engine: str) -> tuple:
+    """The rungs at and below `engine`, in demotion order. Unknown
+    engines (e.g. the throughput paths' "fused"/"hoisted") get a
+    single-rung ladder: retry in place, never demote onto a path with
+    different output semantics."""
+    if engine in ENGINE_LADDER:
+        return ENGINE_LADDER[ENGINE_LADDER.index(engine):]
+    return (engine,)
+
+
+# ---------------------------------------------------------------------------
+# plan components
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeBucket:
+    """The compiled-shape target for one dispatch. `V`/`M` are the
+    workload's real axes; `padded_V`/`padded_M` the tile-aligned bucket
+    the donor-packing path pads to (equal to `V`/`M` when already
+    aligned). `batch` counts scenario lanes (1 = unbatched)."""
+
+    batch: int
+    epochs: int
+    V: int
+    M: int
+    padded_V: int
+    padded_M: int
+
+    @property
+    def key(self) -> str:
+        """The compile-cache-aligned bucket key: two suites with the
+        same key trace the same batched program."""
+        return (
+            f"b{self.batch}e{self.epochs}"
+            f"v{self.padded_V}m{self.padded_M}"
+        )
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _round_up(n: int, mult: int) -> int:
+    return max(mult, -(-int(n) // mult) * mult)
+
+
+def bucket_shape(
+    V: int, M: int, *, epochs: int = 0, batch: int = 1
+) -> ShapeBucket:
+    """Tile-align `[V, M]` to the (8, 128) f32 tile — the donor-packing
+    target. Padding is semantically inert by the same mechanism
+    `pad_scenarios` proves: zero stakes for padded validators, a miner
+    mask excluding padded columns from the consensus grid."""
+    return ShapeBucket(
+        batch=int(batch),
+        epochs=int(epochs),
+        V=int(V),
+        M=int(M),
+        padded_V=_round_up(V, SUBLANE_TILE),
+        padded_M=_round_up(M, LANE_TILE),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryPlan:
+    """The analytic memory decision for one dispatch — the HBM
+    preflight verdict plus the streaming slab length.
+
+    ``fits`` is None when device capacity is unknown (every CPU build):
+    the preflight passes open rather than guessing. ``chunk_epochs`` is
+    the per-slab epoch count the double-buffered streaming driver
+    should cap slabs at — sized so :data:`STREAM_BUFFERS` slabs plus
+    the `[V, M]` working set fit the budget — or None when the whole
+    stack fits monolithically (or capacity is unknown)."""
+
+    predicted_bytes: int
+    capacity_bytes: Optional[int]
+    fits: Optional[bool]
+    resident_epochs: int
+    chunk_epochs: Optional[int]
+    double_buffered: bool
+    suggestion: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchPlan:
+    """One dispatch, fully decided. Frozen and deterministic: equal
+    inputs to :func:`plan_dispatch` yield an equal plan."""
+
+    label: str
+    engine: str
+    #: Consensus impl resolved FOR the chosen engine ("bisect" on the
+    #: fused rungs — they bisect in-kernel).
+    consensus_impl: str
+    #: Consensus impl a ladder demotion onto the XLA rung must use —
+    #: resolved from the caller's request exactly as a direct XLA
+    #: request would have been.
+    fallback_consensus: str
+    ladder: tuple
+    bucket: ShapeBucket
+    miner_shards: int
+    batch_lanes: int
+    memory: MemoryPlan
+    #: Why each decision fell the way it did, in decision order.
+    reasons: tuple
+    #: Optional AOT cost estimate (a `telemetry.cost.CostRecord` dict);
+    #: populated only by :meth:`attach_cost` — never on the hot path.
+    cost: Optional[dict] = None
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["ladder"] = list(self.ladder)
+        out["reasons"] = list(self.reasons)
+        return out
+
+    def span_attr(self) -> dict:
+        """The compact summary stamped on telemetry spans (flat,
+        JSON-able — span attrs are rendered inline by obsreport)."""
+        attr = {
+            "engine": self.engine,
+            "consensus": self.consensus_impl,
+            "bucket": self.bucket.key,
+            "shards": self.miner_shards,
+            "lanes": self.batch_lanes,
+            "why": "; ".join(self.reasons),
+        }
+        if self.memory.predicted_bytes:
+            # Engine-only plans (check_memory=False) carry no footprint;
+            # a literal 0.0 GiB would read as a measurement.
+            attr["hbm_gib"] = round(self.memory.predicted_bytes / 2**30, 3)
+            attr["fits"] = self.memory.fits
+            attr["chunk_epochs"] = self.memory.chunk_epochs
+        return attr
+
+    def record(self) -> None:
+        """Emit one ``event=dispatch_planned`` record and stamp the
+        plan summary on the open telemetry span. Inert at trace time
+        (`simulate_batch` re-plans inside the `shard_map` trace) — the
+        host-side log/span machinery must not bake into a program."""
+        from yuma_simulation_tpu.telemetry.runctx import (
+            _tracing_now,
+            current_span,
+        )
+
+        if _tracing_now():
+            return
+        attr = self.span_attr()
+        s = current_span()
+        if s is not None:
+            s.attrs["plan"] = attr
+        from yuma_simulation_tpu.utils.logging import log_event
+
+        log_event(
+            logger,
+            "dispatch_planned",
+            level=logging.DEBUG,
+            label=self.label,
+            **{k: v for k, v in attr.items() if v is not None},
+        )
+
+    def attach_cost(self, yuma_version: str = "Yuma 1 (paper)") -> "DispatchPlan":
+        """A copy of this plan with the chosen rung's AOT cost record
+        attached (`telemetry.cost.capture_engine_cost`). COMPILES a
+        program — explicit-call only (tools, the supervisor's opt-in
+        capture), never the hot path."""
+        from yuma_simulation_tpu.telemetry.cost import capture_engine_cost
+
+        rec = capture_engine_cost(
+            self.engine,
+            self.bucket.V,
+            self.bucket.M,
+            max(1, self.bucket.epochs),
+            yuma_version=yuma_version,
+        )
+        return dataclasses.replace(self, cost=rec.to_json())
+
+
+# ---------------------------------------------------------------------------
+# the planner
+
+
+def _resolve_spec(spec_or_version):
+    from yuma_simulation_tpu.models.variants import (
+        VariantSpec,
+        variant_for_version,
+    )
+
+    if isinstance(spec_or_version, VariantSpec):
+        return spec_or_version
+    return variant_for_version(spec_or_version)
+
+
+def _plan_engine(
+    epoch_impl: str,
+    consensus_impl: str,
+    shape: Sequence[int],
+    spec,
+    config,
+    dtype,
+    save_bonds: bool,
+    mesh,
+    streaming: bool,
+    quarantine: bool,
+    has_miner_mask: bool,
+    reasons: list,
+) -> tuple[str, str]:
+    """The ONE engine/consensus resolution for every case-scan entry
+    point (`simulate`, `simulate_streamed`, `simulate_generated`,
+    `simulate_batch`): "auto" becomes the fused Pallas scan when
+    eligible (MXU variant wherever the exact limb split covers V) else
+    the XLA scan; the fused engines reject `consensus_impl="sorted"`
+    (they bisect in-kernel), miner-sharding meshes, per-scenario miner
+    masks, and the quarantine guard; the XLA engine resolves "auto"
+    consensus to the shape-gated sorted/bisect default. Returns
+    `(engine, consensus_impl)` fully resolved."""
+    if consensus_impl not in ("auto", "sorted", "bisect"):
+        raise ValueError(
+            f"unknown consensus_impl {consensus_impl!r}; "
+            "expected 'auto', 'sorted' or 'bisect'"
+        )
+    batched = len(shape) == 4
+    if epoch_impl == "auto":
+        from yuma_simulation_tpu.ops.pallas_epoch import (
+            exact_mxu_support_covers,
+            fused_case_scan_eligible,
+        )
+
+        epochs = shape[1] if batched else shape[0]
+        if (
+            mesh is None
+            and not quarantine
+            and not has_miner_mask
+            and consensus_impl in ("auto", "bisect")
+            and epochs >= 1
+            and fused_case_scan_eligible(
+                tuple(shape), spec.bonds_mode, config, dtype, save_bonds,
+                streaming=streaming,
+            )
+        ):
+            # Since r4 the MXU scan's consensus support is EXACT (the
+            # limb-split integer contraction, ~1.6x the VPU scan) and
+            # the whole scan is bitwise the VPU scan, so auto prefers
+            # it wherever the limb split covers V.
+            mxu = exact_mxu_support_covers(shape[-2])
+            epoch_impl = "fused_scan_mxu" if mxu else "fused_scan"
+            reasons.append(
+                f"auto->{epoch_impl}: fused case scan eligible"
+                + ("" if mxu else f" (limb split stops below V={shape[-2]})")
+            )
+        else:
+            epoch_impl = "xla"
+            reasons.append(
+                "auto->xla: "
+                + (
+                    "miner-sharding mesh"
+                    if mesh is not None
+                    else "quarantine guard rides the XLA carry"
+                    if quarantine
+                    else "per-scenario miner mask"
+                    if has_miner_mask
+                    else f"consensus_impl={consensus_impl!r}"
+                    if consensus_impl not in ("auto", "bisect")
+                    else "zero epochs"
+                    if epochs < 1
+                    else "fused case scan ineligible "
+                    "(backend/dtype/mode/VMEM)"
+                )
+            )
+    if epoch_impl in ("fused_scan", "fused_scan_mxu"):
+        if mesh is not None:
+            raise ValueError(
+                "the fused case scan is a single-core Pallas program; "
+                "miner-axis sharding requires epoch_impl='xla'"
+            )
+        if quarantine:
+            raise ValueError(
+                "quarantine rides the XLA scan carry; the fused case scan "
+                "cannot host it — use epoch_impl='xla' (or 'auto', which "
+                "resolves to 'xla' under quarantine)"
+            )
+        if has_miner_mask:
+            raise ValueError(
+                "the batched fused case scan has no per-scenario miner "
+                "masks; heterogeneous suites use epoch_impl='xla'"
+            )
+        if consensus_impl == "sorted":
+            raise ValueError(
+                "the fused case scan computes consensus by bisection; "
+                "consensus_impl='sorted' requires epoch_impl='xla'"
+            )
+        return epoch_impl, consensus_impl
+    if epoch_impl != "xla":
+        raise ValueError(
+            f"unknown epoch_impl {epoch_impl!r}; "
+            "expected 'auto', 'xla', 'fused_scan' or 'fused_scan_mxu'"
+        )
+    from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
+
+    return "xla", resolve_consensus_impl(consensus_impl, *shape[-2:])
+
+
+def _plan_memory(
+    label: str,
+    V: int,
+    M: int,
+    epochs: int,
+    itemsize: int,
+    *,
+    save_bonds: bool,
+    save_incentives: bool,
+    save_consensus: bool,
+    miner_shards: int,
+    batch_lanes: int,
+    max_resident_epochs: Optional[int],
+    streaming: bool,
+    raise_on_reject: bool,
+) -> MemoryPlan:
+    """The analytic memory half of the plan: preflight the resident
+    footprint and size the streaming slab. Pure host arithmetic
+    (`telemetry.cost.estimate_hbm_bytes`) — zero compiles, zero
+    allocation, exactly the hot-path discipline the preflight has
+    always kept."""
+    from yuma_simulation_tpu.telemetry.cost import (
+        DEFAULT_MEMORY_FRACTION,
+        estimate_hbm_bytes,
+        preflight_hbm,
+        resolve_device_spec,
+    )
+
+    resident = (
+        min(epochs, max_resident_epochs)
+        if max_resident_epochs is not None
+        else epochs
+    )
+    kwargs = dict(
+        itemsize=itemsize,
+        save_bonds=save_bonds,
+        save_incentives=save_incentives,
+        save_consensus=save_consensus,
+        miner_shards=miner_shards,
+        batch_lanes=batch_lanes,
+    )
+    estimate = estimate_hbm_bytes(V, M, resident_epochs=resident, **kwargs)
+    verdict = preflight_hbm(
+        label,
+        estimate,
+        raise_on_reject=raise_on_reject and not streaming,
+    )
+    # Slab sizing for the double-buffered streaming driver: per-epoch
+    # bytes from a 1-epoch estimate minus the fixed working set, then
+    # chunk = (budget - fixed) / (STREAM_BUFFERS * per_epoch) so the
+    # computing slab and the in-flight transfer fit together. Gated on
+    # preflight_enabled(): YUMA_TPU_PREFLIGHT=0 is the documented "the
+    # analytic model mis-models my device" escape hatch, and it must
+    # disable slab re-slicing exactly as it disables rejection.
+    chunk_epochs: Optional[int] = None
+    from yuma_simulation_tpu.telemetry.cost import preflight_enabled
+
+    spec = resolve_device_spec()
+    if spec.memory_bytes and preflight_enabled():
+        budget = int(spec.memory_bytes * DEFAULT_MEMORY_FRACTION)
+        one = estimate_hbm_bytes(V, M, resident_epochs=1, **kwargs)
+        zero = estimate_hbm_bytes(V, M, resident_epochs=0, **kwargs)
+        per_epoch = max(1, one.total_bytes - zero.total_bytes)
+        fixed = zero.total_bytes
+        if budget > fixed:
+            chunk_epochs = max(
+                1, (budget - fixed) // (STREAM_BUFFERS * per_epoch)
+            )
+        elif streaming:
+            # The FIXED [V, M] working set alone exceeds the budget: no
+            # slab length can fix that, so a streaming plan rejects here
+            # exactly like a monolithic one (typed event + error) —
+            # streaming must not swallow a deterministic cannot-fit.
+            preflight_hbm(
+                label, zero, raise_on_reject=raise_on_reject
+            )
+            chunk_epochs = 1
+        if not streaming and verdict.fits is not False:
+            # Monolithic dispatch that fits: no slabbing needed.
+            chunk_epochs = None
+    return MemoryPlan(
+        predicted_bytes=estimate.total_bytes,
+        capacity_bytes=verdict.capacity_bytes,
+        fits=verdict.fits,
+        resident_epochs=resident,
+        chunk_epochs=chunk_epochs,
+        double_buffered=streaming,
+        suggestion=verdict.suggestion,
+    )
+
+
+def plan_dispatch(
+    label: str,
+    shape: Sequence[int],
+    spec_or_version,
+    config,
+    dtype,
+    *,
+    epoch_impl: str = "auto",
+    consensus_impl: str = "bisect",
+    save_bonds: bool = False,
+    save_incentives: bool = False,
+    save_consensus: bool = False,
+    mesh=None,
+    streaming: bool = False,
+    quarantine: bool = False,
+    has_miner_mask: bool = False,
+    max_resident_epochs: Optional[int] = None,
+    check_memory: bool = True,
+    raise_on_reject: bool = True,
+) -> DispatchPlan:
+    """Plan one case-scan dispatch. `shape` is `[E, V, M]` or a batched
+    `[B, E, V, M]`. Raises exactly the errors the legacy per-caller
+    resolution raised (bad impl names, fused-rung preconditions,
+    `telemetry.cost.HBMPreflightError` on an unfittable monolithic
+    shape); streaming plans never raise on footprint — they size
+    `memory.chunk_epochs` instead, which is the whole point of
+    streaming.
+
+    `check_memory=False` skips the preflight/slab arithmetic (the
+    trace-re-entrant `simulate_batch` path: its memory is accounted at
+    the entry point that placed the arrays).
+    """
+    import jax.numpy as jnp
+
+    shape = tuple(int(d) for d in shape)
+    if len(shape) == 3:
+        batch, (E, V, M) = 1, shape
+    elif len(shape) == 4:
+        batch, E, V, M = shape
+    else:
+        raise ValueError(
+            f"plan_dispatch expects [E, V, M] or [B, E, V, M], got {shape}"
+        )
+    spec = _resolve_spec(spec_or_version)
+    reasons: list = []
+    if epoch_impl != "auto":
+        reasons.append(f"engine {epoch_impl!r} requested explicitly")
+    engine, resolved_consensus = _plan_engine(
+        epoch_impl,
+        consensus_impl,
+        shape,
+        spec,
+        config,
+        dtype,
+        save_bonds,
+        mesh,
+        streaming,
+        quarantine,
+        has_miner_mask,
+        reasons,
+    )
+    # The XLA-rung consensus a ladder demotion needs: the fused
+    # resolution leaves the request untouched ("auto"/"bisect"); resolve
+    # it for the XLA engine exactly as a direct request would have been.
+    if engine == "xla":
+        fallback_consensus = resolved_consensus
+    else:
+        from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
+
+        fallback_consensus = resolve_consensus_impl(consensus_impl, V, M)
+    miner_shards = (
+        1 if mesh is None else int(mesh.shape[mesh.axis_names[-1]])
+    )
+    if miner_shards > 1:
+        reasons.append(f"miner axis sharded over {miner_shards} devices")
+    if check_memory:
+        memory = _plan_memory(
+            label,
+            V,
+            M,
+            E,
+            jnp.dtype(dtype).itemsize,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=save_consensus,
+            miner_shards=miner_shards,
+            batch_lanes=batch,
+            max_resident_epochs=max_resident_epochs,
+            streaming=streaming,
+            raise_on_reject=raise_on_reject,
+        )
+        if streaming and memory.chunk_epochs is not None:
+            reasons.append(
+                f"streaming slabs capped at {memory.chunk_epochs} epochs "
+                f"({STREAM_BUFFERS} buffers resident)"
+            )
+    else:
+        memory = MemoryPlan(
+            predicted_bytes=0,
+            capacity_bytes=None,
+            fits=None,
+            resident_epochs=E,
+            chunk_epochs=None,
+            double_buffered=streaming,
+        )
+    if max_resident_epochs is not None and streaming is False and E > max_resident_epochs:
+        reasons.append(
+            f"caller caps residency at {max_resident_epochs} epochs"
+        )
+    return DispatchPlan(
+        label=label,
+        engine=engine,
+        consensus_impl=resolved_consensus,
+        fallback_consensus=fallback_consensus,
+        ladder=ladder_from(engine),
+        bucket=bucket_shape(V, M, epochs=E, batch=batch),
+        miner_shards=miner_shards,
+        batch_lanes=batch,
+        memory=memory,
+        reasons=tuple(reasons),
+    )
+
+
+# ---------------------------------------------------------------------------
+# throughput-path resolutions (simulate_scaled / simulate_scaled_batch /
+# montecarlo) — previously inline auto blocks in engine.py and sharded.py
+
+
+def resolve_scaled_engine(
+    shape: Sequence[int], mode, config, dtype, num_epochs: int
+) -> str:
+    """The `epoch_impl="auto"` resolution for the scalar-scaled
+    throughput paths (`simulate_scaled` / `simulate_scaled_batch`):
+    the exact-MXU fused scan where the limb split covers V, the VPU
+    scan where VMEM admits it, else the XLA scan. Trace-time host
+    arithmetic (both callers are jitted)."""
+    from yuma_simulation_tpu.ops.pallas_epoch import (
+        exact_mxu_support_covers,
+        fused_scan_eligible,
+    )
+
+    if num_epochs >= 1 and fused_scan_eligible(
+        tuple(shape), mode, config, dtype
+    ):
+        return (
+            "fused_scan_mxu"
+            if exact_mxu_support_covers(shape[-2])
+            else "fused_scan"
+        )
+    return "xla"
+
+
+def resolve_montecarlo_engine(epoch_impl: str, varying: bool) -> str:
+    """The Monte-Carlo `epoch_impl="auto"` resolution: hoisted for
+    epoch-constant weights (consensus runs once), the full per-epoch
+    XLA kernel for `weights_mode="per_epoch"` (nothing is hoistable)."""
+    if epoch_impl == "auto":
+        return "xla" if varying else "hoisted"
+    if epoch_impl not in ("hoisted", "xla"):
+        raise ValueError(
+            f"unknown epoch_impl {epoch_impl!r}; "
+            "expected 'auto', 'hoisted' or 'xla'"
+        )
+    if varying and epoch_impl == "hoisted":
+        raise ValueError(
+            "weights_mode='per_epoch' re-perturbs the weights every "
+            "epoch; nothing is hoistable — use epoch_impl='xla'/'auto'"
+        )
+    return epoch_impl

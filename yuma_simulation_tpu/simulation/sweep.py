@@ -48,7 +48,12 @@ def _reset_metadata(scenarios: Sequence[Scenario]):
     return r_idx, r_epoch
 
 
-def pad_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
+def pad_scenarios(
+    scenarios: Sequence[Scenario],
+    dtype=jnp.float32,
+    *,
+    pack_tiles: bool = False,
+):
     """Pad a heterogeneous suite to a shared `[B, E, V, M]` shape.
 
     Padding is appended: extra epochs get zero weights *and* zero stakes
@@ -59,12 +64,27 @@ def pad_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
     (SURVEY.md §7 hard part (e): a padded column must not perturb the u16
     grid of real miners).
 
+    `pack_tiles=True` is DONOR PACKING (the planner's shape-bucket
+    policy, :func:`..simulation.planner.bucket_shape`): the shared shape
+    is additionally rounded up to the (8, 128) f32 tile, so a small
+    suite fills the vector/matrix unit's lanes instead of wasting them
+    AND every suite whose raw shapes fall in the same bucket reuses one
+    compiled batched program instead of tracing a program per ragged
+    shape. The extra rows/columns ride exactly the padding mechanism
+    above (zero stakes, mask-excluded miners), so packing is inert per
+    lane — pinned by tests/unit/test_planner.py.
+
     Returns `(W[B,E,V,M], S[B,E,V], reset_index[B], reset_epoch[B],
     miner_mask[B,M])`.
     """
     E = max(s.weights.shape[0] for s in scenarios)
     V = max(s.weights.shape[1] for s in scenarios)
     M = max(s.weights.shape[2] for s in scenarios)
+    if pack_tiles:
+        from yuma_simulation_tpu.simulation.planner import bucket_shape
+
+        bucket = bucket_shape(V, M, epochs=E, batch=len(scenarios))
+        V, M = bucket.padded_V, bucket.padded_M
     B = len(scenarios)
     W = np.zeros((B, E, V, M), np.float32)
     S = np.zeros((B, E, V), np.float32)
@@ -82,6 +102,16 @@ def pad_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
         r_epoch,
         jnp.asarray(mask, dtype),
     )
+
+
+def pack_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
+    """Donor packing: one MXU-tile-filling padded batch for a small or
+    heterogeneous suite — :func:`pad_scenarios` with the planner's
+    tile-bucket policy on. The name is the contract: small scenarios
+    donate their idle tile lanes to each other so the whole suite rides
+    ONE batched dispatch on a bucket-reused compiled shape, instead of
+    one dispatch (and one compiled program) per ragged case."""
+    return pad_scenarios(scenarios, dtype, pack_tiles=True)
 
 
 def stack_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
@@ -210,63 +240,35 @@ def simulate_batch(
     `is None` checks when unarmed.
     """
     from yuma_simulation_tpu.resilience import faults
+    from yuma_simulation_tpu.simulation.planner import plan_dispatch
 
-    if quarantine and epoch_impl in ("fused_scan", "fused_scan_mxu"):
-        raise ValueError(
-            "quarantine rides the XLA scan carry; the fused case scan "
-            "cannot host it — use epoch_impl='xla' (or 'auto', which "
-            "resolves to 'xla' under quarantine)"
-        )
-    if epoch_impl == "auto":
-        from yuma_simulation_tpu.ops.pallas_epoch import (
-            exact_mxu_support_covers,
-            fused_case_scan_eligible,
-        )
-
-        # r4 measured a small-shape crossover (131 vs 177 ms for the
-        # 9x14 case matrix) and gated the fused scan behind a ~2^19-cell
-        # threshold. Re-measured in r5 after the kernel-closure
-        # memoization: warm dispatches at the built-in suite shape are
-        # tunnel-RTT-bound and equal within noise (118.2 vs 118.6 ms per
-        # single-version dispatch; 3.10 vs 3.14 s for the full 9-version
-        # suite with all outputs fetched), while large shapes remain
-        # ~1.5x faster fused — so auto now prefers the flagship engine
-        # whenever it is eligible, and the production chart/CSV paths
-        # ride it too (r4 verdict item 6).
-        if (
-            not quarantine
-            and miner_mask is None
-            and consensus_impl in ("auto", "bisect")
-            and weights.shape[1] >= 1
-            and fused_case_scan_eligible(
-                weights.shape, spec.bonds_mode, config, weights.dtype,
-                save_bonds,
-            )
-        ):
-            epoch_impl = (
-                "fused_scan_mxu"
-                if exact_mxu_support_covers(weights.shape[-2])
-                else "fused_scan"
-            )
-        else:
-            epoch_impl = "xla"
-    if epoch_impl in ("fused_scan", "fused_scan_mxu"):
-        if miner_mask is not None:
-            raise ValueError(
-                "the batched fused case scan has no per-scenario miner "
-                "masks; heterogeneous suites use epoch_impl='xla'"
-            )
-        if consensus_impl not in ("auto", "bisect"):
-            raise ValueError(
-                "the fused case scan computes consensus by bisection; "
-                f"consensus_impl={consensus_impl!r} requires "
-                "epoch_impl='xla'"
-            )
-    elif epoch_impl != "xla":
-        raise ValueError(
-            f"unknown epoch_impl {epoch_impl!r} for simulate_batch; "
-            "expected 'auto', 'xla', 'fused_scan' or 'fused_scan_mxu'"
-        )
+    # The one dispatch plan (simulation.planner), shared with simulate/
+    # simulate_streamed: "auto" prefers the flagship fused batched scan
+    # whenever it is eligible (r4 measured a small-shape crossover; r5
+    # re-measured it gone after the kernel-closure memoization — warm
+    # dispatches at the built-in suite shape are tunnel-RTT-bound and
+    # equal within noise, large shapes ~1.5x faster fused), and every
+    # fused-rung precondition (no quarantine guard, no per-scenario
+    # miner masks, bisect-only consensus) is enforced in ONE place.
+    # check_memory=False: this wrapper is re-entered at trace time by
+    # the sharded shard_map body — memory is accounted (and preflighted)
+    # by whichever entry point placed the arrays.
+    plan = plan_dispatch(
+        "simulate_batch",
+        weights.shape,
+        spec,
+        config,
+        weights.dtype,
+        epoch_impl=epoch_impl,
+        consensus_impl=consensus_impl,
+        save_bonds=save_bonds,
+        save_incentives=save_incentives,
+        quarantine=quarantine,
+        has_miner_mask=miner_mask is not None,
+        check_memory=False,
+    )
+    plan.record()
+    epoch_impl = plan.engine
 
     def _dispatch(rung: str):
         # Profiler step annotation for Perfetto<->ledger alignment.
@@ -298,16 +300,10 @@ def simulate_batch(
                 mxu=rung == "fused_scan_mxu",
             )
         else:
-            cons = consensus_impl
-            if cons == "auto":
-                # An "auto" request (always the case when demoted off a
-                # fused rung, whose checks admit only auto/bisect):
-                # resolve for the XLA engine exactly as simulate() does.
-                from yuma_simulation_tpu.ops.consensus import (
-                    resolve_consensus_impl,
-                )
-
-                cons = resolve_consensus_impl(cons, *weights.shape[-2:])
+            # The plan pre-resolved the XLA-rung consensus — both for a
+            # direct XLA dispatch and for a demotion off a fused rung
+            # (whose checks admit only auto/bisect requests).
+            cons = plan.fallback_consensus
             nf = faults.active_nan_fault()
             nf_epochs = None
             if nf is not None:
@@ -347,8 +343,8 @@ def simulate_batch(
     from yuma_simulation_tpu.resilience.retry import run_ladder
 
     ys, _, _ = run_ladder(
-        _dispatch, epoch_impl, retry_policy, label="simulate_batch",
-        deadline=deadline,
+        _dispatch, epoch_impl, retry_policy, rungs=plan.ladder,
+        label="simulate_batch", deadline=deadline,
     )
     return ys
 
@@ -501,9 +497,11 @@ def total_dividends_batch(
     """`[B, V]` total dividends for a stacked scenario suite — the batched
     equivalent of summing the reference driver's per-epoch output.
 
-    Same-shaped suites run unpadded; heterogeneous suites are padded via
-    :func:`pad_scenarios` (rows then cover `max(V)` validators — entries
-    beyond a scenario's own validator count are zero).
+    Same-shaped suites run unpadded; heterogeneous suites are DONOR-
+    PACKED via :func:`pack_scenarios` (one tile-aligned batched dispatch
+    with per-scenario miner masks — rows then cover the packed
+    validator count; entries beyond a scenario's own validator count
+    are zero).
     """
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
@@ -511,6 +509,6 @@ def total_dividends_batch(
         W, S, ri, re = stack_scenarios(scenarios, dtype)
         ys = simulate_batch(W, S, ri, re, config, spec)
     else:
-        W, S, ri, re, mask = pad_scenarios(scenarios, dtype)
+        W, S, ri, re, mask = pack_scenarios(scenarios, dtype)
         ys = simulate_batch(W, S, ri, re, config, spec, miner_mask=mask)
     return np.asarray(ys["dividends"].sum(axis=1))
