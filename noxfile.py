@@ -93,6 +93,8 @@ TEST_CHUNKS = [
         "tests/unit/test_recompilation.py",
         "tests/unit/test_supervisor.py",
         "tests/unit/test_telemetry.py",
+        "tests/unit/test_fabric.py",
+        "tests/unit/test_fleet_drill.py",
     ],
 ]
 
@@ -130,6 +132,34 @@ def chaos(session: nox.Session) -> None:
         "python", "-m", "tools.obsreport",
         os.path.join(session.create_tmp(), "chaos-bundle"),
         "--drill", "--check",
+    )
+
+
+@nox.session
+def fleet(session: nox.Session) -> None:
+    """Fleet lane (mirrors the CI chaos job's fleet half): the
+    in-process fabric battery (lease races, torn leases, steal/requeue
+    history, at-most-once publish) plus the multiprocess pod-level
+    chaos drill — one simulated host SIGKILLed, one lease torn, a
+    stall and a NaN lane on a third — gated by the fleet-aware
+    `obsreport --check` (run inside the drill test)."""
+    session.install("-e", ".[test]")
+    session.run(
+        "python", "-m", "pytest",
+        "tests/unit/test_fabric.py", "tests/unit/test_fleet_drill.py",
+        "-q",
+    )
+    import os
+    import shutil
+
+    # Fresh target every run: nox reuses its tmp dir across sessions and
+    # the fleet drill REFUSES a non-empty directory (a resumed drill
+    # exercises none of its faults).
+    bundle = os.path.join(session.create_tmp(), "fleet-bundle")
+    shutil.rmtree(bundle, ignore_errors=True)
+    session.run(
+        "python", "-m", "tools.obsreport", bundle,
+        "--fleet-drill", "--check",
     )
 
 

@@ -1,0 +1,615 @@
+"""Fleet fabric: lease protocol, shared store, work-stealing scheduler —
+ISSUE 7 acceptance battery (in-process half).
+
+The multiprocess pod-level chaos drill (host SIGKILL + lease tear +
+stall + NaN across >=3 simulated hosts) lives in
+tests/unit/test_fleet_drill.py (slow+chaos — the CI chaos lane runs
+it); everything deterministic and seconds-scale is here: claim
+exclusivity under randomized interleavings, torn-lease tolerance,
+expiry-driven stealing with per-unit attempt history, at-most-once
+publish, and the fleet end-to-end bitwise contract."""
+
+import errno
+import json
+import pathlib
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.fabric import (
+    FleetConfig,
+    FleetStore,
+    LeaseStore,
+    build_fleet_report,
+    check_fleet,
+    merged_ledger,
+    partition_lanes,
+    publish_fleet_report,
+    run_fleet_batch,
+)
+from yuma_simulation_tpu.resilience import (
+    FaultPlan,
+    LeaseTearFault,
+    NaNFault,
+    inject_faults,
+)
+from yuma_simulation_tpu.resilience.errors import LeaseExpired
+from yuma_simulation_tpu.scenarios import get_cases
+from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+
+VERSION = "Yuma 1 (paper)"
+
+
+# ------------------------------------------------------------- the lease
+
+
+def test_claim_is_exclusive_and_released(tmp_path):
+    a = LeaseStore(tmp_path, "hostA", ttl_seconds=30.0)
+    b = LeaseStore(tmp_path, "hostB", ttl_seconds=30.0)
+    claim = a.try_claim(0)
+    assert claim is not None and claim.generation == 0
+    assert b.try_claim(0) is None  # live claim protects the unit
+    a.renew(0)  # heartbeat is a no-op-ish refresh while owned
+    a.release(0)
+    assert not a.lease_path(0).exists()
+    second = b.try_claim(0)
+    assert second is not None and second.generation == 0  # no steal
+
+
+def test_expired_lease_is_stolen_with_generation_and_typed_abandon(tmp_path):
+    dead = LeaseStore(tmp_path, "dead-host", ttl_seconds=0.1)
+    assert dead.try_claim(0) is not None
+    time.sleep(0.25)
+    thief = LeaseStore(tmp_path, "thief", ttl_seconds=0.1)
+    stolen = thief.try_claim(0)
+    assert stolen is not None
+    assert stolen.generation == 1
+    assert stolen.stolen_from == "dead-host"
+    # the original holder discovers the theft as the TYPED failure
+    with pytest.raises(LeaseExpired) as exc:
+        dead.renew(0)
+    assert exc.value.unit == 0 and exc.value.holder == "thief"
+    assert not dead.still_owner(0)
+    # the steal left its durable tombstone (= the attempt history)
+    assert thief.generation(0) == 1
+
+
+def test_torn_lease_is_tolerated_and_stealable(tmp_path):
+    holder = LeaseStore(tmp_path, "holder", ttl_seconds=60.0)
+    assert holder.try_claim(0) is not None
+    # shared-store corruption: truncate the live claim record
+    path = holder.lease_path(0)
+    path.write_bytes(path.read_bytes()[:7])
+    scanner = LeaseStore(tmp_path, "scanner", ttl_seconds=60.0)
+    info = scanner.read(0)
+    assert info is not None and info.torn
+    # torn trumps mtime: stealable NOW, whatever the heartbeat says
+    assert scanner.is_stealable(info)
+    stolen = scanner.try_claim(0)
+    assert stolen is not None and stolen.generation == 1
+    # the torn record carried no parseable holder
+    assert stolen.stolen_from == ""
+    with pytest.raises(LeaseExpired):
+        holder.renew(0)
+
+
+def test_claim_race_exactly_one_winner_randomized_interleavings(tmp_path):
+    """ISSUE 7 property: two hosts racing to claim the same unit never
+    both win (and therefore never both publish — publish is gated on
+    holding the claim). Randomized sleeps at every protocol pause point
+    across many trials explore the interleaving space; the link-based
+    claim must yield exactly one winner in every schedule."""
+    trials = 20
+    for trial in range(trials):
+        d = tmp_path / f"trial{trial}"
+        winners = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(2)
+
+        def host(name: str, seed: str) -> None:
+            rng = random.Random(seed)
+            ls = LeaseStore(d, name, ttl_seconds=30.0)
+            ls._pause = lambda stage: time.sleep(rng.random() * 0.005)
+            barrier.wait()
+            if ls.try_claim(0) is not None:
+                with lock:
+                    winners.append(name)
+
+        threads = [
+            threading.Thread(target=host, args=(n, f"{trial}:{n}"))
+            for n in ("hostA", "hostB")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(winners) == 1, (trial, winners)
+
+
+def test_steal_race_exactly_one_winner_randomized_interleavings(tmp_path):
+    """The steal path's exclusivity: two stealers racing for the same
+    EXPIRED lease — the tombstone rename arbitrates; exactly one may
+    claim, and the loser backs off without damaging the fresh claim."""
+    for trial in range(12):
+        d = tmp_path / f"trial{trial}"
+        # TTL chosen so the dead claim (aged 0.5s) is long expired while
+        # a freshly-stolen claim stays live across the whole race (ms).
+        dead = LeaseStore(d, "dead-host", ttl_seconds=0.3)
+        assert dead.try_claim(0) is not None
+        time.sleep(0.5)
+        winners = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(2)
+
+        def thief(name: str, seed: str) -> None:
+            rng = random.Random(seed)
+            # same TTL as the fleet (expiry is a fleet-wide constant)
+            ls = LeaseStore(d, name, ttl_seconds=0.3)
+            ls._pause = lambda stage: time.sleep(rng.random() * 0.005)
+            barrier.wait()
+            claim = ls.try_claim(0)
+            if claim is not None:
+                with lock:
+                    winners.append((name, claim.generation))
+
+        threads = [
+            threading.Thread(target=thief, args=(n, f"s{trial}:{n}"))
+            for n in ("thiefA", "thiefB")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(winners) == 1, (trial, winners)
+        assert winners[0][1] == 1  # generation counts the one steal
+        # the fresh claim survived the losing stealer intact
+        survivor = LeaseStore(d, "observer", ttl_seconds=60.0)
+        info = survivor.read(0)
+        assert info is not None and not info.torn
+        assert info.host == winners[0][0]
+
+
+@pytest.mark.faultinject
+def test_lease_tear_fault_tears_own_live_lease(tmp_path):
+    ls = LeaseStore(tmp_path, "hostA", ttl_seconds=60.0)
+    assert ls.try_claim(0) is not None
+    with inject_faults(FaultPlan(lease_tear=LeaseTearFault(after_renewals=2))):
+        ls.renew(0)  # renewal 1: not yet
+        assert not ls.read(0).torn
+        ls.renew(0)  # renewal 2: tear fires, once
+        assert ls.read(0).torn
+        ls.renew(0)  # inode unchanged: the holder still renews
+        assert ls.read(0).torn
+
+
+@pytest.mark.faultinject
+def test_host_crash_fault_sigkills_after_n_claims(tmp_path):
+    """The crash hook must take the PROCESS down with SIGKILL (no
+    teardown), so it runs in a scratch subprocess."""
+    import signal
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    code = (
+        "from yuma_simulation_tpu.resilience.faults import ("
+        "FaultPlan, HostCrashFault, inject_faults, maybe_crash_host)\n"
+        "with inject_faults(FaultPlan(host_crash=HostCrashFault(after_claims=2))):\n"
+        "    maybe_crash_host(0)\n"
+        "    print('survived-first-claim', flush=True)\n"
+        "    maybe_crash_host(1)\n"
+        "    print('NEVER-REACHED', flush=True)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        cwd=repo,
+        timeout=120,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+    assert "survived-first-claim" in proc.stdout
+    assert "NEVER-REACHED" not in proc.stdout
+
+
+# ------------------------------------------------------- publish_atomic
+
+
+def test_publish_atomic_exdev_falls_back_to_copy_rename(tmp_path, monkeypatch):
+    """Shared-store case: the temp file lands on a different filesystem
+    than the target — `rename` raises EXDEV and the publish must fall
+    back to copy + same-filesystem rename, still atomic at the target."""
+    calls = {"exdev": 0}
+    orig = pathlib.Path.replace
+
+    def fake_replace(self, target):
+        if calls["exdev"] == 0 and ".xdev." not in self.name:
+            calls["exdev"] += 1
+            raise OSError(errno.EXDEV, "Invalid cross-device link")
+        return orig(self, target)
+
+    monkeypatch.setattr(pathlib.Path, "replace", fake_replace)
+    publish_atomic(tmp_path / "x.json", b'{"a": 1}')
+    assert calls["exdev"] == 1
+    assert (tmp_path / "x.json").read_bytes() == b'{"a": 1}'
+    assert not list(tmp_path.glob("*.tmp"))  # no stragglers either way
+
+
+def test_publish_atomic_tmp_dir_staging(tmp_path):
+    staging = tmp_path / "staging"
+    staging.mkdir()
+    target = tmp_path / "store" / "rec.json"
+    target.parent.mkdir()
+    publish_atomic(target, b'{"b": 2}', tmp_dir=staging)
+    assert target.read_bytes() == b'{"b": 2}'
+    assert not list(staging.iterdir())
+
+
+def test_publish_atomic_unexpected_oserror_propagates(tmp_path, monkeypatch):
+    def always_fail(self, target):
+        raise OSError(errno.EACCES, "Permission denied")
+
+    monkeypatch.setattr(pathlib.Path, "replace", always_fail)
+    with pytest.raises(OSError) as exc:
+        publish_atomic(tmp_path / "x.json", b"{}")
+    assert exc.value.errno == errno.EACCES
+
+
+# ------------------------------------------------------------- the store
+
+
+def test_store_at_most_once_publish_and_corruption_requeue(tmp_path):
+    store = FleetStore(tmp_path)
+    store.ensure_manifest(
+        num_units=2, unit_lanes=[(0, 1), (1, 2)], tag="t", config={"v": 1}
+    )
+    first = np.arange(6.0).reshape(1, 2, 3)
+    assert store.publish_result(0, {"dividends": first})
+    # at-most-once: a verified result is never overwritten
+    assert not store.publish_result(0, {"dividends": np.zeros((1, 2, 3))})
+    np.testing.assert_array_equal(store.load_result(0)["dividends"], first)
+    # corruption requeues: a torn result drops back to pending and the
+    # republish is accepted
+    path = store.result_path(0)
+    path.write_bytes(path.read_bytes()[:20])
+    assert not store.verify_result(0)
+    assert 0 in store.pending_units()
+    assert store.publish_result(0, {"dividends": first})
+    assert store.verify_result(0)
+
+
+def test_store_manifest_rejects_a_different_sweep(tmp_path):
+    store = FleetStore(tmp_path)
+    store.ensure_manifest(
+        num_units=1, unit_lanes=[(0, 4)], tag="a", config={"v": 1}
+    )
+    again = FleetStore(tmp_path)
+    again.ensure_manifest(
+        num_units=1, unit_lanes=[(0, 4)], tag="a", config={"v": 1}
+    )
+    with pytest.raises(ValueError, match="different"):
+        again.ensure_manifest(
+            num_units=1, unit_lanes=[(0, 4)], tag="a", config={"v": 2}
+        )
+
+
+def test_partition_lanes_matches_supervisor_rule():
+    assert partition_lanes(7, 3) == [(0, 3), (3, 6), (6, 7)]
+    with pytest.raises(ValueError, match="empty"):
+        partition_lanes(0, 3)
+    with pytest.raises(ValueError, match="unit_size"):
+        partition_lanes(3, 0)
+
+
+# --------------------------------------------------------- the scheduler
+
+
+def test_fleet_batch_single_host_matches_supervised_run(tmp_path):
+    from yuma_simulation_tpu.resilience import SweepSupervisor
+
+    cases = get_cases()[:4]
+    clean = SweepSupervisor(directory=None, unit_size=2).run_batch(
+        cases, VERSION
+    )
+    out = run_fleet_batch(
+        cases,
+        VERSION,
+        FleetConfig(
+            directory=tmp_path, unit_size=2, lease_ttl_seconds=30.0
+        ),
+    )
+    report = out["report"]
+    assert report.units_published == report.num_units == 2
+    assert report.clean
+    np.testing.assert_array_equal(out["dividends"], clean["dividends"])
+    assert check_fleet(tmp_path) == []
+
+
+def test_fleet_two_hosts_share_the_grid_no_double_publish(tmp_path):
+    """Two in-process hosts (threads) work-steal one store: every unit
+    publishes exactly once, the merged result is bitwise the clean
+    single-host run, and the merged ledgers reconcile."""
+    from yuma_simulation_tpu.resilience import SweepSupervisor
+
+    cases = get_cases()[:4]
+    clean = SweepSupervisor(directory=None, unit_size=1).run_batch(
+        cases, VERSION
+    )
+    errors = []
+
+    def host(host_id: str) -> None:
+        try:
+            run_fleet_batch(
+                cases,
+                VERSION,
+                FleetConfig(
+                    directory=tmp_path,
+                    host_id=host_id,
+                    unit_size=1,
+                    lease_ttl_seconds=30.0,
+                    poll_seconds=0.05,
+                    max_wait_seconds=240.0,
+                ),
+                finalize=False,
+            )
+        except BaseException as exc:  # noqa: BLE001 — surfaced below
+            errors.append((host_id, exc))
+
+    threads = [
+        threading.Thread(target=host, args=(f"host{i}",)) for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    store = FleetStore(tmp_path)
+    report = publish_fleet_report(store)
+    assert report.units_published == 4
+    assert report.hosts_lost == ()
+    ok_units = sorted(
+        r["unit"]
+        for r in merged_ledger(store)
+        if r.get("event") == "unit_ok"
+    )
+    assert ok_units == [0, 1, 2, 3]  # exactly one accepted publish each
+    np.testing.assert_array_equal(
+        store.collect("dividends"), np.asarray(clean["dividends"])
+    )
+    assert check_fleet(tmp_path) == []
+
+
+def test_lease_expiry_steal_requeues_with_attempt_history(tmp_path):
+    """A host dies holding a claim (simulated: claims and never
+    heartbeats); a surviving host steals after expiry, re-executes, and
+    the per-unit attempt history survives in the ledger + tombstones —
+    the PR 3 requeue-history semantics one level up."""
+    cases = get_cases()[:4]
+    lanes = partition_lanes(len(cases), 2)
+    store = FleetStore(tmp_path)
+    store.ensure_manifest(
+        num_units=len(lanes),
+        unit_lanes=lanes,
+        tag=f"fleet_batch:{VERSION}",
+        config={
+            "driver": "run_fleet_batch",
+            "version": VERSION,
+            "num_scenarios": len(cases),
+            "unit_size": 2,
+            "dtype": "float32",
+        },
+    )
+    # the doomed host claims unit 0 and is never heard from again
+    dead = LeaseStore(store.leases_dir, "doomed-host", ttl_seconds=0.2)
+    assert dead.try_claim(0) is not None
+    time.sleep(0.4)
+
+    out = run_fleet_batch(
+        cases,
+        VERSION,
+        FleetConfig(
+            directory=tmp_path,
+            host_id="survivor",
+            unit_size=2,
+            lease_ttl_seconds=0.2,
+            poll_seconds=0.05,
+        ),
+    )
+    report = out["report"]
+    assert report.units_published == 2
+    assert report.units_stolen == 1
+    records = merged_ledger(FleetStore(tmp_path))
+    stolen = [r for r in records if r.get("event") == "unit_stolen"]
+    assert len(stolen) == 1
+    assert stolen[0]["unit"] == 0
+    assert stolen[0]["prior_host"] == "doomed-host"
+    assert stolen[0]["generation"] == 1
+    # the winning execution's records carry the steal generation
+    ok0 = [
+        r
+        for r in records
+        if r.get("event") == "unit_ok" and r.get("unit") == 0
+    ]
+    assert len(ok0) == 1 and ok0[0]["generation"] == 1
+    # and the durable tombstone backs the count (check_fleet verifies)
+    assert LeaseStore(store.leases_dir, "observer").generation(0) == 1
+    assert check_fleet(tmp_path) == []
+
+
+@pytest.mark.faultinject
+def test_fleet_nan_lane_quarantines_globally_healthy_lanes_bitwise(tmp_path):
+    """A NaN lane inside one fleet unit: globalized quarantine
+    provenance in the fleet ledger, healthy lanes bitwise vs clean."""
+    from yuma_simulation_tpu.resilience import SweepSupervisor
+
+    cases = get_cases()[:4]
+    clean = SweepSupervisor(directory=None, unit_size=2).run_batch(
+        cases, VERSION
+    )
+    with inject_faults(FaultPlan(nan=NaNFault(epoch=2, case=1))):
+        out = run_fleet_batch(
+            cases,
+            VERSION,
+            FleetConfig(
+                directory=tmp_path, unit_size=2, lease_ttl_seconds=30.0
+            ),
+        )
+    report = out["report"]
+    # unit 0 = lanes [0,2) and unit 1 = lanes [2,4): local lane 1 of
+    # each unit poisons global lanes 1 and 3
+    assert report.lanes_quarantined == 2
+    assert out["quarantine"].quarantined_cases == (1, 3)
+    for lane in (0, 2):
+        np.testing.assert_array_equal(
+            out["dividends"][lane], np.asarray(clean["dividends"])[lane]
+        )
+    for lane in (1, 3):
+        np.testing.assert_array_equal(
+            out["dividends"][lane][:2],
+            np.asarray(clean["dividends"])[lane][:2],
+        )
+        assert (out["dividends"][lane][2:] == 0).all()
+    assert np.isfinite(out["dividends"]).all()
+    assert check_fleet(tmp_path) == []
+
+
+def test_fleet_resume_is_pure_collection(tmp_path):
+    """A second fleet run over a completed store claims nothing,
+    publishes nothing, and returns the identical result."""
+    cases = get_cases()[:4]
+    cfg = FleetConfig(
+        directory=tmp_path, unit_size=2, lease_ttl_seconds=30.0
+    )
+    first = run_fleet_batch(cases, VERSION, cfg)
+    second = run_fleet_batch(
+        cases,
+        VERSION,
+        FleetConfig(
+            directory=tmp_path,
+            host_id="late-joiner",
+            unit_size=2,
+            lease_ttl_seconds=30.0,
+        ),
+    )
+    np.testing.assert_array_equal(first["dividends"], second["dividends"])
+    assert second["host"].units_published == 0
+    ok = [
+        r
+        for r in merged_ledger(FleetStore(tmp_path))
+        if r.get("event") == "unit_ok"
+    ]
+    assert len(ok) == 2  # only the first run executed
+
+
+def test_check_fleet_flags_missing_result_and_tampered_report(tmp_path):
+    cases = get_cases()[:4]
+    run_fleet_batch(
+        cases,
+        VERSION,
+        FleetConfig(directory=tmp_path, unit_size=2, lease_ttl_seconds=30.0),
+    )
+    assert check_fleet(tmp_path) == []
+    store = FleetStore(tmp_path)
+    # tamper the published report: counts must be caught
+    report_path = tmp_path / "fleet_report.json"
+    data = json.loads(report_path.read_text())
+    data["units_stolen"] = 7
+    report_path.write_text(json.dumps(data))
+    problems = check_fleet(tmp_path)
+    assert any("units_stolen" in p for p in problems)
+    # remove a result: the unit must be reported lost
+    publish_fleet_report(store)  # heal the report first
+    store.result_path(1).unlink()
+    problems = check_fleet(tmp_path)
+    assert any("unit 1" in p and "verified" in p for p in problems)
+
+
+def test_fleet_report_derivation_is_pure(tmp_path):
+    cases = get_cases()[:2]
+    run_fleet_batch(
+        cases,
+        VERSION,
+        FleetConfig(directory=tmp_path, unit_size=2, lease_ttl_seconds=30.0),
+    )
+    a = build_fleet_report(tmp_path)
+    b = build_fleet_report(tmp_path)
+    assert a == b
+
+
+# ------------------------------------------------------------ v1 surface
+
+
+def test_run_simulation_fleet_knob_matches_plain(tmp_path):
+    from yuma_simulation_tpu.scenarios import create_case
+    from yuma_simulation_tpu.simulation.engine import run_simulation
+
+    case = create_case("Case 2")
+    plain = run_simulation(case, VERSION)
+    fleet = run_simulation(case, VERSION, fleet=tmp_path)
+    assert set(plain[0]) == set(fleet[0])
+    for validator in plain[0]:
+        np.testing.assert_array_equal(plain[0][validator], fleet[0][validator])
+    np.testing.assert_array_equal(np.asarray(plain[1]), np.asarray(fleet[1]))
+    np.testing.assert_array_equal(np.asarray(plain[2]), np.asarray(fleet[2]))
+    # a second invocation against the same store is pure collection
+    again = run_simulation(case, VERSION, fleet=tmp_path)
+    for validator in plain[0]:
+        np.testing.assert_array_equal(fleet[0][validator], again[0][validator])
+    ok = [
+        r
+        for r in merged_ledger(FleetStore(tmp_path))
+        if r.get("event") == "unit_ok"
+    ]
+    assert len(ok) == 1  # executed exactly once across both calls
+
+
+def test_dividends_cli_fleet_store_builds_each_sheet_once(tmp_path):
+    """The `yuma-dividends --fleet-store` path: the beta sheet builds as
+    one lease-claimed unit; a second invocation against the same store
+    is pure collection (no rebuild) and writes identical bytes."""
+    import pandas as pd
+
+    from yuma_simulation_tpu.cli.total_dividends_sheet_generator import main
+
+    out1, out2 = tmp_path / "o1", tmp_path / "o2"
+    store = tmp_path / "store"
+    main(
+        ["--bond-penalty", "1.0", "--out-dir", str(out1),
+         "--fleet-store", str(store)]
+    )
+    csv_bytes = (out1 / "total_dividends_b1.0.csv").read_bytes()
+    df = pd.read_csv(out1 / "total_dividends_b1.0.csv")
+    assert len(df) == 14 and not df.isnull().values.any()
+    main(
+        ["--bond-penalty", "1.0", "--out-dir", str(out2),
+         "--fleet-store", str(store)]
+    )
+    assert (out2 / "total_dividends_b1.0.csv").read_bytes() == csv_bytes
+    ok = [
+        r
+        for r in merged_ledger(FleetStore(store))
+        if r.get("event") == "unit_ok"
+    ]
+    assert len(ok) == 1  # the sheet built exactly once across both runs
+    assert check_fleet(store) == []
+
+
+# --------------------------------------------------------- mesh plumbing
+
+
+def test_surviving_members_is_the_shared_shrink_filter():
+    from yuma_simulation_tpu.parallel import surviving_members
+
+    # fleet rosters: plain host-id strings
+    assert surviving_members(["h0", "h1", "h2"], ["h1"]) == ["h0", "h2"]
+
+    # device-like members: identity via .id
+    class Dev:
+        def __init__(self, i):
+            self.id = i
+
+    devs = [Dev(0), Dev(1), Dev(2)]
+    assert [d.id for d in surviving_members(devs, [1])] == [0, 2]

@@ -1,0 +1,51 @@
+"""The pod-level fleet chaos drill — ISSUE 7 acceptance capstone.
+
+Multiprocess simulated hosts (the pattern of
+test_distributed_multiprocess.py): one host SIGKILLed mid-sweep, one
+live lease torn, a stall and a NaN lane injected on a third host, plus
+an unfaulted oracle host in an identical subprocess environment. The
+sweep must complete with healthy lanes bitwise-identical to the
+unfaulted run, exactly one accepted publish per unit, and a
+FleetHealthReport that reconciles with the merged ledgers
+(`obsreport --check` exit 0) — the PR 3 single-host drill guarantee,
+extended to the fleet.
+
+slow+chaos: the CI chaos lane (`pytest -m "faultinject or chaos"`)
+runs it; the fast tier-1 lane (`-m "not slow"`) skips the multi-minute
+subprocess battery.
+"""
+
+import pytest
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_pod_level_fleet_chaos_drill(tmp_path, capsys):
+    from yuma_simulation_tpu.fabric.simhost import run_drill
+
+    # run_drill itself raises on ANY violated acceptance property:
+    # host exit codes, completion, at-most-once publish, bitwise healthy
+    # lanes, quarantine masking, ledger<->report reconciliation, and
+    # per-finished-host bundle soundness.
+    summary = run_drill(tmp_path / "drill", timeout=420.0)
+    report = summary["report"]
+
+    # Re-assert the headline acceptance criteria explicitly so a
+    # regression names the exact guarantee lost.
+    assert report.units_published == report.num_units
+    assert "crash-host" in report.hosts_lost
+    assert report.units_stolen >= 1
+    assert report.stalls_killed >= 1
+    assert report.lanes_quarantined >= 1
+    assert not report.clean
+    # the roster shrink mirrors MeshDegradation one level up
+    assert any(
+        "crash-host" in d.lost_device_ids for d in report.degradations
+    )
+
+    # obsreport --check over the drill store must exit 0 (the CI gate).
+    from tools.obsreport import main as obsreport_main
+
+    assert obsreport_main([summary["store"], "--check"]) == 0
+    out = capsys.readouterr().out
+    assert "fleet store is sound" in out

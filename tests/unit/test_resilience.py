@@ -110,6 +110,64 @@ def test_classify_failure_stall_caller_errors_still_win():
     assert classify_failure(ValueError("DEADLINE_EXCEEDED-ish")) is None
 
 
+@pytest.mark.parametrize(
+    "message",
+    [
+        # coordinator-channel loss: the healthy peers' view of a dead host
+        "heartbeat timeout: coordinator unreachable",
+        "coordination service unavailable",
+        "lost connection to coordinator at 10.0.0.2:8476",
+        "coordinator disconnected before barrier",
+        # TCP-level phrasings a dead peer's kernel sends back
+        "connection reset by peer",
+        "UNAVAILABLE: connection refused",
+        "peer closed connection during transfer",
+        "host unreachable: worker-7",
+        "worker task died during all-reduce",
+    ],
+)
+def test_classify_failure_host_loss_patterns(message):
+    """ISSUE 7 satellite: every coordinator-loss / heartbeat-timeout /
+    connection-reset phrasing classifies as the typed retryable
+    HostLossError — each pattern pinned individually so a marker
+    regression names the exact phrasing lost. HostLossError subclasses
+    EngineStall, so every pre-fleet stall-handling path (watchdog,
+    ladder, supervisor accounting) treats a host loss exactly as
+    before, while fleet callers can match the narrower type and steal
+    the dead host's leases."""
+    from yuma_simulation_tpu.resilience import EngineStall, HostLossError
+
+    typed = classify_failure(RuntimeError(message))
+    assert isinstance(typed, HostLossError), message
+    assert isinstance(typed, EngineStall)  # stall semantics preserved
+    assert isinstance(typed, EngineFailure)  # retryable by the ladder
+
+
+def test_classify_failure_host_loss_caller_errors_still_win():
+    assert classify_failure(ValueError("connection reset by peer")) is None
+
+
+def test_classify_failure_host_loss_excludes_local_oserrors():
+    """A local EPIPE/ECONNRESET from the caller's own plumbing shares
+    the peer-death phrasings but is NOT a host loss — retrying a unit
+    cannot fix the caller's environment. Runtime-reported peer death
+    arrives as RuntimeError, which still classifies (above)."""
+    assert classify_failure(OSError(32, "Broken pipe")) is None
+    assert (
+        classify_failure(ConnectionResetError(104, "Connection reset by peer"))
+        is None
+    )
+
+
+def test_lease_expired_is_not_an_engine_failure():
+    """A lost lease means the unit belongs to ANOTHER host — retrying
+    the engine here is wrong, so LeaseExpired must never classify as
+    retryable."""
+    from yuma_simulation_tpu.resilience import LeaseExpired
+
+    assert classify_failure(LeaseExpired("stolen", unit=3)) is None
+
+
 def test_ladder_from_rungs():
     assert ladder_from("fused_scan_mxu") == ENGINE_LADDER
     assert ladder_from("fused_scan") == ("fused_scan", "xla")

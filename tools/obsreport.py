@@ -27,6 +27,17 @@ the test-only fault hooks — a stall, a NaN lane, a torn checkpoint
 chunk, and (when ``jax.shard_map`` is available) a device loss on the
 virtual 8-device CPU mesh — so the CI chaos lane can produce, gate and
 upload a real bundle on every push.
+
+Fleet stores (``yuma_simulation_tpu.fabric``) are detected
+automatically: the report renders the merged ``FleetHealthReport`` plus
+one per-host timeline section, and ``--check`` additionally runs the
+fleet gate — every unit has a verified result, every claim on disk
+resolves to a ledger record (and, through the per-host bundle check, to
+a span), and the published fleet report matches the merged ledgers.
+``--fleet-drill`` runs the multiprocess pod-level chaos drill (one host
+SIGKILLed, one lease torn, a stall and a NaN lane on a third host, an
+unfaulted oracle host) into DIRECTORY first, verifying healthy lanes
+land bitwise-identical to the unfaulted run.
 """
 
 from __future__ import annotations
@@ -362,6 +373,79 @@ def run_drill(directory: str) -> None:
     )
 
 
+def render_fleet(directory: str) -> str:
+    """The fleet-store report: manifest + merged FleetHealthReport +
+    one per-host timeline section (each host's bundle through the
+    existing single-run renderer)."""
+    from yuma_simulation_tpu.fabric.health import (
+        build_fleet_report,
+        load_fleet_report,
+    )
+    from yuma_simulation_tpu.fabric.store import FleetStore
+    from yuma_simulation_tpu.telemetry.flight import load_bundle
+
+    store = FleetStore(directory)
+    manifest = store.manifest()
+    report = build_fleet_report(store)
+    published = load_fleet_report(store)
+    lines = [
+        f"fleet store: {store.directory}",
+        f"fleet: {manifest.get('fleet')}  units: {manifest['num_units']}"
+        f"  published: {report.units_published}",
+        "fleet health: "
+        + " ".join(
+            f"{k}={getattr(report, k)}"
+            for k in (
+                "hosts_lost",
+                "units_stolen",
+                "units_abandoned",
+                "units_duplicate",
+                "stalls_killed",
+                "engine_demotions",
+                "mesh_shrinks",
+                "lanes_quarantined",
+            )
+        ),
+        f"hosts: seen={list(report.hosts_seen)} "
+        f"finished={list(report.hosts_finished)} "
+        f"lost={list(report.hosts_lost)}",
+    ]
+    if published is None:
+        lines.append("fleet_report.json: not finalized (derived above)")
+    for deg in report.degradations:
+        lines.append(
+            f"  host roster {deg.from_devices}->{deg.to_devices} "
+            f"(lost {', '.join(deg.lost_device_ids)}: {deg.reason})"
+        )
+    for host_id in store.host_ids():
+        lines.append("")
+        lines.append(f"--- host {host_id} ---")
+        lines.append(render(load_bundle(store.host_dir(host_id)), None))
+    return "\n".join(lines)
+
+
+def check_fleet_store(directory: str) -> list[str]:
+    """The fleet ``--check`` gate: the fleet-level consistency check
+    plus the per-host bundle check for every FINISHED host (a SIGKILLed
+    host never ran its bundle-publish finally — its ledger is the
+    surviving record; demanding spans of the dead would be a false
+    positive)."""
+    from yuma_simulation_tpu.fabric.health import (
+        build_fleet_report,
+        check_fleet,
+    )
+    from yuma_simulation_tpu.fabric.store import FleetStore
+    from yuma_simulation_tpu.telemetry.flight import check_bundle, load_bundle
+
+    problems = list(check_fleet(directory))
+    store = FleetStore(directory)
+    report = build_fleet_report(store)
+    for host_id in report.hosts_finished:
+        bundle = load_bundle(store.host_dir(host_id))
+        problems.extend(f"host {host_id}: {p}" for p in check_bundle(bundle))
+    return problems
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="obsreport", description=__doc__.split("\n\n")[0]
@@ -385,12 +469,70 @@ def main(argv: list[str] | None = None) -> int:
         help="run the deterministic chaos drill into DIRECTORY first "
         "(CI smoke; forces the CPU backend)",
     )
+    parser.add_argument(
+        "--fleet-drill",
+        action="store_true",
+        help="run the multiprocess pod-level fleet chaos drill into "
+        "DIRECTORY first (>=3 simulated hosts: kill, lease tear, "
+        "stall+NaN; CI smoke, CPU)",
+    )
     args = parser.parse_args(argv)
 
     if args.drill:
         run_drill(args.directory)
+    if args.fleet_drill:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from yuma_simulation_tpu.fabric.simhost import (
+            run_drill as run_fleet_drill,
+        )
 
+        summary = run_fleet_drill(args.directory)
+        report = summary["report"]
+        print(
+            "fleet drill complete (3 faulted hosts + oracle): "
+            f"hosts_lost={list(report.hosts_lost)} "
+            f"stolen={report.units_stolen} "
+            f"stalls={report.stalls_killed} "
+            f"quarantined={report.lanes_quarantined}"
+        )
+        # The drill's store is the fleet bundle to render/check below.
+        args.directory = summary["store"]
+
+    from yuma_simulation_tpu.fabric.store import is_fleet_store
     from yuma_simulation_tpu.telemetry.flight import check_bundle, load_bundle
+
+    if is_fleet_store(args.directory):
+        if args.json:
+            from yuma_simulation_tpu.fabric.health import (
+                build_fleet_report,
+                merged_ledger,
+            )
+            from yuma_simulation_tpu.fabric.store import FleetStore
+
+            store = FleetStore(args.directory)
+            print(
+                json.dumps(
+                    {
+                        "directory": str(store.directory),
+                        "fleet": store.manifest(),
+                        "report": build_fleet_report(store).to_json(),
+                        "ledger": merged_ledger(store),
+                    },
+                    indent=2,
+                    sort_keys=True,
+                )
+            )
+        else:
+            print(render_fleet(args.directory))
+        if args.check:
+            problems = check_fleet_store(args.directory)
+            if problems:
+                print("\nobsreport --check FAILED:", file=sys.stderr)
+                for p in problems:
+                    print(f"  - {p}", file=sys.stderr)
+                return 2
+            print("\nobsreport --check: fleet store is sound")
+        return 0
 
     bundle = load_bundle(args.directory)
     if args.json:

@@ -51,6 +51,16 @@ def main(argv: list[str] | None = None) -> None:
         help="write a jax.profiler trace (Perfetto/XPlane) of the whole "
         "build under this directory (default: no profiling)",
     )
+    parser.add_argument(
+        "--fleet-store",
+        default=None,
+        help="coordinate the per-beta tables through a shared fleet "
+        "store (README 'Fleet sweeps'): N concurrent invocations "
+        "pointed at this directory split the beta sweep via "
+        "lease-claimed units — each table builds exactly once across "
+        "the fleet, a dying builder's beta is requeued via lease "
+        "expiry, and every invocation writes the complete HTML set",
+    )
     args = parser.parse_args(argv)
 
     # Operator-facing stream (structured event= records included) — the
@@ -63,23 +73,52 @@ def main(argv: list[str] | None = None) -> None:
         cases = get_cases()
 
     args.out_dir.mkdir(parents=True, exist_ok=True)
+
+    def build_table(bond_penalty: str) -> bytes:
+        hp = SimulationHyperparameters(bond_penalty=float(bond_penalty))
+        table = generate_chart_table(
+            cases,
+            canonical_versions(),
+            hp,
+            draggable_table=not args.no_draggable,
+        )
+        return table.data.encode("utf-8")
+
+    def write_table(bond_penalty: str, data: bytes) -> None:
+        file_name = (
+            args.out_dir / f"simulation_results_b{bond_penalty}.html"
+        )
+        file_name.write_bytes(data)
+        print(f"HTML saved to {file_name}")
+
     # One telemetry run for the whole invocation: every structured
     # record emitted below carries this run_id, and the per-beta suite
     # builds become spans under it (yuma_simulation_tpu.telemetry).
     with RunContext(), profile_trace(args.profile_dir):
-        for bond_penalty in args.bond_penalty:
-            hp = SimulationHyperparameters(bond_penalty=float(bond_penalty))
-            table = generate_chart_table(
-                cases,
-                canonical_versions(),
-                hp,
-                draggable_table=not args.no_draggable,
+        if args.fleet_store is not None:
+            # The fleet path necessarily writes after completion: the
+            # full set only exists once every host's units published.
+            from yuma_simulation_tpu.fabric import run_fleet_artifacts
+
+            tables = run_fleet_artifacts(
+                args.bond_penalty,
+                build_table,
+                args.fleet_store,
+                tag="chart_tables",
+                config_fingerprint={
+                    "driver": "yuma-charts",
+                    "betas": list(args.bond_penalty),
+                    "cases": [case.name for case in cases],
+                    "draggable": not args.no_draggable,
+                },
             )
-            file_name = (
-                args.out_dir / f"simulation_results_b{bond_penalty}.html"
-            )
-            file_name.write_text(table.data, encoding="utf-8")
-            print(f"HTML saved to {file_name}")
+            for bond_penalty, data in tables.items():
+                write_table(bond_penalty, data)
+        else:
+            # Write each table as it completes: a crash mid-sweep keeps
+            # every finished HTML, and only one table is ever resident.
+            for bond_penalty in args.bond_penalty:
+                write_table(bond_penalty, build_table(bond_penalty))
 
 
 if __name__ == "__main__":

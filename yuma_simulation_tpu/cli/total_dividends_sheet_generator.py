@@ -38,6 +38,16 @@ def main(argv: list[str] | None = None) -> None:
         help="write a jax.profiler trace (Perfetto/XPlane) of the whole "
         "build under this directory (default: no profiling)",
     )
+    parser.add_argument(
+        "--fleet-store",
+        default=None,
+        help="coordinate the per-beta sheets through a shared fleet "
+        "store (README 'Fleet sweeps'): N concurrent invocations "
+        "pointed at this directory split the beta sweep via "
+        "lease-claimed units — each sheet builds exactly once across "
+        "the fleet, a dying builder's beta is requeued via lease "
+        "expiry, and every invocation writes the complete CSV set",
+    )
     args = parser.parse_args(argv)
 
     # Operator-facing stream (structured event= records included) — the
@@ -46,23 +56,50 @@ def main(argv: list[str] | None = None) -> None:
 
     cases = get_cases()
     args.out_dir.mkdir(parents=True, exist_ok=True)
+
+    def build_sheet(bond_penalty: str) -> bytes:
+        print(
+            f"Generating total dividends sheet for "
+            f"bond_penalty={bond_penalty}"
+        )
+        hp = SimulationHyperparameters(bond_penalty=float(bond_penalty))
+        with span(f"sheet:b{bond_penalty}"):
+            df = generate_total_dividends_table(
+                cases, canonical_versions(), hp
+            )
+        if df.isnull().values.any():
+            print("Warning: NaN values detected in the dividends table.")
+        return df.to_csv(index=False, float_format="%.6f").encode()
+
+    def write_sheet(bond_penalty: str, data: bytes) -> None:
+        file_name = args.out_dir / f"total_dividends_b{bond_penalty}.csv"
+        file_name.write_bytes(data)
+        print(f"CSV saved to {file_name}")
+
     # One telemetry run for the invocation, one span per beta sheet.
     with RunContext(), profile_trace(args.profile_dir):
-        for bond_penalty in args.bond_penalty:
-            print(
-                f"Generating total dividends sheet for "
-                f"bond_penalty={bond_penalty}"
+        if args.fleet_store is not None:
+            # The fleet path necessarily writes after completion: the
+            # full set only exists once every host's units published.
+            from yuma_simulation_tpu.fabric import run_fleet_artifacts
+
+            sheets = run_fleet_artifacts(
+                args.bond_penalty,
+                build_sheet,
+                args.fleet_store,
+                tag="dividend_sheets",
+                config_fingerprint={
+                    "driver": "yuma-dividends",
+                    "betas": list(args.bond_penalty),
+                },
             )
-            hp = SimulationHyperparameters(bond_penalty=float(bond_penalty))
-            with span(f"sheet:b{bond_penalty}"):
-                df = generate_total_dividends_table(
-                    cases, canonical_versions(), hp
-                )
-            if df.isnull().values.any():
-                print("Warning: NaN values detected in the dividends table.")
-            file_name = args.out_dir / f"total_dividends_b{bond_penalty}.csv"
-            df.to_csv(file_name, index=False, float_format="%.6f")
-            print(f"CSV saved to {file_name}")
+            for bond_penalty, data in sheets.items():
+                write_sheet(bond_penalty, data)
+        else:
+            # Write each sheet as it completes: a crash mid-sweep keeps
+            # every finished CSV, and only one sheet is ever resident.
+            for bond_penalty in args.bond_penalty:
+                write_sheet(bond_penalty, build_sheet(bond_penalty))
 
 
 if __name__ == "__main__":

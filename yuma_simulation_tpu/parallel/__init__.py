@@ -24,6 +24,7 @@ from yuma_simulation_tpu.parallel.mesh import (  # noqa: F401
     initialize_distributed,
     make_hybrid_mesh,
     make_mesh,
+    surviving_members,
     surviving_mesh,
 )
 from yuma_simulation_tpu.parallel.sharded import (  # noqa: F401

@@ -66,12 +66,32 @@ def make_mesh(
 class MeshDegradation:
     """One elastic shrink of a sweep's mesh (also logged as
     `event=mesh_degraded`): which devices were dropped, what the mesh
-    shrank from and to, and why."""
+    shrank from and to, and why.
+
+    The same shape describes a shrink at EVERY level of the elastic
+    hierarchy — the fabric layer reuses it verbatim for host loss
+    (:mod:`yuma_simulation_tpu.fabric.health` aliases it as
+    ``FleetDegradation``), where the "devices" are fleet hosts."""
 
     from_devices: int
     to_devices: int
     lost_device_ids: tuple
     reason: str
+
+
+def surviving_members(
+    members: Sequence, lost_ids: Sequence, *, key=None
+) -> list:
+    """The survivor filter shared by every level of elastic degradation:
+    drop `lost_ids` from `members`, identity taken from ``member.id``
+    when present (jax devices) else the member itself (fleet host ids).
+    :func:`surviving_mesh` applies it to a mesh's devices; the fleet
+    fabric applies it one level up to the host roster — same semantics,
+    one implementation (ROADMAP item 4)."""
+    if key is None:
+        key = lambda m: getattr(m, "id", m)  # noqa: E731
+    lost = set(lost_ids)
+    return [m for m in members if key(m) not in lost]
 
 
 def surviving_mesh(
@@ -92,8 +112,7 @@ def surviving_mesh(
     mesh. One `event=mesh_degraded` record is emitted per rebuild by the
     elastic driver, not here — the driver knows the dispatch context.
     """
-    lost = set(lost_device_ids)
-    survivors = [d for d in mesh.devices.flat if d.id not in lost]
+    survivors = surviving_members(list(mesh.devices.flat), lost_device_ids)
     if len(survivors) <= 1:
         return None
     model = mesh.shape.get(MODEL_AXIS, 1)

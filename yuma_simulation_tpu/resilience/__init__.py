@@ -33,6 +33,8 @@ from yuma_simulation_tpu.resilience.errors import (  # noqa: F401
     EngineLadderExhausted,
     EngineResourceExhausted,
     EngineStall,
+    HostLossError,
+    LeaseExpired,
     NonFiniteOutputError,
     ResilienceError,
     classify_failure,
@@ -40,6 +42,8 @@ from yuma_simulation_tpu.resilience.errors import (  # noqa: F401
 from yuma_simulation_tpu.resilience.faults import (  # noqa: F401
     DeviceLossFault,
     FaultPlan,
+    HostCrashFault,
+    LeaseTearFault,
     NaNFault,
     StallFault,
     inject_faults,

@@ -61,6 +61,43 @@ class DeviceLossError(EngineFailure):
         self.device_ids = tuple(device_ids)
 
 
+class HostLossError(EngineStall):
+    """A WHOLE HOST dropped out of the fleet mid-sweep (SIGKILLed
+    worker, preempted VM, coordinator connection lost, heartbeat
+    stopped). The fleet analogue of :class:`DeviceLossError` one level
+    up (:mod:`..fabric`): the lost host's leased work units are requeued
+    by the survivors via lease expiry, exactly as `surviving_mesh`
+    rebuilds a mesh over surviving devices.
+
+    Subclasses :class:`EngineStall` deliberately: a host loss first
+    SURFACES on the healthy peers as a stall (missed heartbeat, wedged
+    collective, dead coordinator channel), so every existing
+    stall-handling path — watchdog kill, ladder retry, supervisor
+    bookkeeping — handles it unchanged, while fleet-aware callers can
+    match the narrower type and steal the dead host's leases instead of
+    merely retrying. Retryable by construction: the unit is pure and
+    any surviving host can re-execute it."""
+
+    def __init__(self, message: str, host_ids=(), budget_seconds=None):
+        super().__init__(message, budget_seconds=budget_seconds)
+        self.host_ids = tuple(host_ids)
+
+
+class LeaseExpired(ResilienceError):
+    """A fleet work-unit lease was lost: the holder's renewal found the
+    claim file replaced (stolen after expiry), torn, or gone. NOT an
+    :class:`EngineFailure`: the unit now belongs to another host —
+    retrying the dispatch here would race the new owner for nothing
+    (results are content-addressed and deterministic, so even the race
+    is harmless, but the polite move is to abandon and claim other
+    work). Carries the unit id and, when known, the usurping holder."""
+
+    def __init__(self, message: str, unit=None, holder=None):
+        super().__init__(message)
+        self.unit = unit
+        self.holder = holder
+
+
 class DistributedInitError(ResilienceError):
     """A multi-host distributed join failed within its initialization
     timeout (peer crashed before the barrier, wrong coordinator
@@ -118,6 +155,37 @@ _STALL_MARKERS = (
     "heartbeat timeout",
 )
 
+#: Substrings that identify the loss of a WHOLE HOST rather than a
+#: single wedged operation: coordinator-channel loss, stopped
+#: heartbeats, and the TCP-level phrasings a dead peer's kernel sends
+#: back ("connection reset by peer" et al.). Checked BEFORE the stall
+#: markers — a host loss is still stall-shaped (HostLossError subclasses
+#: EngineStall, so non-fleet callers behave identically), but the
+#: narrower type lets the fleet fabric steal the dead host's leases
+#: instead of merely retrying into the void. Deliberately NOT here:
+#: bare local-I/O phrasings ("broken pipe", "socket closed") — they
+#: appear in ordinary OSErrors (a closed stdout, a dropped log pipe)
+#: far more often than in peer-death reports, and classifying those as
+#: retryable would silently re-execute units whose real failure is the
+#: caller's environment. Raw OSErrors are additionally exempted in
+#: :func:`classify_failure` for the same reason: runtime peer-death
+#: surfaces as XLA RuntimeErrors, local plumbing as OSError.
+_HOST_LOSS_MARKERS = (
+    "heartbeat timeout",
+    "heartbeat timed out",
+    "missed heartbeats",
+    "coordinator unreachable",
+    "coordinator unavailable",
+    "coordination service unavailable",
+    "lost connection to coordinator",
+    "coordinator disconnected",
+    "connection reset by peer",
+    "connection refused",
+    "peer closed connection",
+    "host unreachable",
+    "worker task died",
+)
+
 #: Substrings that identify a kernel/program compile failure.
 _COMPILE_MARKERS = (
     "mosaic failed",
@@ -142,6 +210,12 @@ def classify_failure(exc: BaseException) -> Optional[EngineFailure]:
     """
     if isinstance(exc, EngineFailure):
         return exc
+    if isinstance(exc, ResilienceError):
+        # Typed but deliberately NON-retryable (LeaseExpired,
+        # DistributedInitError, CheckpointCorruptionError, ...): the
+        # type is the decision — its message must never be re-matched
+        # against the engine-failure markers.
+        return None
     if isinstance(exc, (ValueError, TypeError, KeyboardInterrupt)):
         return None
     msg = str(exc).lower()
@@ -149,6 +223,21 @@ def classify_failure(exc: BaseException) -> Optional[EngineFailure]:
         err = EngineResourceExhausted(str(exc))
         err.__cause__ = exc
         return err
+    if not isinstance(exc, OSError) and any(
+        marker in msg for marker in _HOST_LOSS_MARKERS
+    ):
+        # Checked before the generic stall markers: "heartbeat timeout:
+        # coordinator unreachable" is a stall AND a host loss, and the
+        # narrower type must win so fleet callers can requeue the dead
+        # host's leases (non-fleet callers see an EngineStall subclass
+        # and behave exactly as before). Raw OSErrors are excluded: a
+        # local EPIPE/ECONNRESET from the caller's own plumbing shares
+        # these phrasings, and retrying a unit cannot fix the caller's
+        # environment — peer death reported by the runtime arrives as a
+        # RuntimeError, which still classifies.
+        host_err = HostLossError(str(exc))
+        host_err.__cause__ = exc
+        return host_err
     if any(marker in msg for marker in _STALL_MARKERS):
         # Checked before the compile markers: a hung compile surfaces as
         # "deadline exceeded while compiling", which must classify as a

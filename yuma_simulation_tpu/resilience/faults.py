@@ -32,7 +32,13 @@ deterministically*, so every ladder rung runs in CI under
 - `FaultPlan.device_loss` — every elastic sharded dispatch whose mesh
   still routes to the named device raises a simulated
   :class:`..errors.DeviceLossError`, until the mesh is rebuilt without
-  it (the semantics of real hardware loss: only shrinking recovers).
+  it (the semantics of real hardware loss: only shrinking recovers);
+- `FaultPlan.host_crash` — SIGKILL the current process (a simulated
+  fleet host) after N lease claims, so the fleet drill proves lease
+  EXPIRY recovers the dead host's units (no teardown code runs);
+- `FaultPlan.lease_tear` — truncate the host's own live lease file
+  after N heartbeat renewals (simulated shared-store corruption), so
+  torn-lease tolerance and the LeaseExpired abandon path are exercised.
 
 The hooks are consulted at host level by the engines and
 `CheckpointedSweep`; with no plan armed (the production state) each is
@@ -55,6 +61,7 @@ import contextlib
 import dataclasses
 import logging
 import os
+import sys
 from typing import Optional
 
 from yuma_simulation_tpu.resilience.errors import (
@@ -95,6 +102,37 @@ class DeviceLossFault:
 
 
 @dataclasses.dataclass(frozen=True)
+class HostCrashFault:
+    """SIGKILL the CURRENT PROCESS (a simulated fleet host) after it has
+    claimed `after_claims` work-unit leases — the fleet drill's host
+    loss. SIGKILL by design: no atexit, no finally, no lease release —
+    exactly what a preempted VM or OOM-killed worker leaves behind, so
+    the drill proves lease EXPIRY (not polite cleanup) is what recovers
+    the unit. Consulted by the fabric scheduler via
+    :func:`maybe_crash_host` immediately after a claim is ledgered, so
+    the claim is durably visible to the survivors before the host
+    dies."""
+
+    after_claims: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaseTearFault:
+    """Truncate the current host's OWN LIVE lease file to `keep_bytes`
+    after its `after_renewals`-th heartbeat renewal — simulated shared-
+    filesystem corruption of a claim record. A torn lease is unparseable
+    to every scanner, which must treat it as stealable (corrupt claims
+    cannot gate work forever); the original holder discovers the theft
+    at its next renewal (identity mismatch -> typed
+    :class:`..errors.LeaseExpired`) and abandons the unit without
+    publishing. Consulted by the fabric lease store via
+    :func:`maybe_tear_lease`."""
+
+    after_renewals: int = 1
+    keep_bytes: int = 8
+
+
+@dataclasses.dataclass(frozen=True)
 class NaNFault:
     """Poison scenario lane `case`'s dividends at epoch `epoch` (global
     epoch index). `case=None` targets a single-scenario run — or every
@@ -123,6 +161,10 @@ class FaultPlan:
     stall: Optional[StallFault] = None
     #: drop one device out of the elastic sharded mesh.
     device_loss: Optional[DeviceLossFault] = None
+    #: SIGKILL this process (a simulated fleet host) after N lease claims.
+    host_crash: Optional[HostCrashFault] = None
+    #: truncate this host's live lease file after N heartbeat renewals.
+    lease_tear: Optional[LeaseTearFault] = None
 
 
 class _FaultState:
@@ -133,6 +175,9 @@ class _FaultState:
         self.stall_dispatches_seen = 0
         self.stall_dispatches_fired = 0
         self.mangled_chunks: set = set()
+        self.claims_seen = 0
+        self.renewals_seen = 0
+        self.lease_torn = False
 
 
 _ACTIVE: Optional[_FaultState] = None
@@ -265,6 +310,60 @@ def active_nan_fault() -> Optional[NaNFault]:
         case="all" if f.case is None else f.case, epoch=f.epoch,
     )
     return f
+
+
+def maybe_crash_host(unit) -> None:
+    """Fabric-scheduler hook: called (host level) immediately after a
+    work-unit lease claim has been ledgered. SIGKILLs the process once
+    the armed plan's claim count is reached — no Python teardown runs,
+    matching a real preemption/OOM kill. The unit id is logged BEFORE
+    the kill so the drill can assert which claim died."""
+    state = _ACTIVE
+    if state is None or state.plan.host_crash is None:
+        return
+    if _tracing_now():
+        return
+    state.claims_seen += 1
+    if state.claims_seen >= state.plan.host_crash.after_claims:
+        log_event(
+            logger, "fault_injected", kind="host_crash", unit=unit,
+            claims=state.claims_seen,
+        )
+        import signal
+
+        # Flush stdio: SIGKILL gives buffered log lines no second chance.
+        for stream in (sys.stdout, sys.stderr):
+            try:
+                stream.flush()
+            except Exception:
+                pass
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_tear_lease(path, unit) -> None:
+    """Lease-store hook: called (host level) after each successful
+    heartbeat renewal of this host's own lease. Truncates the live lease
+    file ONCE per armed plan — simulated shared-store corruption of a
+    claim record — so scanners exercise torn-lease tolerance and the
+    holder exercises the LeaseExpired abandon path."""
+    state = _ACTIVE
+    if state is None or state.plan.lease_tear is None or state.lease_torn:
+        return
+    if _tracing_now():
+        return
+    tear = state.plan.lease_tear
+    state.renewals_seen += 1
+    if state.renewals_seen >= tear.after_renewals:
+        state.lease_torn = True
+        try:
+            data = path.read_bytes()
+        except OSError:
+            return
+        path.write_bytes(data[: tear.keep_bytes])
+        log_event(
+            logger, "fault_injected", kind="lease_tear", unit=unit,
+            kept_bytes=tear.keep_bytes,
+        )
 
 
 def mangle_chunk_file(path, chunk_index: int) -> None:

@@ -828,6 +828,7 @@ def run_simulation(
     yuma_config: Optional[YumaConfig] = None,
     *,
     supervised: bool = False,
+    fleet=None,
 ) -> tuple[dict[str, list[float]], list[np.ndarray], list[np.ndarray]]:
     """Drop-in equivalent of the reference driver
     (simulation_utils.py:26-112): returns `(dividends_per_validator,
@@ -839,7 +840,23 @@ def run_simulation(
     default engine-degradation ladder plus the default deadline
     watchdog, so a hung compile or engine failure degrades and retries
     instead of wedging/aborting the run (README "Supervised sweeps").
+
+    `fleet=` (new; a shared store directory or a
+    :class:`..fabric.FleetConfig`) runs the simulation under FLEET
+    coordination: the case becomes one lease-claimed work unit in the
+    shared store, so N processes invoked concurrently with the same
+    store execute it exactly once between them, survive the executing
+    process dying mid-run (lease expiry -> any peer re-executes), and
+    all return the published result (README "Fleet sweeps"). Fleet runs
+    always dispatch under the supervised resilience tier — they are
+    unattended by construction.
     """
+    if fleet is not None:
+        from yuma_simulation_tpu.fabric.scheduler import run_fleet_case
+
+        return run_fleet_case(
+            case, yuma_version, yuma_config, fleet=fleet, supervised=True,
+        )
     supervision = {}
     if supervised:
         from yuma_simulation_tpu.resilience.retry import default_retry_policy

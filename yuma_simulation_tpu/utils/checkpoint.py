@@ -35,11 +35,14 @@ they are verified by decode-probing the npz instead.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import hashlib
 import json
 import logging
 import os
 import pathlib
+import shutil
+import uuid
 from typing import Callable, Optional
 
 import numpy as np
@@ -60,20 +63,83 @@ def _fsync_write(path: pathlib.Path, write_fn) -> None:
         os.fsync(f.fileno())
 
 
+def _fsync_dir(directory: pathlib.Path) -> None:
+    """fsync a directory (POSIX): a rename is only durable once the
+    DIRECTORY entry itself is on stable storage — without this, a power
+    loss immediately after `rename` can roll the directory back to the
+    pre-publish state even though the file data was fsync'd. Best-effort
+    (some filesystems/platforms refuse O_RDONLY dir fsync); never
+    raises — the publish already happened, durability is the only thing
+    at stake."""
+    if os.name != "posix":
+        return
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _publish(tmp: pathlib.Path, final: pathlib.Path) -> None:
-    """Atomically move `tmp` over `final` (same directory). POSIX rename
-    is atomic; a crash leaves either the old `final` or the new one."""
-    tmp.replace(final)
+    """Atomically move `tmp` over `final`. POSIX rename is atomic; a
+    crash leaves either the old `final` or the new one. The parent
+    directory is fsync'd after the rename so the published NAME survives
+    power loss, not just the bytes (lease/claim files on a shared store
+    depend on this). Cross-filesystem temp files (EXDEV — a caller
+    staged `tmp` on local disk, `final` lives on the shared store) fall
+    back to copy into the target directory + same-filesystem rename."""
+    try:
+        tmp.replace(final)
+    except OSError as e:
+        if e.errno != errno.EXDEV:
+            raise
+        # tmp and final are on different filesystems: rename cannot be
+        # atomic across the boundary, so re-stage IN the target
+        # directory (the copy gets its own fsync) and rename there.
+        local_tmp = final.with_name(
+            f".{final.name}.{uuid.uuid4().hex[:8]}.xdev.tmp"
+        )
+        with open(tmp, "rb") as src:
+            _fsync_write(
+                local_tmp,
+                lambda f: shutil.copyfileobj(src, f),
+            )
+        local_tmp.replace(final)
+        tmp.unlink(missing_ok=True)
+    _fsync_dir(final.parent)
 
 
-def publish_atomic(path: pathlib.Path, data: bytes) -> None:
+def publish_atomic(
+    path: pathlib.Path, data: bytes, *, tmp_dir=None
+) -> None:
     """Publish `data` at `path` under the crash-safety contract above:
-    written to a temp name, fsync'd, renamed. The shared primitive for
-    every durable sidecar in the resilience layer (checkpoint manifests
-    and checksums here, the supervisor's :class:`~yuma_simulation_tpu.
-    resilience.supervisor.FailureLedger`)."""
+    written to a temp name, fsync'd, renamed, parent directory fsync'd
+    (a published claim/ledger record must survive power loss — the
+    rename alone only orders the bytes, not the directory entry). The
+    shared primitive for every durable sidecar in the resilience layer
+    (checkpoint manifests and checksums here, the supervisor's
+    :class:`~yuma_simulation_tpu.resilience.supervisor.FailureLedger`,
+    the fleet fabric's lease and result stores).
+
+    `tmp_dir` stages the temp file elsewhere (e.g. fast local disk when
+    `path` lives on a shared network store); when that lands on a
+    different filesystem the publish transparently falls back to
+    copy + same-filesystem rename — still atomic at the target.
+
+    The temp name is writer-unique (pid + nonce): two fleet hosts
+    publishing the same shared-store path concurrently (a manifest
+    race, a fleet-report refinalize) must not truncate each other's
+    in-flight temp — each rename is atomic and the last writer wins
+    whole, never interleaved."""
     path = pathlib.Path(path)
-    tmp = path.with_name(path.name + ".tmp")
+    nonce = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    tmp_parent = pathlib.Path(tmp_dir) if tmp_dir is not None else path.parent
+    tmp = tmp_parent / f".{path.name}.{nonce}.tmp"
     _fsync_write(tmp, lambda f: f.write(data))
     _publish(tmp, path)
 
