@@ -95,6 +95,7 @@ TEST_CHUNKS = [
         "tests/unit/test_telemetry.py",
         "tests/unit/test_fabric.py",
         "tests/unit/test_fleet_drill.py",
+        "tests/unit/test_serve.py",
     ],
 ]
 
@@ -161,6 +162,28 @@ def fleet(session: nox.Session) -> None:
         "python", "-m", "tools.obsreport", bundle,
         "--fleet-drill", "--check",
     )
+
+
+@nox.session
+def serve(session: nox.Session) -> None:
+    """Serve lane (mirrors the CI `serve` job): the serving-tier test
+    battery, then the smoke drill — start a real HTTP server, fire one
+    of each contract-defining request (happy path, structured admission
+    rejection, quota shed with Retry-After, coalesced same-bucket pair)
+    — gated by `obsreport --check` over the server's flight bundle."""
+    session.install("-e", ".[test]")
+    session.run("python", "-m", "pytest", "tests/unit/test_serve.py", "-q")
+    import os
+    import shutil
+
+    bundle = os.path.join(session.create_tmp(), "serve-bundle")
+    shutil.rmtree(bundle, ignore_errors=True)
+    session.run(
+        "python", "-m", "yuma_simulation_tpu.serve", "--smoke",
+        "--bundle-dir", bundle, "--queue-limit", "16",
+        "--tenant-burst", "4", "--coalesce-window", "0.3",
+    )
+    session.run("python", "-m", "tools.obsreport", bundle, "--check")
 
 
 @nox.session

@@ -14,6 +14,7 @@ def test_v1_namespace_exports_nothing():
 _FROZEN_SURFACE = [
     "HTML",
     "Scenario",
+    "SimulationClient",
     "SimulationHyperparameters",
     "YumaConfig",
     "YumaParams",
@@ -21,6 +22,7 @@ _FROZEN_SURFACE = [
     "generate_chart_table",
     "generate_total_dividends_table",
     "run_simulation",
+    "serve",
 ]
 
 

@@ -613,3 +613,67 @@ def test_surviving_members_is_the_shared_shrink_filter():
 
     devs = [Dev(0), Dev(1), Dev(2)]
     assert [d.id for d in surviving_members(devs, [1])] == [0, 2]
+
+
+# --------------------------------------------------------- fleet grid
+
+
+def test_run_fleet_grid_matches_supervised_grid_bitwise(tmp_path):
+    """ISSUE 8 satellite (ROADMAP item 4 residual): the fleet grid
+    driver expands a hyperparameter grid into lease-claimed fleet units
+    and lands BITWISE what the single-host supervised grid produces."""
+    from yuma_simulation_tpu.fabric import FleetConfig, run_fleet_grid
+    from yuma_simulation_tpu.resilience import SweepSupervisor
+    from yuma_simulation_tpu.simulation.sweep import config_grid
+
+    case = get_cases()[0]
+    axes = {"bond_penalty": [0.0, 0.5, 1.0], "kappa": [0.4, 0.5]}
+    out = run_fleet_grid(
+        case,
+        VERSION,
+        FleetConfig(directory=tmp_path, unit_size=4),
+        axes=axes,
+    )
+    configs, points = config_grid(
+        **{k: list(v) for k, v in sorted(axes.items())}
+    )
+    ref = SweepSupervisor(directory=None, unit_size=4).run_grid(
+        case, VERSION, configs
+    )
+    assert out["points"] == points
+    np.testing.assert_array_equal(
+        np.asarray(out["dividends"]), np.asarray(ref["dividends"])
+    )
+    # 6 grid points / unit_size 4 -> 2 units, all published by this host.
+    assert out["host"].units_published == 2
+    assert out["report"].units_published == 2
+
+
+def test_run_fleet_grid_second_invocation_is_pure_collection(tmp_path):
+    """A second host joining after the grid completed publishes nothing
+    and collects the full surface — the fleet batch driver's resume
+    contract, inherited by the grid driver."""
+    from yuma_simulation_tpu.fabric import FleetConfig, run_fleet_grid
+
+    case = get_cases()[0]
+    axes = {"bond_penalty": [0.0, 1.0]}
+    first = run_fleet_grid(
+        case, VERSION, FleetConfig(directory=tmp_path, unit_size=1), axes=axes
+    )
+    second = run_fleet_grid(
+        case,
+        VERSION,
+        FleetConfig(directory=tmp_path, unit_size=1, host_id="late-joiner"),
+        axes=axes,
+    )
+    assert second["host"].units_published == 0
+    np.testing.assert_array_equal(
+        np.asarray(first["dividends"]), np.asarray(second["dividends"])
+    )
+
+
+def test_run_fleet_grid_requires_axes_or_configs(tmp_path):
+    from yuma_simulation_tpu.fabric import run_fleet_grid
+
+    with pytest.raises(ValueError, match="axes"):
+        run_fleet_grid(get_cases()[0], VERSION, tmp_path)

@@ -240,3 +240,36 @@ def test_sentinel_rejects_unjitted_callables():
         RecompilationSentinel(lambda x: x)
     with pytest.raises(ValueError, match="at least one"):
         RecompilationSentinel()
+
+
+def test_serve_warm_repeat_is_compile_free():
+    """ISSUE 8 acceptance (warm engines): after a bucket's first request
+    compiles its donor-packed batched program, every further request in
+    the same shape bucket — admission, quota, queue, coalescer, the
+    supervised dispatch, response slicing — adds ZERO compiles. The
+    whole serving pipeline is host-side around one warm jit cache."""
+    from yuma_simulation_tpu.serve import ServeConfig, SimulationService
+
+    svc = SimulationService(
+        ServeConfig(coalesce_window_seconds=0.0)
+    )
+    payload = {"tenant": "warm", "case": "Case 1"}
+    try:
+        status, _body, _h = svc.handle("simulate", dict(payload))  # warm-up
+        assert status == 200
+        with RecompilationSentinel(
+            _simulate_batch_xla,
+            _simulate_scan,
+            budget=0,
+            label="serve warm repeat",
+        ) as sentinel:
+            # Same bucket, different tenant AND different case: the
+            # bucket key (not the payload) is the compile key.
+            for tenant, case in (("warm", "Case 1"), ("other", "Case 2")):
+                status, body, _h = svc.handle(
+                    "simulate", {"tenant": tenant, "case": case}
+                )
+                assert status == 200 and body["status"] == "ok"
+        assert sentinel.new_entries == 0
+    finally:
+        svc.close()

@@ -575,3 +575,51 @@ def test_simulate_batch_rejects_unknown_epoch_impl():
     W, S, ri, re = stack_scenarios(cases)
     with pytest.raises(ValueError, match="unknown epoch_impl"):
         simulate_batch(W, S, ri, re, YumaConfig(), spec, epoch_impl="fast")
+
+
+@pytest.mark.parametrize(
+    "message",
+    [
+        # every stall-marker phrasing...
+        "DEADLINE_EXCEEDED: operation timed out after 600s",
+        "collective operation timed out: all-reduce id=7",
+        "barrier timed out: 3 of 4 tasks arrived",
+        # ...every host-loss phrasing...
+        "heartbeat timeout: coordinator unreachable",
+        "connection reset by peer",
+        "worker task died",
+        # ...and every resource/compile phrasing
+        "RESOURCE_EXHAUSTED: out of memory while allocating",
+        "INTERNAL: Mosaic failed to compile",
+    ],
+)
+def test_classify_failure_serve_errors_immune_to_markers(message):
+    """ISSUE 8 satellite: the serving tier's typed errors are decisions,
+    not messages. An AdmissionRejected or QueueOverflow whose text
+    happens to contain a stall/host-loss/resource/compile marker must
+    NEVER re-classify into a retryable engine failure — the ladder
+    retrying a rejected or shed request would re-run exactly the work
+    admission/backpressure refused. Pinned per pattern, like the PR 3
+    stall and PR 7 host-loss batteries."""
+    from yuma_simulation_tpu.resilience import AdmissionRejected, QueueOverflow
+
+    assert classify_failure(AdmissionRejected(message)) is None, message
+    assert classify_failure(QueueOverflow(message)) is None, message
+
+
+def test_serve_error_payloads_survive_typing():
+    """The typed fields the HTTP layer serializes (reason/suggestion,
+    retry_after/queue_depth) ride the exception objects."""
+    from yuma_simulation_tpu.resilience import AdmissionRejected, QueueOverflow
+
+    rej = AdmissionRejected(
+        "predicted 12.0 GiB exceeds capacity",
+        reason="preflight_rejected",
+        suggestion="stream with max_resident_epochs<=512",
+    )
+    assert rej.reason == "preflight_rejected"
+    assert "max_resident_epochs" in rej.suggestion
+    ovf = QueueOverflow("queue at bound", retry_after=3.25, queue_depth=64)
+    assert ovf.retry_after == 3.25
+    assert ovf.queue_depth == 64
+    assert ovf.retryable is True  # by the CLIENT, never the ladder

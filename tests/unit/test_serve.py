@@ -1,0 +1,751 @@
+"""Serving tier: admission, backpressure, coalescing, degradation.
+
+ISSUE 8 acceptance surface. The hostile-traffic contract under test:
+every request gets a TYPED response (result / partial-with-quarantine /
+429 + Retry-After / structured rejection — zero bare 500s), coalesced
+lanes are bitwise the solo dispatch, the queue/shed/breaker metrics are
+live on /metrics and in the flight bundle, and a mid-request device
+loss degrades into a structured response instead of a 500 (the sharded
+half gated on HAS_JAX_SHARD_MAP exactly like the elastic drills)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.resilience import (
+    AdmissionRejected,
+    DeviceLossFault,
+    FaultPlan,
+    OverloadFault,
+    QueueOverflow,
+    classify_failure,
+    inject_faults,
+)
+from yuma_simulation_tpu.scenarios import create_case
+from yuma_simulation_tpu.scenarios.synthetic import random_subnet_scenario
+from yuma_simulation_tpu.serve import (
+    CircuitBreaker,
+    ServeConfig,
+    SimulationClient,
+    SimulationServer,
+    SimulationService,
+    TokenBucket,
+    wait_until_ready,
+)
+
+VERSION = "Yuma 1 (paper)"
+
+
+def _service(**knobs) -> SimulationService:
+    knobs.setdefault("coalesce_window_seconds", 0.0)
+    return SimulationService(ServeConfig(**knobs))
+
+
+def _scenario_payload(scenario, **extra) -> dict:
+    return {
+        "weights": np.asarray(scenario.weights).tolist(),
+        "stakes": np.asarray(scenario.stakes).tolist(),
+        **extra,
+    }
+
+
+# ---------------------------------------------------------------------------
+# quotas / breaker units (pure host logic, injectable clocks)
+
+
+def test_token_bucket_refills_on_the_clock():
+    t = [0.0]
+    bucket = TokenBucket(rate=2.0, burst=2, clock=lambda: t[0])
+    assert bucket.try_acquire() == 0.0
+    assert bucket.try_acquire() == 0.0
+    wait = bucket.try_acquire()
+    assert wait == pytest.approx(0.5)
+    t[0] += 0.5  # one token refilled
+    assert bucket.try_acquire() == 0.0
+    assert bucket.try_acquire() > 0.0
+
+
+def test_token_bucket_zero_rate_never_refills():
+    bucket = TokenBucket(rate=0.0, burst=1, clock=lambda: 0.0)
+    assert bucket.try_acquire() == 0.0
+    assert bucket.try_acquire() >= 60.0
+
+
+def test_breaker_trips_half_opens_and_closes():
+    t = [0.0]
+    b = CircuitBreaker(threshold=2, cooldown_seconds=10.0, clock=lambda: t[0])
+    ladder = ("fused_scan", "xla")
+    assert b.filter_ladder(ladder) == ladder
+    b.record_failure("fused_scan")
+    assert b.filter_ladder(ladder) == ladder  # below threshold
+    b.record_failure("fused_scan")  # trips open
+    assert b.filter_ladder(ladder) == ("xla",)
+    assert b.snapshot()["fused_scan"]["state"] == "open"
+    t[0] = 10.0  # cooldown elapsed -> exactly one half-open probe
+    assert b.filter_ladder(ladder) == ladder
+    assert b.snapshot()["fused_scan"]["state"] == "half_open"
+    assert b.filter_ladder(ladder) == ("xla",)  # second caller: still open
+    b.record_failure("fused_scan")  # probe failed -> re-open, new cooldown
+    assert b.filter_ladder(ladder) == ("xla",)
+    t[0] = 20.0
+    assert b.filter_ladder(ladder) == ladder  # probe again
+    b.record_success("fused_scan")  # probe succeeded -> closed
+    assert b.snapshot()["fused_scan"]["state"] == "closed"
+    assert b.filter_ladder(ladder) == ladder
+
+
+def test_breaker_abort_probe_releases_the_latch():
+    """A half-open probe dying on a NON-engine failure must not leave
+    `probing` latched (which would keep the rung dead forever): abort
+    clears the latch, the rung stays open, and the next caller is
+    admitted as a fresh probe."""
+    t = [0.0]
+    b = CircuitBreaker(threshold=1, cooldown_seconds=5.0, clock=lambda: t[0])
+    ladder = ("fused_scan", "xla")
+    b.record_failure("fused_scan")  # trips
+    t[0] = 5.0
+    assert b.filter_ladder(ladder) == ladder  # half-open probe admitted
+    b.abort_probe("fused_scan")  # probe died on a caller error
+    assert b.snapshot()["fused_scan"]["state"] == "open"
+    assert b.filter_ladder(ladder) == ladder  # fresh probe, not dead
+    b.abort_probe("xla")  # no-op on a non-probing rung
+    b.record_success("fused_scan")
+    assert b.snapshot()["fused_scan"]["state"] == "closed"
+
+
+def test_plan_demoted_reanchors_below_only():
+    """The breaker's re-anchoring primitive: `DispatchPlan.demoted`
+    walks DOWN the plan's own ladder (never upgrades), switches to the
+    pre-resolved XLA fallback consensus, and records why."""
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.models.config import YumaConfig
+    from yuma_simulation_tpu.simulation.planner import plan_dispatch
+
+    plan = plan_dispatch(
+        "breaker-test",
+        (40, 3, 2),
+        VERSION,
+        YumaConfig(),
+        jnp.float32,
+        epoch_impl="fused_scan",
+        quarantine=False,
+    )
+    assert plan.demoted("fused_scan") is plan  # same rung: no-op
+    lower = plan.demoted("xla")
+    assert lower.engine == "xla"
+    assert lower.ladder == ("xla",)
+    assert lower.consensus_impl == plan.fallback_consensus
+    assert any("circuit breaker" in r for r in lower.reasons)
+    with pytest.raises(ValueError, match="walks DOWN|only walks DOWN"):
+        lower.demoted("fused_scan")
+
+
+def test_tenant_quota_table_is_bounded():
+    """A hostile client minting a fresh tenant per request cannot grow
+    the bucket table without bound; negotiated-override tenants are
+    pinned through the flood."""
+    from yuma_simulation_tpu.serve import TenantQuotas
+
+    q = TenantQuotas(
+        rate=1.0,
+        burst=1,
+        overrides={"vip": (5.0, 5)},
+        clock=lambda: 0.0,
+        max_tenants=8,
+    )
+    q.bucket("vip")
+    for i in range(100):
+        q.bucket(f"hostile-{i}")
+    assert len(q._buckets) <= 8
+    assert "vip" in q._buckets  # the override tenant survived eviction
+
+
+def test_breaker_never_opens_the_last_rung():
+    b = CircuitBreaker(threshold=1, cooldown_seconds=1e9, clock=lambda: 0.0)
+    b.record_failure("xla")
+    b.record_failure("xla")
+    assert b.filter_ladder(("xla",)) == ("xla",)
+
+
+# ---------------------------------------------------------------------------
+# admission
+
+
+def test_admission_rejects_malformed_payloads():
+    svc = _service(start_dispatcher=False)
+    try:
+        for payload in (
+            [],  # not an object
+            {"weights": [[1.0]]},  # wrong rank, no stakes
+            {"case": "No Such Case"},
+            {"case": "Case 1", "version": "Yuma 99"},
+            {"case": "Case 1", "engine": "warp_drive"},
+            {"case": "Case 1", "deadline_seconds": -5},
+            {"case": "Case 1", "config": {"liquid_alpha": 1.0}},
+            {"case": "Case 1", "engine": "fused_scan", "quarantine": True},
+            {
+                "weights": np.zeros((2, 3, 4)).tolist(),
+                "stakes": np.zeros((2, 2)).tolist(),  # mismatched V
+            },
+        ):
+            status, body, _ = svc.handle("simulate", payload)
+            assert status == 400, (payload, body)
+            assert body["error"] == "AdmissionRejected"
+            assert body["status"] == "rejected"
+    finally:
+        svc.close()
+
+
+def test_admission_preflight_rejects_with_suggestion(monkeypatch):
+    """The analytic HBM preflight prices the request BEFORE any compile:
+    under a nano device spec the shape is rejected with the planner's
+    stream/shard suggestion in the structured 400."""
+    monkeypatch.setenv(
+        "YUMA_TPU_DEVICE_SPEC",
+        json.dumps({"name": "nano-serve", "memory_bytes": 16384}),
+    )
+    svc = _service(start_dispatcher=False)
+    try:
+        scenario = random_subnet_scenario(
+            0, num_validators=8, num_miners=16, num_epochs=40
+        )
+        status, body, _ = svc.handle(
+            "simulate", _scenario_payload(scenario, tenant="big")
+        )
+        assert status == 400
+        assert body["reason"] == "preflight_rejected"
+        assert "suggestion" in body
+    finally:
+        svc.close()
+
+
+def test_admission_caps_sweep_grid_cardinality():
+    """A hostile `axes` payload whose cartesian product explodes is
+    rejected at admission — the grid is materialized host-side at
+    dispatch, so unbounded points would be a host-memory DoS the array
+    ceilings cannot catch."""
+    svc = _service(start_dispatcher=False)
+    try:
+        status, body, _ = svc.handle(
+            "sweep",
+            {
+                "tenant": "hostile",
+                "case": "Case 1",
+                "axes": {
+                    "kappa": list(np.linspace(0.1, 0.9, 100)),
+                    "bond_alpha": list(np.linspace(0.1, 0.9, 100)),
+                    "bond_penalty": list(np.linspace(0.0, 1.0, 100)),
+                },
+            },
+        )
+        assert status == 400
+        assert body["error"] == "AdmissionRejected"
+        assert "points" in body["message"]
+    finally:
+        svc.close()
+
+
+def test_classify_failure_never_reclassifies_serve_errors():
+    """PR 3/PR 7 marker discipline: the typed serve errors are decisions,
+    not messages — phrasings that LOOK like stall/host-loss/resource
+    markers must not re-classify them into retryable engine failures."""
+    for exc in (
+        AdmissionRejected(
+            "heartbeat timeout: connection reset by peer "
+            "(a hostile payload could phrase anything)"
+        ),
+        AdmissionRejected("RESOURCE_EXHAUSTED out of memory"),
+        QueueOverflow("deadline exceeded: collective operation timed out"),
+        QueueOverflow("coordinator unreachable; worker task died"),
+    ):
+        assert classify_failure(exc) is None, exc
+    # The typed payload survives for the HTTP layer.
+    exc = QueueOverflow("shed", retry_after=2.5, queue_depth=7)
+    assert exc.retry_after == 2.5 and exc.queue_depth == 7 and exc.retryable
+    rej = AdmissionRejected("no", reason="preflight_rejected", suggestion="s")
+    assert rej.reason == "preflight_rejected" and rej.suggestion == "s"
+
+
+# ---------------------------------------------------------------------------
+# backpressure
+
+
+def test_tenant_quota_sheds_with_retry_after():
+    svc = _service(
+        tenant_overrides={"greedy": (0.0, 2)}, start_dispatcher=False
+    )
+    try:
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(
+                    svc.handle("simulate", {"tenant": "greedy", "case": "Case 1"})
+                )
+            )
+            for _ in range(2)
+        ]
+        # The first two requests hold the tenant's whole burst; they sit
+        # queued (no dispatcher) while the third arrives.
+        for th in threads:
+            th.start()
+        for _ in range(100):
+            if len(svc.queue) == 2:
+                break
+            time.sleep(0.05)
+        status, body, headers = svc.handle(
+            "simulate", {"tenant": "greedy", "case": "Case 1"}
+        )
+        assert status == 429
+        assert body["error"] == "QueueOverflow"
+        assert body["retry_after"] > 0
+        assert "Retry-After" in headers
+        # Another tenant's bucket is untouched: queued fine.
+        svc.start_dispatcher()
+        status2, body2, _ = svc.handle(
+            "simulate", {"tenant": "polite", "case": "Case 1"}
+        )
+        assert status2 == 200 and body2["status"] == "ok"
+        for th in threads:
+            th.join(timeout=120)
+        assert [s for s, _b, _h in results] == [200, 200]
+    finally:
+        svc.close()
+
+
+def test_queue_bound_sheds_with_retry_after():
+    svc = _service(queue_limit=2, start_dispatcher=False)
+    try:
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda i=i: results.append(
+                    svc.handle("simulate", {"tenant": f"t{i}", "case": "Case 1"})
+                )
+            )
+            for i in range(2)
+        ]
+        for th in threads:
+            th.start()
+        for _ in range(100):
+            if len(svc.queue) == 2:
+                break
+            time.sleep(0.05)
+        status, body, headers = svc.handle(
+            "simulate", {"tenant": "t9", "case": "Case 1"}
+        )
+        assert status == 429 and body["error"] == "QueueOverflow"
+        assert headers.get("Retry-After")
+        assert svc.registry.counter("serve_requests_shed").value >= 1
+        svc.start_dispatcher()
+        for th in threads:
+            th.join(timeout=120)
+        assert [s for s, _b, _h in results] == [200, 200]
+    finally:
+        svc.close()
+
+
+@pytest.mark.faultinject
+def test_overload_burst_sheds_and_server_recovers():
+    """The OverloadFault drill: a synthetic admission-layer burst fills
+    the bounded queue, the real request sheds 429 with Retry-After, the
+    shed counter moves — and once the burst drains, the same request
+    succeeds. The server never answers anything untyped."""
+    svc = _service(queue_limit=4, start_dispatcher=False)
+    try:
+        shed_before = svc.registry.counter("serve_requests_shed").value
+        with inject_faults(FaultPlan(overload=OverloadFault(requests=12))):
+            status, body, headers = svc.handle(
+                "simulate", {"tenant": "victim", "case": "Case 1"}
+            )
+        assert status == 429 and body["error"] == "QueueOverflow"
+        assert headers.get("Retry-After")
+        # 12-burst into a 4-slot queue: >= 8 synthetic sheds + the victim.
+        assert (
+            svc.registry.counter("serve_requests_shed").value
+            >= shed_before + 9
+        )
+        svc.start_dispatcher()  # drain the synthetic burst
+        status2, body2, _ = svc.handle(
+            "simulate", {"tenant": "victim", "case": "Case 1"}
+        )
+        assert status2 == 200 and body2["status"] == "ok"
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# coalescing: bitwise vs solo, under concurrency
+
+
+def _soak_payloads():
+    """Two shape buckets: the built-in [40,3,2] cases and a 40x10x7
+    synthetic family (padded_V 16 vs 8 — distinct buckets by the
+    planner's tile policy)."""
+    payloads = [
+        {"tenant": "a", "case": "Case 1"},
+        {"tenant": "b", "case": "Case 2"},
+        {"tenant": "c", "case": "Case 4"},  # reset-bonds case
+    ]
+    for seed in (1, 2, 3):
+        payloads.append(
+            _scenario_payload(
+                random_subnet_scenario(
+                    seed, num_validators=10, num_miners=7, num_epochs=40
+                ),
+                tenant=f"s{seed}",
+            )
+        )
+    return payloads
+
+
+def test_concurrent_soak_coalesced_bitwise_vs_solo():
+    """N threads x mixed shapes through one server: every response is a
+    typed 200, same-bucket requests coalesce into shared dispatches,
+    and every coalesced result is BITWISE the solo dispatch of the same
+    request (the donor-packing contract, end to end)."""
+    payloads = _soak_payloads()
+
+    # Solo oracle: same service pipeline, coalescing off, sequential.
+    solo_svc = _service()
+    try:
+        solo = [
+            solo_svc.handle("simulate", dict(p)) for p in payloads
+        ]
+    finally:
+        solo_svc.close()
+    assert all(s == 200 for s, _b, _h in solo)
+
+    # Soak: queue everything BEFORE the dispatcher starts, so grouping
+    # is deterministic (first pop sweeps all bucket-mates).
+    svc = _service(
+        coalesce_window_seconds=0.05, max_batch=8, start_dispatcher=False
+    )
+    try:
+        results: dict[int, tuple] = {}
+        threads = [
+            threading.Thread(
+                target=lambda i=i: results.__setitem__(
+                    i, svc.handle("simulate", dict(payloads[i]))
+                )
+            )
+            for i in range(len(payloads))
+        ]
+        for th in threads:
+            th.start()
+        for _ in range(200):
+            if len(svc.queue) == len(payloads):
+                break
+            time.sleep(0.05)
+        assert len(svc.queue) == len(payloads)
+        svc.start_dispatcher()
+        for th in threads:
+            th.join(timeout=300)
+        assert sorted(results) == list(range(len(payloads)))
+
+        coalesced_counts = []
+        for i, payload in enumerate(payloads):
+            status, body, _ = results[i]
+            assert status == 200, body
+            assert body["status"] == "ok"
+            coalesced_counts.append(body["coalesced"])
+            _s, solo_body, _h = solo[i]
+            # Bitwise: the exact float lists of the solo dispatch.
+            assert body["dividends"] == solo_body["dividends"], (
+                f"request {i} coalesced result diverged from solo"
+            )
+            assert body["total_dividends"] == solo_body["total_dividends"]
+        # Both buckets actually coalesced (3 members each).
+        assert max(coalesced_counts) >= 2
+        assert (
+            svc.registry.counter("serve_coalesced_lanes").value >= 4
+        )
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation
+
+
+@pytest.mark.faultinject
+def test_breaker_trips_fleet_wide_after_typed_failures():
+    """Repeated typed failures on an explicitly requested fused rung:
+    each request individually demotes to xla (typed, 200), and after
+    `breaker_threshold` of them the rung trips — subsequent requests
+    start at xla with ZERO demotions (no latency paid to the dead rung)
+    until the cooldown's half-open probe."""
+    svc = _service(
+        breaker_threshold=2, breaker_cooldown_seconds=3600.0
+    )
+    payload = {
+        "tenant": "fused-power-user",
+        "case": "Case 1",
+        "engine": "fused_scan",
+        "quarantine": False,
+    }
+    try:
+        with inject_faults(FaultPlan(fused_oom_dispatches=1000)):
+            for i in range(2):
+                status, body, _ = svc.handle("simulate", dict(payload))
+                assert status == 200, body
+                assert body["report"]["engine_demotions"] >= 1, (i, body)
+                assert body["report"]["engines_used"] == ["xla"]
+            # Tripped: the fused rung is skipped fleet-wide, so the
+            # fault (which only fires on fused dispatches) never fires
+            # and no demotion latency is paid.
+            status, body, _ = svc.handle("simulate", dict(payload))
+            assert status == 200
+            assert body["report"]["engine_demotions"] == 0
+            assert body["report"]["engines_used"] == ["xla"]
+        assert svc.breaker.snapshot()["fused_scan"]["state"] == "open"
+        assert svc.registry.counter("serve_breaker_trips").value >= 1
+    finally:
+        svc.close()
+
+
+@pytest.mark.chaos
+def test_nan_lane_returns_partial_not_500():
+    """A request whose simulation goes non-finite comes back as a
+    structured PARTIAL response carrying the quarantine provenance —
+    never a 500 — and a healthy request coalesced into the same
+    dispatch stays bitwise clean."""
+    from yuma_simulation_tpu.resilience import NaNFault
+
+    solo_svc = _service()
+    try:
+        _s, clean_body, _h = solo_svc.handle(
+            "simulate", {"tenant": "clean", "case": "Case 2"}
+        )
+    finally:
+        solo_svc.close()
+
+    svc = _service(max_batch=4, start_dispatcher=False)
+    try:
+        results: dict[int, tuple] = {}
+        payloads = [
+            {"tenant": "poisoned", "case": "Case 1"},
+            {"tenant": "clean", "case": "Case 2"},
+        ]
+        threads = [
+            threading.Thread(
+                target=lambda i=i: results.__setitem__(
+                    i, svc.handle("simulate", dict(payloads[i]))
+                )
+            )
+            for i in range(2)
+        ]
+        for th in threads:
+            th.start()
+        for _ in range(100):
+            if len(svc.queue) == 2:
+                break
+            time.sleep(0.05)
+        with inject_faults(FaultPlan(nan=NaNFault(epoch=2, case=0))):
+            svc.start_dispatcher()
+            for th in threads:
+                th.join(timeout=300)
+        status0, body0, _ = results[0]
+        status1, body1, _ = results[1]
+        assert status0 == 200 and body0["status"] == "partial"
+        assert body0["quarantine"][0]["epoch"] == 2
+        assert body0["degraded"] is True
+        # The healthy tenant in the SAME coalesced dispatch: clean and
+        # bitwise identical to its unfaulted solo run.
+        assert status1 == 200 and body1["status"] == "ok"
+        assert body1["coalesced"] == 2
+        assert body1["dividends"] == clean_body["dividends"]
+    finally:
+        svc.close()
+
+
+@pytest.mark.chaos
+def test_device_loss_mid_request_returns_structured_degraded():
+    """Mid-request device loss: the elastic mesh shrinks under the
+    supervisor, the response is a structured 200 with the degradation
+    visible (mesh_shrinks, degraded=true), and the server keeps serving.
+    Gated on HAS_JAX_SHARD_MAP exactly like the elastic drills."""
+    from yuma_simulation_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    lost = mesh.devices.flat[1].id
+    svc = _service(mesh=mesh, default_deadline_seconds=240.0)
+    payload = {"tenant": "sharded", "case": "Case 1"}
+    try:
+        status, body, _ = svc.handle("simulate", dict(payload))  # warm
+        assert status == 200, body
+        with inject_faults(
+            FaultPlan(device_loss=DeviceLossFault(device_id=lost))
+        ):
+            status, body, _ = svc.handle("simulate", dict(payload))
+        assert status == 200, body
+        assert body["status"] == "ok"
+        assert body["degraded"] is True
+        assert body["report"]["mesh_shrinks"] >= 1
+        # The server survived: next request is clean.
+        status, body, _ = svc.handle("simulate", dict(payload))
+        assert status == 200 and body["degraded"] is False
+    finally:
+        svc.close()
+
+
+def test_deadline_exhausted_while_queued_is_typed():
+    svc = _service(start_dispatcher=False, default_deadline_seconds=0.2)
+    try:
+        result = {}
+        th = threading.Thread(
+            target=lambda: result.setdefault(
+                "r", svc.handle("simulate", {"tenant": "late", "case": "Case 1"})
+            )
+        )
+        th.start()
+        for _ in range(100):
+            if len(svc.queue) == 1:
+                break
+            time.sleep(0.02)
+        time.sleep(0.3)  # let the deadline lapse while queued
+        svc.start_dispatcher()
+        th.join(timeout=60)
+        status, body, _ = result["r"]
+        assert status == 504
+        assert body["error"] == "DeadlineExhausted" and body["retryable"]
+    finally:
+        svc.close()
+
+
+def test_shutdown_is_graceful_and_typed():
+    svc = _service()
+    status, body, _ = svc.handle("simulate", {"tenant": "x", "case": "Case 1"})
+    assert status == 200
+    svc.close()
+    svc.close()  # idempotent
+    status, body, _ = svc.handle("simulate", {"tenant": "x", "case": "Case 1"})
+    assert status == 503 and body["status"] == "shutting_down"
+
+
+# ---------------------------------------------------------------------------
+# sweep / table endpoints
+
+
+def test_sweep_endpoint_matches_direct_grid():
+    from yuma_simulation_tpu.resilience.supervisor import SweepSupervisor
+    from yuma_simulation_tpu.simulation.sweep import config_grid
+
+    svc = _service()
+    try:
+        status, body, _ = svc.handle(
+            "sweep",
+            {
+                "tenant": "grid",
+                "case": "Case 1",
+                "axes": {"bond_penalty": [0.0, 0.5, 1.0]},
+            },
+        )
+        assert status == 200 and body["status"] == "ok", body
+        assert [p["bond_penalty"] for p in body["points"]] == [0.0, 0.5, 1.0]
+        configs, _points = config_grid(bond_penalty=[0.0, 0.5, 1.0])
+        ref = SweepSupervisor(directory=None, unit_size=8).run_grid(
+            create_case("Case 1"), VERSION, configs
+        )
+        np.testing.assert_array_equal(
+            np.asarray(body["total_dividends"]),
+            np.asarray(ref["dividends"]).sum(axis=1),
+        )
+    finally:
+        svc.close()
+
+
+def test_table_endpoint_returns_csv():
+    svc = _service()
+    try:
+        status, body, _ = svc.handle(
+            "table", {"tenant": "csv", "versions": [VERSION]}
+        )
+        assert status == 200 and body["status"] == "ok"
+        assert body["csv"].startswith("Case,")
+        assert "Case 1" in body["csv"]
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer + flight bundle + obsreport
+
+
+def test_http_server_end_to_end(tmp_path):
+    bundle_dir = tmp_path / "serve-bundle"
+    server = SimulationServer(
+        ServeConfig(
+            coalesce_window_seconds=0.0, bundle_dir=str(bundle_dir)
+        )
+    ).start()
+    try:
+        assert wait_until_ready(server.url)
+        client = SimulationClient(server.url, tenant="alice")
+        r = client.simulate(case="Case 1")
+        assert r.status == 200 and r.ok, r.body
+        bad = client.simulate(weights=[[1.0]])
+        assert bad.status == 400 and bad.body["error"] == "AdmissionRejected"
+        health = client.healthz()
+        assert health.status == 200 and health.body["status"] == "ok"
+        assert health.body["requests_total"] >= 2
+        metrics = client.metrics()
+        for series in (
+            "serve_queue_depth",
+            "serve_requests_shed",
+            "serve_breaker_open",
+            "serve_requests_total",
+            "serve_request_seconds",
+        ):
+            assert series in metrics, series
+        missing = client._request("POST", "/v1/nope", {})
+        assert missing.status == 404
+    finally:
+        server.close()
+
+    # The flight bundle is sound (obsreport --check's gate) and renders
+    # the per-tenant request timeline.
+    from tools.obsreport import render, render_serve
+    from yuma_simulation_tpu.telemetry.flight import check_bundle, load_bundle
+
+    bundle = load_bundle(bundle_dir)
+    assert check_bundle(bundle) == []
+    run_id = bundle.latest_run_id()
+    serve_lines = "\n".join(render_serve(bundle, run_id))
+    assert "tenant alice" in serve_lines
+    assert "request:" in serve_lines
+    full = render(bundle, run_id)
+    assert "serve requests" in full
+    # The acceptance metrics land in the bundle snapshot too.
+    last = bundle.metrics[-1]
+    assert "serve_queue_depth" in last["gauges"]
+    assert "serve_requests_shed" in last["counters"]
+    assert "serve_breaker_trips" in last["counters"]
+
+
+def test_http_rejects_undecodable_body():
+    import urllib.error
+    import urllib.request
+
+    server = SimulationServer(ServeConfig(start_dispatcher=True)).start()
+    try:
+        assert wait_until_ready(server.url)
+        req = urllib.request.Request(
+            server.url + "/v1/simulate",
+            data=b"{not json",
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                status = resp.status
+                body = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as err:
+            status = err.code
+            body = json.loads(err.read().decode())
+        assert status == 400 and body["error"] == "InvalidJSON"
+    finally:
+        server.close()

@@ -8,7 +8,9 @@ loads the bundle a supervised sweep leaves in its checkpoint directory
 with every ledger record — demotions, stalls, shrinks, requeues,
 quarantines — attributed to its span, cross-checked against the run's
 `SweepHealthReport`, plus a perf section (AOT cost report + roofline
-verdicts) when the bundle carries cost records.
+verdicts) when the bundle carries cost records and a per-tenant request
+timeline when it carries a serving run's `request:*` spans
+(`yuma_simulation_tpu.serve`).
 
 Usage::
 
@@ -151,6 +153,10 @@ def render(bundle, run_id: str | None) -> str:
     if plans:
         lines.append("")
         lines.extend(plans)
+    serve = render_serve(bundle, target)
+    if serve:
+        lines.append("")
+        lines.extend(serve)
     perf = render_perf(bundle)
     if perf:
         lines.append("")
@@ -193,6 +199,52 @@ def render_plans(bundle, run_id: str) -> list[str]:
         if plan.get("why"):
             parts.append(f"({plan['why']})")
         lines.append(" ".join(parts))
+    return lines
+
+
+def render_serve(bundle, run_id: str) -> list[str]:
+    """The per-tenant request timeline of a SERVING run: one section
+    per tenant, one line per ``request:*`` span — arrival time,
+    endpoint, outcome, HTTP status, wall duration — so a server's
+    flight bundle answers "what did each tenant see" without grepping
+    the ledger. Renders only when the bundle carries serve spans."""
+    requests = []
+    for s in bundle.spans:
+        if s.get("run_id") != run_id:
+            continue
+        if not str(s.get("name", "")).startswith("request:"):
+            continue
+        requests.append(s)
+    if not requests:
+        return []
+    by_tenant: dict[str, list] = {}
+    for s in requests:
+        attrs = s.get("attrs") or {}
+        by_tenant.setdefault(str(attrs.get("tenant", "?")), []).append(s)
+    lines = [f"serve requests ({len(requests)} across {len(by_tenant)} tenant(s)):"]
+    for tenant in sorted(by_tenant):
+        spans = sorted(
+            by_tenant[tenant], key=lambda s: float(s.get("t_start") or 0.0)
+        )
+        shed = sum(
+            1
+            for s in spans
+            if (s.get("attrs") or {}).get("status") in (429, 503, 504)
+        )
+        lines.append(
+            f"  tenant {tenant}: {len(spans)} request(s)"
+            + (f", {shed} shed/failed" if shed else "")
+        )
+        for s in spans:
+            attrs = s.get("attrs") or {}
+            t0, t1 = s.get("t_start"), s.get("t_end")
+            dur = f"{t1 - t0:.3f}s" if t0 and t1 else "?"
+            lines.append(
+                f"    {_fmt_ts(t0)}  {s.get('name')} "
+                f"{attrs.get('endpoint', '?')} "
+                f"-> {attrs.get('status', '?')} "
+                f"{attrs.get('outcome', '')} {dur}".rstrip()
+            )
     return lines
 
 

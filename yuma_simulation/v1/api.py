@@ -9,6 +9,7 @@ makes public (`generate_total_dividends_table`, `run_simulation`).
 from yuma_simulation_tpu.v1.api import (  # noqa: F401
     HTML,
     Scenario,
+    SimulationClient,
     SimulationHyperparameters,
     YumaConfig,
     YumaParams,
@@ -16,11 +17,13 @@ from yuma_simulation_tpu.v1.api import (  # noqa: F401
     generate_chart_table,
     generate_total_dividends_table,
     run_simulation,
+    serve,
 )
 
 __all__ = [
     "HTML",
     "Scenario",
+    "SimulationClient",
     "SimulationHyperparameters",
     "YumaConfig",
     "YumaParams",
@@ -28,4 +31,5 @@ __all__ = [
     "generate_chart_table",
     "generate_total_dividends_table",
     "run_simulation",
+    "serve",
 ]

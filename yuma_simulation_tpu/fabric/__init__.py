@@ -14,7 +14,7 @@ and publish is at-most-once.
 - :mod:`.lease` — the claim/heartbeat/steal protocol;
 - :mod:`.store` — manifest + per-unit results + per-host bundles;
 - :mod:`.scheduler` — the host loop and the `run_fleet_batch` /
-  `run_fleet_case` entry points;
+  `run_fleet_grid` / `run_fleet_case` entry points;
 - :mod:`.health` — the merged-ledger :class:`FleetHealthReport` and the
   `obsreport --check` fleet gate;
 - :mod:`.simhost` — multiprocess simulated hosts + the pod-level chaos
@@ -44,6 +44,7 @@ from yuma_simulation_tpu.fabric.scheduler import (  # noqa: F401
     run_fleet_artifacts,
     run_fleet_batch,
     run_fleet_case,
+    run_fleet_grid,
 )
 from yuma_simulation_tpu.fabric.store import (  # noqa: F401
     FleetStore,

@@ -551,6 +551,127 @@ def run_fleet_batch(
     }
 
 
+def run_fleet_grid(
+    scenario,
+    yuma_version: str,
+    fleet: FleetConfig | str | pathlib.Path,
+    *,
+    axes: Optional[dict] = None,
+    configs=None,
+    points: Optional[list] = None,
+    tag: str = "",
+    supervisor=None,
+    finalize: bool = True,
+) -> dict:
+    """Run a hyperparameter grid (or a Monte-Carlo parameter sample —
+    any `axes` value lists, random draws included) as this process's
+    share of a FLEET: the fleet analogue of
+    :meth:`..resilience.supervisor.SweepSupervisor.run_grid`, closing
+    the ROADMAP item 4 residual (fleet drivers for generated sweeps).
+
+    `axes` maps config field names to value lists exactly as
+    :func:`..simulation.sweep.config_grid` takes them; every
+    participating host must call with the SAME scenario/version/axes
+    against the same store (the manifest fingerprint enforces the grid
+    shape). Alternatively pass a pre-built batched `configs` (+ its
+    `points` list) — e.g. a seeded Monte-Carlo sample — which every
+    host must construct identically (pass the seed, not the sample,
+    between hosts). Grid points partition into `fleet.unit_size` units;
+    each unit re-slices the batched config pytree and computes through
+    the local supervisor, inheriting deadline/ladder/quarantine.
+
+    Returns ``{"dividends": [P, E, V], "quarantine": QuarantineReport,
+    "report": FleetHealthReport, "host": FleetHostSummary, "points":
+    [...]}`` once every unit is published. `finalize=False` skips the
+    report publish + collection (drill workers)."""
+    import jax
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.fabric.health import (
+        publish_fleet_report,
+        quarantine_entries,
+    )
+    from yuma_simulation_tpu.resilience.guards import QuarantineReport
+    from yuma_simulation_tpu.resilience.supervisor import SweepSupervisor
+
+    if not isinstance(fleet, FleetConfig):
+        fleet = FleetConfig(directory=fleet)
+    if configs is None:
+        if not axes:
+            raise ValueError(
+                "run_fleet_grid needs axes={field: [values]} (or a "
+                "pre-built configs batch)"
+            )
+        from yuma_simulation_tpu.simulation.sweep import config_grid
+
+        axes = {k: [float(v) for v in vs] for k, vs in sorted(axes.items())}
+        configs, points = config_grid(**axes)
+    leaves = jax.tree.leaves(configs)
+    num_points = next(
+        (leaf.shape[0] for leaf in leaves if jnp.ndim(leaf) > 0), 1
+    )
+    lanes = partition_lanes(num_points, fleet.unit_size)
+    tag = tag or f"fleet_grid:{yuma_version}"
+
+    def compute(idx: int, lo: int, hi: int) -> dict:
+        unit_cfg = jax.tree.map(
+            lambda leaf: leaf[lo:hi] if jnp.ndim(leaf) > 0 else leaf,
+            configs,
+        )
+        sup = supervisor if supervisor is not None else SweepSupervisor(
+            directory=None, unit_size=fleet.unit_size
+        )
+        out = sup.run_grid(
+            scenario,
+            yuma_version,
+            unit_cfg,
+            tag=f"{tag}:fleetunit{idx}",
+        )
+        rep = out["report"]
+        return {
+            "dividends": np.asarray(out["dividends"]),
+            "_engine": ",".join(rep.engines_used),
+            "_attempts": 1 + rep.units_retried,
+            "_stalls": rep.stalls_killed,
+            "_demotions": rep.engine_demotions,
+            "_mesh_shrinks": rep.mesh_shrinks,
+            "_quarantined": [
+                [lo + e.case, e.epoch, e.tensor]
+                for e in out["quarantine"].entries
+            ],
+        }
+
+    host = FleetHost(fleet)
+    summary = host.run_units(
+        compute,
+        num_units=len(lanes),
+        unit_lanes=lanes,
+        tag=tag,
+        config_fingerprint={
+            "driver": "run_fleet_grid",
+            "version": yuma_version,
+            "num_points": int(num_points),
+            "unit_size": fleet.unit_size,
+            "axes": axes if axes is not None else "prebuilt-configs",
+            "shape": [int(d) for d in np.shape(scenario.weights)],
+        },
+        result_keys=("dividends",),
+    )
+    if not finalize:
+        return {"host": summary}
+    report = publish_fleet_report(host.store)
+    entries = quarantine_entries(host.store)
+    return {
+        "dividends": host.store.collect("dividends"),
+        "quarantine": QuarantineReport(
+            entries=tuple(entries), num_cases=int(num_points)
+        ),
+        "report": report,
+        "host": summary,
+        "points": points,
+    }
+
+
 def run_fleet_artifacts(
     labels: Sequence[str],
     build: Callable[[str], bytes],

@@ -25,6 +25,7 @@ contract.
 """
 
 from yuma_simulation_tpu.resilience.errors import (  # noqa: F401
+    AdmissionRejected,
     CheckpointCorruptionError,
     DeviceLossError,
     DistributedInitError,
@@ -36,6 +37,7 @@ from yuma_simulation_tpu.resilience.errors import (  # noqa: F401
     HostLossError,
     LeaseExpired,
     NonFiniteOutputError,
+    QueueOverflow,
     ResilienceError,
     classify_failure,
 )
@@ -45,6 +47,7 @@ from yuma_simulation_tpu.resilience.faults import (  # noqa: F401
     HostCrashFault,
     LeaseTearFault,
     NaNFault,
+    OverloadFault,
     StallFault,
     inject_faults,
 )

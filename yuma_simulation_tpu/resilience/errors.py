@@ -106,6 +106,54 @@ class DistributedInitError(ResilienceError):
     to re-launch or abort the job."""
 
 
+class AdmissionRejected(ResilienceError):
+    """A serving-tier request failed admission control BEFORE any
+    compile or dispatch: malformed payload, unknown version/engine, or
+    an analytic HBM preflight verdict that the shape deterministically
+    cannot fit. NOT retryable and NOT an :class:`EngineFailure` — the
+    same request is rejected again no matter which rung runs it, so the
+    ladder must never burn retries on it (the HTTP layer maps it to a
+    structured 4xx). Carries the preflight's way out when there is one
+    (``suggestion``: shard count / max_resident_epochs) so the client
+    can reshape instead of guessing."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        reason: str = "invalid_request",
+        suggestion: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.reason = reason
+        self.suggestion = suggestion
+
+
+class QueueOverflow(ResilienceError):
+    """The serving tier shed this request: the global run queue is at
+    its bound or the tenant's token bucket is empty. Retryable BY THE
+    CLIENT — and only by the client: re-dispatching server-side would
+    be exactly the unbounded growth the bound exists to prevent, so
+    this is NOT an :class:`EngineFailure` and the engine ladder never
+    acts on it. ``retry_after`` (seconds) is the backoff the HTTP layer
+    surfaces as ``429`` + ``Retry-After``."""
+
+    #: Client-retryable: resubmitting after ``retry_after`` is expected
+    #: to succeed once the queue drains / the bucket refills.
+    retryable = True
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        retry_after: float = 1.0,
+        queue_depth: Optional[int] = None,
+    ):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+        self.queue_depth = queue_depth
+
+
 class EngineLadderExhausted(EngineFailure):
     """Every rung of the degradation ladder failed. Carries the
     per-demotion records so the caller can see the full walk."""

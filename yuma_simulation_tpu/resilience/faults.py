@@ -38,7 +38,10 @@ deterministically*, so every ladder rung runs in CI under
   EXPIRY recovers the dead host's units (no teardown code runs);
 - `FaultPlan.lease_tear` — truncate the host's own live lease file
   after N heartbeat renewals (simulated shared-store corruption), so
-  torn-lease tolerance and the LeaseExpired abandon path are exercised.
+  torn-lease tolerance and the LeaseExpired abandon path are exercised;
+- `FaultPlan.overload` — a one-shot burst of synthetic requests at the
+  serving tier's admission layer, so the 429/Retry-After shed path and
+  the queue-depth/shed metrics are drill-able on CPU CI.
 
 The hooks are consulted at host level by the engines and
 `CheckpointedSweep`; with no plan armed (the production state) each is
@@ -133,6 +136,21 @@ class LeaseTearFault:
 
 
 @dataclasses.dataclass(frozen=True)
+class OverloadFault:
+    """Inject a deterministic BURST of synthetic requests at the serving
+    tier's admission layer (:mod:`..serve`): the next real request first
+    pushes `requests` synthetic tickets (tiny built-in scenario, tenant
+    `tenant`) through the same quota + bounded-queue path it is about to
+    take, so the shed/backpressure/breaker responses are drill-able on
+    CPU CI without a real traffic generator. One-shot per armed plan —
+    the burst fires exactly once, consumed via
+    :func:`active_overload_fault`."""
+
+    requests: int = 32
+    tenant: str = "synthetic-burst"
+
+
+@dataclasses.dataclass(frozen=True)
 class NaNFault:
     """Poison scenario lane `case`'s dividends at epoch `epoch` (global
     epoch index). `case=None` targets a single-scenario run — or every
@@ -165,6 +183,8 @@ class FaultPlan:
     host_crash: Optional[HostCrashFault] = None
     #: truncate this host's live lease file after N heartbeat renewals.
     lease_tear: Optional[LeaseTearFault] = None
+    #: burst of synthetic requests at the serve tier's admission layer.
+    overload: Optional[OverloadFault] = None
 
 
 class _FaultState:
@@ -178,6 +198,7 @@ class _FaultState:
         self.claims_seen = 0
         self.renewals_seen = 0
         self.lease_torn = False
+        self.overload_fired = False
 
 
 _ACTIVE: Optional[_FaultState] = None
@@ -308,6 +329,29 @@ def active_nan_fault() -> Optional[NaNFault]:
     log_event(
         logger, "fault_injected", kind="nan",
         case="all" if f.case is None else f.case, epoch=f.epoch,
+    )
+    return f
+
+
+def active_overload_fault() -> Optional[OverloadFault]:
+    """Serve-admission hook: the armed plan's overload burst, exactly
+    once per armed plan (a burst that re-fired on every subsequent
+    request would never let the drill observe recovery). The serve tier
+    translates it into `requests` synthetic admission tickets pushed
+    through the real quota + bounded-queue path."""
+    state = _ACTIVE
+    if state is None or state.plan.overload is None or state.overload_fired:
+        return None
+    if _tracing_now():
+        return None
+    state.overload_fired = True
+    f = state.plan.overload
+    log_event(
+        logger,
+        "fault_injected",
+        kind="overload",
+        requests=f.requests,
+        tenant=f.tenant,
     )
     return f
 

@@ -299,6 +299,7 @@ class SweepSupervisor:
         mesh=None,
         dtype=jnp.float32,
         tag: str = "",
+        pack: bool = False,
     ) -> dict:
         """Supervised :func:`..simulation.sweep.simulate_batch` /
         :func:`..parallel.sharded.simulate_batch_sharded` over a
@@ -312,15 +313,45 @@ class SweepSupervisor:
         unfaulted run produces — every recovery action either
         re-executes a pure unit or masks a lane, never perturbs a
         healthy one.
+
+        `pack=True` DONOR-PACKS the suite first
+        (:func:`..simulation.sweep.pack_scenarios`): heterogeneous
+        scenarios pad to one tile-aligned shape bucket with per-lane
+        miner masks, so the whole suite rides one compiled batched
+        shape — the serving tier's coalescing path. The returned
+        dividends then carry the bucket's padded validator axis (slice
+        `[:, :E_i, :V_i]` per lane to recover each scenario's own view;
+        padded entries are exact zeros by the padding contract). XLA
+        engine only (the fused scan has no per-scenario miner masks)
+        and single-host only (the sharded path accounts memory
+        per-shard, not per-bucket).
         """
         from yuma_simulation_tpu.models.config import YumaConfig
         from yuma_simulation_tpu.models.variants import variant_for_version
-        from yuma_simulation_tpu.simulation.sweep import stack_scenarios
+        from yuma_simulation_tpu.simulation.sweep import (
+            pack_scenarios,
+            stack_scenarios,
+        )
 
         config = config if config is not None else YumaConfig()
         spec = variant_for_version(yuma_version)
         scenarios = list(scenarios)
         units = self._partition(len(scenarios))
+        packed = None
+        if pack:
+            if mesh is not None:
+                raise ValueError(
+                    "pack=True donor-packs with per-lane miner masks, "
+                    "which the sharded dispatch does not thread; use "
+                    "mesh=None (or pre-shard the suite)"
+                )
+            if self.engine != "xla":
+                raise ValueError(
+                    "pack=True requires engine='xla': the fused case "
+                    "scan has no per-scenario miner masks"
+                )
+            if scenarios:
+                packed = pack_scenarios(scenarios, dtype)
 
         # The sweep-level dispatch plan (simulation.planner), recorded
         # on the sweep span so the flight bundle shows WHY the rung ran
@@ -334,7 +365,10 @@ class SweepSupervisor:
                 plan_dispatch,
             )
 
-            E0, V0, M0 = np.shape(scenarios[0].weights)
+            if packed is not None:
+                _, E0, V0, M0 = packed[0].shape
+            else:
+                E0, V0, M0 = np.shape(scenarios[0].weights)
             lanes0 = min(self.unit_size, len(scenarios))
             plan = plan_dispatch(
                 f"supervised_batch:{yuma_version}",
@@ -344,6 +378,7 @@ class SweepSupervisor:
                 dtype,
                 epoch_impl=self.engine if mesh is None else "xla",
                 quarantine=self.quarantine,
+                has_miner_mask=packed is not None,
                 check_memory=mesh is None,
             )
 
@@ -387,6 +422,23 @@ class SweepSupervisor:
                     else "sharded_xla"
                 )
                 return out
+            if packed is not None:
+                Wp, Sp, rip, rep, maskp = packed
+                return self._ladder_dispatch(
+                    lambda rung: _batch_on_rung(
+                        Wp[lo:hi],
+                        Sp[lo:hi],
+                        rip[lo:hi],
+                        rep[lo:hi],
+                        config,
+                        spec,
+                        rung,
+                        self.quarantine,
+                        miner_mask=maskp[lo:hi],
+                    ),
+                    label=label,
+                    outcome=outcome,
+                )
             W, S, ri, re = stack_scenarios(unit, dtype)
             return self._ladder_dispatch(
                 lambda rung: _batch_on_rung(
@@ -894,11 +946,15 @@ def _ledger_quarantine_entries(
     return tuple(entries)
 
 
-def _batch_on_rung(W, S, ri, re, config, spec, rung, quarantine) -> dict:
+def _batch_on_rung(
+    W, S, ri, re, config, spec, rung, quarantine, miner_mask=None
+) -> dict:
     """One `simulate_batch` dispatch pinned to ladder rung `rung`,
     blocked to completion so async failures surface inside the
     supervising try. Module-level so every unit hits the same jitted
-    cache entries — the supervisor adds zero warm-repeat compiles."""
+    cache entries — the supervisor adds zero warm-repeat compiles.
+    `miner_mask` is the donor-packed suites' per-lane consensus mask
+    (`run_batch(pack=True)`); XLA rung only."""
     import jax
 
     from yuma_simulation_tpu.simulation.sweep import simulate_batch
@@ -908,7 +964,7 @@ def _batch_on_rung(W, S, ri, re, config, spec, rung, quarantine) -> dict:
         return jax.block_until_ready(
             simulate_batch(
                 W, S, ri, re, config, spec, epoch_impl=rung,
-                quarantine=quarantine,
+                quarantine=quarantine, miner_mask=miner_mask,
             )
         )
 

@@ -1,0 +1,53 @@
+"""Serving tier: the warm-engine simulation service (ROADMAP item 1).
+
+The long-lived process that turns the platform into a product: engines
+stay warm across requests, heterogeneous tenants coalesce onto shared
+compiled shapes, and hostile traffic degrades gracefully instead of
+taking the process down. Five modules:
+
+- :mod:`.admission` — validate + price every request through the
+  dispatch planner and the analytic HBM preflight BEFORE any compile
+  (typed :class:`..resilience.errors.AdmissionRejected` -> 400 with the
+  preflight's reshape suggestion);
+- :mod:`.quotas` — per-tenant token buckets + the global bounded run
+  queue (typed :class:`..resilience.errors.QueueOverflow` -> 429 +
+  ``Retry-After``; ``serve_queue_depth``/``serve_requests_shed``
+  metrics);
+- :mod:`.coalescer` — same-shape-bucket requests donor-packed into one
+  batched dispatch, per-request lanes sliced back bitwise;
+- :mod:`.lifecycle` — the per-engine-rung circuit breaker (trip ->
+  re-anchored plans fleet-wide -> half-open probe -> close) and the
+  startup warmup pass;
+- :mod:`.service` / :mod:`.server` — the pipeline core and its stdlib
+  `http.server` front (``/v1/simulate``, ``/v1/sweep``, ``/v1/table``,
+  ``/healthz``, ``/metrics``) plus the stdlib
+  :class:`~.server.SimulationClient`.
+
+Run it: ``python -m yuma_simulation_tpu.serve`` (see ``--help``;
+``--smoke`` drives the CI smoke lane). README "Serving" has the
+operator contract.
+"""
+
+from yuma_simulation_tpu.serve.admission import (  # noqa: F401
+    AdmissionTicket,
+    admit,
+)
+from yuma_simulation_tpu.serve.lifecycle import (  # noqa: F401
+    CircuitBreaker,
+    warmup,
+)
+from yuma_simulation_tpu.serve.quotas import (  # noqa: F401
+    BoundedRunQueue,
+    TenantQuotas,
+    TokenBucket,
+)
+from yuma_simulation_tpu.serve.server import (  # noqa: F401
+    ServeResponse,
+    SimulationClient,
+    SimulationServer,
+    wait_until_ready,
+)
+from yuma_simulation_tpu.serve.service import (  # noqa: F401
+    ServeConfig,
+    SimulationService,
+)

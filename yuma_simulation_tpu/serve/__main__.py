@@ -1,0 +1,187 @@
+"""CLI: ``python -m yuma_simulation_tpu.serve`` — run (or smoke) the
+warm-engine simulation service.
+
+Foreground mode serves until interrupted; ``--smoke`` is the CI lane:
+start a server on an ephemeral port, fire one of each contract-defining
+request — a happy path, a structured admission rejection, a quota shed
+(429 + Retry-After), and a coalesced same-bucket pair — then shut down
+gracefully and leave the flight bundle for ``python -m tools.obsreport
+BUNDLE --check`` to gate. Exit 0 only when every expectation held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+
+
+def _build_config(args, **overrides) -> "ServeConfig":  # noqa: F821
+    from yuma_simulation_tpu.serve.service import ServeConfig
+
+    return ServeConfig(
+        **overrides,
+        queue_limit=args.queue_limit,
+        coalesce_window_seconds=args.coalesce_window,
+        max_batch=args.max_batch,
+        tenant_rate=args.tenant_rate,
+        tenant_burst=args.tenant_burst,
+        default_deadline_seconds=args.deadline,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_seconds=args.breaker_cooldown,
+        bundle_dir=args.bundle_dir,
+        warmup_shapes=tuple(
+            tuple(int(d) for d in shape.split("x"))
+            for shape in (args.warmup or [])
+        ),
+    )
+
+
+def run_smoke(args) -> int:
+    """The serve smoke drill (see module docstring). CPU-safe."""
+    from yuma_simulation_tpu.serve.server import (
+        SimulationClient,
+        SimulationServer,
+        wait_until_ready,
+    )
+    from yuma_simulation_tpu.utils import setup_logging
+
+    setup_logging()
+    failures: list[str] = []
+
+    def expect(cond: bool, what: str) -> None:
+        print(("ok   " if cond else "FAIL ") + what)
+        if not cond:
+            failures.append(what)
+
+    # The greedy tenant gets a NON-REFILLING bucket (rate 0): its burst
+    # deterministically exhausts regardless of how fast this runner
+    # executes requests — the shed path must not depend on a race
+    # between the drill loop and the refill clock.
+    server = SimulationServer(
+        _build_config(
+            args, tenant_overrides={"greedy": (0.0, args.tenant_burst)}
+        )
+    ).start()
+    try:
+        expect(wait_until_ready(server.url), "server answers /healthz")
+        client = SimulationClient(server.url, tenant="smoke")
+
+        # Happy path: a built-in case through the full pipeline.
+        r = client.simulate(case="Case 1")
+        expect(
+            r.status == 200 and r.body.get("status") == "ok",
+            f"happy path simulate -> 200 ok (got {r.status} "
+            f"{r.body.get('status')})",
+        )
+
+        # Structured admission rejection: malformed payload, typed 400.
+        r = client.simulate(weights=[[1.0]])  # wrong rank, no stakes
+        expect(
+            r.status == 400 and r.body.get("error") == "AdmissionRejected",
+            f"malformed payload -> 400 AdmissionRejected (got {r.status} "
+            f"{r.body.get('error')})",
+        )
+
+        # Quota shed: exhaust one tenant's burst back-to-back; the
+        # bucket refills at tenant_rate, so with the smoke's small burst
+        # a tight loop must see a 429 with Retry-After.
+        greedy = SimulationClient(server.url, tenant="greedy")
+        shed = None
+        for _ in range(args.tenant_burst + 2):
+            r = greedy.simulate(case="Case 2")
+            if r.status == 429:
+                shed = r
+                break
+        expect(
+            shed is not None
+            and shed.retry_after is not None
+            and shed.body.get("error") == "QueueOverflow",
+            "tenant burst -> 429 QueueOverflow with Retry-After",
+        )
+
+        # Coalescing: two same-bucket requests in flight together ride
+        # one donor-packed dispatch (coalesced=2 on both responses).
+        with concurrent.futures.ThreadPoolExecutor(2) as pool:
+            futs = [
+                pool.submit(
+                    SimulationClient(server.url, tenant=f"t{i}").simulate,
+                    case="Case 3",
+                )
+                for i in range(2)
+            ]
+            results = [f.result() for f in futs]
+        expect(
+            all(r.status == 200 for r in results)
+            and max(r.body.get("coalesced", 1) for r in results) >= 2,
+            "concurrent same-bucket pair -> coalesced dispatch",
+        )
+
+        # The acceptance metrics surface on /metrics.
+        metrics = client.metrics()
+        for series in (
+            "serve_queue_depth",
+            "serve_requests_shed",
+            "serve_breaker_open",
+        ):
+            expect(series in metrics, f"/metrics exposes {series}")
+    finally:
+        server.close()
+
+    if failures:
+        print(f"\nserve smoke FAILED ({len(failures)} expectation(s))")
+        return 1
+    print("\nserve smoke passed")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m yuma_simulation_tpu.serve",
+        description=__doc__.split("\n\n")[0],
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument(
+        "--bundle-dir",
+        default=None,
+        help="flight-bundle directory (spans + request ledger + metrics)",
+    )
+    parser.add_argument("--queue-limit", type=int, default=64)
+    parser.add_argument("--coalesce-window", type=float, default=0.05)
+    parser.add_argument("--max-batch", type=int, default=8)
+    parser.add_argument("--tenant-rate", type=float, default=20.0)
+    parser.add_argument("--tenant-burst", type=int, default=10)
+    parser.add_argument("--deadline", type=float, default=120.0)
+    parser.add_argument("--breaker-threshold", type=int, default=3)
+    parser.add_argument("--breaker-cooldown", type=float, default=30.0)
+    parser.add_argument(
+        "--warmup",
+        action="append",
+        metavar="ExVxM",
+        help="pre-compile this shape at startup (repeatable), e.g. 40x3x2",
+    )
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="CI smoke: ephemeral port, contract-defining requests, "
+        "graceful shutdown, exit nonzero on any miss",
+    )
+    args = parser.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke(args)
+
+    from yuma_simulation_tpu.serve.server import SimulationServer
+    from yuma_simulation_tpu.utils import setup_logging
+
+    setup_logging()
+    server = SimulationServer(
+        _build_config(args), host=args.host, port=args.port
+    )
+    print(f"serving on {server.url} (Ctrl-C to stop)")
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

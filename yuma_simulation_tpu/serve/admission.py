@@ -1,0 +1,394 @@
+"""Admission control: validate and PRICE every request before any compile.
+
+The serving tier's first robustness gate. A hostile or mistaken request
+must be rejected while it is still cheap — after JSON parsing, before
+any trace, compile, or device allocation — with a typed
+:class:`..resilience.errors.AdmissionRejected` that tells the client
+*why* and, when the analytic HBM preflight produced one, *what would
+fit* (its shard-count / ``max_resident_epochs`` suggestion). The
+machinery is exactly the planner's
+(:func:`..simulation.planner.plan_dispatch` with
+``raise_on_reject=False``): pure host arithmetic, zero compiles, so
+admission costs microseconds even under a burst.
+
+The output is an :class:`AdmissionTicket`: the parsed request plus its
+frozen :class:`..simulation.planner.DispatchPlan` and the coalescing key
+(shape bucket + version + config fingerprint) the dispatcher groups
+same-bucket tenants by.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import replace
+from typing import NoReturn, Optional, Sequence
+
+import numpy as np
+
+from yuma_simulation_tpu.resilience.errors import AdmissionRejected
+
+#: Engines a request may name; "auto" resolves through the planner.
+_ENGINES = ("auto", "xla", "fused_scan", "fused_scan_mxu")
+
+#: Hard per-request shape ceilings — a parse-time sanity bound so a
+#: hostile payload cannot make the server materialize absurd host
+#: arrays before the preflight even runs. Generous: the bench flagship
+#: (256 x 4096 x 10k epochs) fits with room.
+MAX_EPOCHS = 1 << 20
+MAX_VALIDATORS = 1 << 14
+MAX_MINERS = 1 << 18
+
+#: Hard cap on a sweep's grid cardinality: the cartesian product of the
+#: axes is materialized host-side at dispatch, so an unbounded `axes`
+#: payload would be exactly the host-memory DoS the array ceilings above
+#: exist to stop.
+MAX_SWEEP_POINTS = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionTicket:
+    """One admitted request, fully decided: what to run, on which plan,
+    under which deadline — everything the dispatcher needs without
+    re-touching the raw payload."""
+
+    request_id: str
+    tenant: str
+    kind: str  # "simulate" | "sweep" | "table"
+    version: str
+    scenario: Optional[object]  # Scenario for simulate/sweep
+    config: object  # YumaConfig
+    config_key: tuple  # hashable fingerprint of the config overrides
+    axes: Optional[dict]  # sweep hyperparameter grid
+    versions: Optional[tuple]  # table versions
+    plan: object  # DispatchPlan
+    engine: str
+    quarantine: bool
+    deadline_seconds: float
+    admitted_t: float  # time.monotonic() at admission
+    #: Donor-packing group key: requests sharing it ride one batched
+    #: dispatch. None = never coalesced (sweep/table/fused requests).
+    coalesce_key: Optional[tuple] = None
+
+    def remaining_seconds(self) -> float:
+        return self.deadline_seconds - (time.monotonic() - self.admitted_t)
+
+
+def _reject(
+    message: str, *, reason: str = "invalid_request", **kw
+) -> NoReturn:
+    raise AdmissionRejected(message, reason=reason, **kw)
+
+
+def _require(payload: dict, field: str):
+    if field not in payload:
+        _reject(f"request is missing required field {field!r}")
+    return payload[field]
+
+
+def _as_float_array(value, field: str, ndim: int) -> np.ndarray:
+    try:
+        arr = np.asarray(value, dtype=np.float32)
+    except (TypeError, ValueError):
+        _reject(f"field {field!r} is not a numeric array")
+    if arr.ndim != ndim:
+        _reject(
+            f"field {field!r} must be {ndim}-dimensional, got shape "
+            f"{arr.shape}"
+        )
+    return arr
+
+
+def _build_config(overrides: Optional[dict]):
+    """A `YumaConfig` from a flat float-field override dict — the same
+    field universe `config_grid` sweeps (static/compiled fields are not
+    request-settable: they select different compiled programs, which a
+    warm-engine service must not let a payload do)."""
+    from yuma_simulation_tpu.models.config import (
+        SimulationHyperparameters,
+        YumaConfig,
+        YumaParams,
+    )
+
+    sim = SimulationHyperparameters()
+    par = YumaParams()
+    if not overrides:
+        return YumaConfig(simulation=sim, yuma_params=par), ()
+    if not isinstance(overrides, dict):
+        _reject("field 'config' must be an object of float fields")
+    sim_fields = {f for f in vars(sim) if f != "consensus_precision"}
+    par_fields = {
+        f
+        for f in vars(par)
+        if f
+        not in (
+            "liquid_alpha",
+            "override_consensus_high",
+            "override_consensus_low",
+        )
+    }
+    key = []
+    for name, value in sorted(overrides.items()):
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            _reject(f"config field {name!r} must be a number")
+        if name in sim_fields:
+            sim = replace(sim, **{name: float(value)})
+        elif name in par_fields:
+            par = replace(par, **{name: float(value)})
+        else:
+            _reject(
+                f"config field {name!r} is not request-settable "
+                "(unknown or compile-static)"
+            )
+        key.append((name, float(value)))
+    return YumaConfig(simulation=sim, yuma_params=par), tuple(key)
+
+
+def _build_scenario(payload: dict, request_id: str):
+    """The request's Scenario: a registered case by name, or explicit
+    `[E, V, M]` weights + `[E, V]` stakes arrays."""
+    from yuma_simulation_tpu.scenarios.base import Scenario, create_case
+
+    case_name = payload.get("case")
+    if case_name is not None:
+        try:
+            return create_case(str(case_name))
+        except ValueError as exc:
+            _reject(str(exc))
+    weights = _as_float_array(_require(payload, "weights"), "weights", 3)
+    stakes = _as_float_array(_require(payload, "stakes"), "stakes", 2)
+    E, V, M = weights.shape
+    if not (1 <= E <= MAX_EPOCHS):
+        _reject(f"epochs {E} outside [1, {MAX_EPOCHS}]")
+    if not (1 <= V <= MAX_VALIDATORS):
+        _reject(f"validators {V} outside [1, {MAX_VALIDATORS}]")
+    if not (1 <= M <= MAX_MINERS):
+        _reject(f"miners {M} outside [1, {MAX_MINERS}]")
+    if stakes.shape != (E, V):
+        _reject(
+            f"stakes shape {stakes.shape} does not match weights "
+            f"[E={E}, V={V}]"
+        )
+    reset_index = payload.get("reset_bonds_index")
+    reset_epoch = payload.get("reset_bonds_epoch")
+    for name, val in (
+        ("reset_bonds_index", reset_index),
+        ("reset_bonds_epoch", reset_epoch),
+    ):
+        if val is not None and not isinstance(val, int):
+            _reject(f"field {name!r} must be an integer epoch/index")
+    validators = [f"v{i}" for i in range(V)]
+    return Scenario(
+        name=f"request:{request_id}",
+        validators=validators,
+        base_validator=validators[0],
+        weights=weights,
+        stakes=stakes,
+        num_epochs=E,
+        reset_bonds_index=reset_index,
+        reset_bonds_epoch=reset_epoch,
+    )
+
+
+def _plan_or_reject(
+    label: str,
+    shape: Sequence[int],
+    version: str,
+    config,
+    *,
+    engine: str,
+    quarantine: bool,
+):
+    """Run the planner as the admission pricer: planner `ValueError`s
+    (bad impl combinations) become typed rejections, and a preflight
+    verdict of "cannot fit" rejects WITH the planner's suggestion —
+    before anything compiled."""
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.simulation.planner import plan_dispatch
+
+    try:
+        plan = plan_dispatch(
+            label,
+            shape,
+            version,
+            config,
+            jnp.float32,
+            epoch_impl=engine,
+            quarantine=quarantine,
+            raise_on_reject=False,
+        )
+    except (ValueError, KeyError) as exc:
+        _reject(str(exc))
+    if plan.memory.fits is False:
+        _reject(
+            f"predicted HBM footprint "
+            f"{plan.memory.predicted_bytes / 2**30:.2f} GiB exceeds "
+            "device capacity"
+            + (
+                f" ({plan.memory.capacity_bytes / 2**30:.2f} GiB)"
+                if plan.memory.capacity_bytes
+                else ""
+            ),
+            reason="preflight_rejected",
+            suggestion=plan.memory.suggestion,
+        )
+    return plan
+
+
+def admit(
+    payload: dict,
+    *,
+    request_id: str,
+    kind: str,
+    default_deadline_seconds: float,
+    max_unit_lanes: int = 64,
+) -> AdmissionTicket:
+    """Validate and price one request; returns the ticket or raises a
+    typed :class:`AdmissionRejected`. Zero compiles by construction."""
+    from yuma_simulation_tpu.models.variants import variant_for_version
+
+    if not isinstance(payload, dict):
+        _reject("request body must be a JSON object")
+    tenant = payload.get("tenant", "anonymous")
+    if not isinstance(tenant, str) or not tenant:
+        _reject("field 'tenant' must be a non-empty string")
+    version = payload.get("version", "Yuma 1 (paper)")
+    try:
+        variant_for_version(version)
+    except (ValueError, KeyError) as exc:
+        _reject(f"unknown version {version!r}: {exc}")
+    engine = payload.get("engine", "auto")
+    if engine not in _ENGINES:
+        _reject(f"unknown engine {engine!r}; expected one of {_ENGINES}")
+    deadline = payload.get("deadline_seconds", default_deadline_seconds)
+    if not isinstance(deadline, (int, float)) or deadline <= 0:
+        _reject("field 'deadline_seconds' must be a positive number")
+    config, config_key = _build_config(payload.get("config"))
+    quarantine = bool(
+        payload.get("quarantine", engine in ("auto", "xla"))
+    )
+    if quarantine and engine in ("fused_scan", "fused_scan_mxu"):
+        _reject(
+            "quarantine rides the XLA scan carry; a fused-engine "
+            "request must pass quarantine=false"
+        )
+
+    scenario = None
+    axes = None
+    versions = None
+    coalesce_key = None
+    if kind == "simulate":
+        scenario = _build_scenario(payload, request_id)
+        E, V, M = scenario.weights.shape
+        plan = _plan_or_reject(
+            f"serve:simulate:{request_id}",
+            (E, V, M),
+            version,
+            config,
+            engine=engine,
+            quarantine=quarantine,
+        )
+        if plan.engine == "xla":
+            # Donor-packing group: same tile bucket + same epochs +
+            # same version/config/quarantine rides ONE batched dispatch
+            # (the planner's bucket policy — epochs are data, never
+            # bucketed).
+            coalesce_key = (
+                "simulate",
+                version,
+                config_key,
+                quarantine,
+                plan.bucket.epochs,
+                plan.bucket.padded_V,
+                plan.bucket.padded_M,
+            )
+    elif kind == "sweep":
+        scenario = _build_scenario(payload, request_id)
+        raw_axes = _require(payload, "axes")
+        if not isinstance(raw_axes, dict) or not raw_axes:
+            _reject("field 'axes' must be a non-empty object of lists")
+        axes = {}
+        points = 1
+        for name, values in sorted(raw_axes.items()):
+            if not isinstance(values, (list, tuple)) or not values:
+                _reject(f"axis {name!r} must be a non-empty list")
+            if not all(
+                isinstance(v, (int, float)) and not isinstance(v, bool)
+                for v in values
+            ):
+                _reject(f"axis {name!r} values must be numbers")
+            axes[name] = [float(v) for v in values]
+            points *= len(values)
+            if points > MAX_SWEEP_POINTS:
+                _reject(
+                    f"sweep grid exceeds {MAX_SWEEP_POINTS} points; "
+                    "split the axes across requests (or run it through "
+                    "the fleet fabric's run_fleet_grid)"
+                )
+        # Validate the axis names the same way config_grid will.
+        from yuma_simulation_tpu.simulation.sweep import config_grid
+
+        try:
+            config_grid(**{k: v[:1] for k, v in axes.items()})
+        except ValueError as exc:
+            _reject(str(exc))
+        E, V, M = scenario.weights.shape
+        # Price the batch the dispatcher will actually place: the grid
+        # partitions into units of at most `max_unit_lanes` lanes, so a
+        # large-but-unit-partitioned sweep must not be rejected on a
+        # monolithic footprint it never dispatches.
+        plan = _plan_or_reject(
+            f"serve:sweep:{request_id}",
+            (min(points, max_unit_lanes), E, V, M),
+            version,
+            config,
+            engine="xla",
+            quarantine=quarantine,
+        )
+    elif kind == "table":
+        from yuma_simulation_tpu.models.config import YumaSimulationNames
+
+        names = vars(YumaSimulationNames()).values()
+        raw_versions = payload.get("versions")
+        if raw_versions is None:
+            versions = (version,)
+        else:
+            if not isinstance(raw_versions, (list, tuple)) or not raw_versions:
+                _reject("field 'versions' must be a non-empty list")
+            for v in raw_versions:
+                if v not in names:
+                    _reject(f"unknown version {v!r} in 'versions'")
+            versions = tuple(raw_versions)
+        from yuma_simulation_tpu.scenarios.base import get_cases
+
+        suite = get_cases()
+        E, V, M = suite[0].weights.shape
+        plan = _plan_or_reject(
+            f"serve:table:{request_id}",
+            (len(suite), E, V, M),
+            versions[0],
+            config,
+            engine="xla",
+            quarantine=False,
+        )
+    else:
+        _reject(f"unknown request kind {kind!r}")
+
+    return AdmissionTicket(
+        request_id=request_id,
+        tenant=tenant,
+        kind=kind,
+        version=version,
+        scenario=scenario,
+        config=config,
+        config_key=config_key,
+        axes=axes,
+        versions=versions,
+        plan=plan,
+        engine=engine,
+        quarantine=quarantine,
+        deadline_seconds=float(deadline),
+        admitted_t=time.monotonic(),
+        coalesce_key=coalesce_key,
+    )

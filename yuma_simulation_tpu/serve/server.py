@@ -1,0 +1,311 @@
+"""The HTTP adapter: stdlib `http.server` over :class:`.service
+.SimulationService`, plus the matching stdlib client.
+
+Deliberately dependency-free (ROADMAP item 1 allows FastAPI/grpc; the
+stdlib server means tier-1 CI exercises the full serving stack on CPU
+with nothing installed). `ThreadingHTTPServer` gives one thread per
+connection — the service core is thread-safe and does the real
+bounding, so the transport stays dumb:
+
+- ``POST /v1/simulate`` / ``POST /v1/sweep`` / ``POST /v1/table`` —
+  JSON request -> :meth:`..serve.service.SimulationService.handle`;
+- ``GET /healthz`` — liveness + queue/breaker state (JSON);
+- ``GET /metrics`` — the process metrics registry in Prometheus text
+  exposition (the PR 4 surface, now scrapeable).
+
+Every response this layer produces is typed JSON (or Prometheus text):
+a malformed body is a structured 400, an unknown route a structured
+404, and the service's own contract covers the rest — no bare 500s.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from yuma_simulation_tpu.serve.service import ServeConfig, SimulationService
+
+logger = logging.getLogger(__name__)
+
+#: POST routes -> request kinds the service understands.
+_ROUTES = {
+    "/v1/simulate": "simulate",
+    "/v1/sweep": "sweep",
+    "/v1/table": "table",
+}
+
+#: Largest accepted request body (bytes): bounds a hostile
+#: Content-Length before any array parsing happens.
+MAX_BODY_BYTES = 256 * 1024 * 1024
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "yuma-serve"
+    protocol_version = "HTTP/1.1"
+
+    # Set per server class (see _make_handler).
+    service: SimulationService
+
+    def log_message(self, format: str, *args) -> None:  # noqa: A002
+        logger.debug("http: " + format, *args)
+
+    def _send_json(
+        self, status: int, body: dict, headers: Optional[dict] = None
+    ) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        try:
+            if self.path == "/healthz":
+                self._send_json(200, self.service.healthz())
+            elif self.path == "/metrics":
+                text = self.service.metrics_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+            else:
+                self._send_json(
+                    404,
+                    {"status": "rejected", "error": "NotFound",
+                     "message": f"no route {self.path!r}"},
+                )
+        except BrokenPipeError:  # client went away; nothing to answer
+            pass
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server API
+        try:
+            kind = _ROUTES.get(self.path)
+            if kind is None:
+                # Responding BEFORE reading the body on a keep-alive
+                # connection would leave the unread bytes to be parsed
+                # as the next request line — close instead.
+                self.close_connection = True
+                self._send_json(
+                    404,
+                    {"status": "rejected", "error": "NotFound",
+                     "message": f"no route {self.path!r}"},
+                )
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                length = -1
+            if length < 0 or length > MAX_BODY_BYTES:
+                self.close_connection = True  # body unread; see above
+                self._send_json(
+                    413,
+                    {"status": "rejected", "error": "PayloadTooLarge",
+                     "message": f"body must be 0..{MAX_BODY_BYTES} bytes"},
+                )
+                return
+            raw = self.rfile.read(length)
+            try:
+                payload = json.loads(raw.decode() or "{}")
+            except (ValueError, UnicodeDecodeError) as exc:
+                self._send_json(
+                    400,
+                    {"status": "rejected", "error": "InvalidJSON",
+                     "message": str(exc)[:200]},
+                )
+                return
+            status, body, headers = self.service.handle(kind, payload)
+            self._send_json(status, body, headers)
+        except BrokenPipeError:
+            pass
+
+
+def _make_handler(service: SimulationService) -> type:
+    return type("BoundHandler", (_Handler,), {"service": service})
+
+
+class SimulationServer:
+    """The long-lived HTTP front: owns (or wraps) a
+    :class:`SimulationService` and serves it on a background thread.
+    `port=0` binds an ephemeral port (tests/smoke); :attr:`port` is the
+    bound one. `close()` stops the listener THEN drains the service —
+    in-flight requests finish, queued ones get the structured
+    shutting-down response, the flight bundle publishes."""
+
+    def __init__(
+        self,
+        config: Optional[ServeConfig] = None,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        service: Optional[SimulationService] = None,
+    ):
+        self.service = (
+            service if service is not None else SimulationService(config)
+        )
+        self._httpd = ThreadingHTTPServer(
+            (host, port), _make_handler(self.service)
+        )
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "SimulationServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="yuma-serve-http",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("serving on %s", self.url)
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground mode (the CLI): serve until interrupted."""
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:  # pragma: no cover — interactive
+            pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        self.service.close()
+
+
+@dataclass
+class ServeResponse:
+    """One client-side result: HTTP status + parsed JSON body (+ the
+    Retry-After header, parsed, when the server sent one)."""
+
+    status: int
+    body: dict
+    retry_after: Optional[float] = None
+    headers: dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return self.status == 200 and self.body.get("status") in (
+            "ok",
+            "partial",
+        )
+
+
+class SimulationClient:
+    """Stdlib client for the serving tier (the v1 helper): JSON over
+    urllib, typed :class:`ServeResponse` back — 4xx/5xx are RETURNED
+    (the server's typed bodies are the contract), never raised; only
+    transport-level failures raise (`URLError`)."""
+
+    def __init__(
+        self, base_url: str, *, tenant: str = "default", timeout: float = 120.0
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.tenant = tenant
+        self.timeout = timeout
+
+    def _request(
+        self, method: str, path: str, payload: Optional[dict] = None
+    ) -> ServeResponse:
+        url = self.base_url + path
+        data = None
+        headers = {"Accept": "application/json"}
+        if payload is not None:
+            data = json.dumps(payload).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            url, data=data, headers=headers, method=method
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                status = resp.status
+                hdrs = dict(resp.headers.items())
+        except urllib.error.HTTPError as err:
+            raw = err.read()
+            status = err.code
+            hdrs = dict(err.headers.items()) if err.headers else {}
+        try:
+            body = json.loads(raw.decode() or "{}")
+        except ValueError:
+            body = {"status": "error", "raw": raw.decode(errors="replace")}
+        retry_after = None
+        if "Retry-After" in hdrs:
+            try:
+                retry_after = float(hdrs["Retry-After"])
+            except ValueError:
+                pass
+        return ServeResponse(
+            status=status, body=body, retry_after=retry_after, headers=hdrs
+        )
+
+    def _post(self, path: str, payload: dict) -> ServeResponse:
+        payload = dict(payload)
+        payload.setdefault("tenant", self.tenant)
+        return self._request("POST", path, payload)
+
+    def simulate(self, **payload) -> ServeResponse:
+        """POST /v1/simulate — `case=` (a registered case name) or
+        `weights=`/`stakes=` arrays, plus `version`, `config`,
+        `deadline_seconds`, `engine`, `quarantine` knobs."""
+        return self._post("/v1/simulate", payload)
+
+    def sweep(self, **payload) -> ServeResponse:
+        """POST /v1/sweep — a scenario plus `axes={field: [values]}`."""
+        return self._post("/v1/sweep", payload)
+
+    def table(self, **payload) -> ServeResponse:
+        """POST /v1/table — the total-dividends CSV across versions."""
+        return self._post("/v1/table", payload)
+
+    def healthz(self) -> ServeResponse:
+        return self._request("GET", "/healthz")
+
+    def metrics(self) -> str:
+        url = self.base_url + "/metrics"
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            return resp.read().decode()
+
+
+def wait_until_ready(
+    url: str, *, timeout: float = 10.0, interval: float = 0.05
+) -> bool:
+    """Poll `/healthz` until the server answers (startup rendezvous for
+    tests and the smoke lane)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                url.rstrip("/") + "/healthz", timeout=interval + 1.0
+            ):
+                return True
+        except (urllib.error.URLError, socket.timeout, ConnectionError):
+            time.sleep(interval)
+    return False

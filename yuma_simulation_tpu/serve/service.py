@@ -1,0 +1,694 @@
+"""The simulation service core: admission -> queue -> coalesce -> dispatch.
+
+The long-lived, transport-agnostic heart of the serving tier (the HTTP
+layer in :mod:`.server` is a thin adapter over :meth:`SimulationService
+.handle`). One process, one service, one telemetry
+:class:`..telemetry.runctx.RunContext` for its whole lifetime — every
+request rides the pipeline:
+
+1. **admission** (:mod:`.admission`): validate + price through the
+   planner and the analytic HBM preflight, zero compiles — typed
+   :class:`..resilience.errors.AdmissionRejected` -> structured 400;
+2. **backpressure** (:mod:`.quotas`): per-tenant token bucket, then the
+   global bounded run queue — typed `QueueOverflow` -> 429 +
+   ``Retry-After``, never an unbounded backlog;
+3. **coalescing** (:mod:`.coalescer`): same shape bucket within the
+   window -> one donor-packed batched dispatch, per-request lanes
+   sliced back bitwise;
+4. **supervised execution**: every dispatch runs through
+   :class:`..resilience.supervisor.SweepSupervisor` — the request's
+   deadline threads into the watchdog, NaN lanes quarantine into
+   ``"partial"`` responses, device loss shrinks the mesh into a
+   ``degraded`` flag, engine failures demote down the ladder — and the
+   per-rung :class:`.lifecycle.CircuitBreaker` re-anchors future plans
+   below a rung that keeps failing, recovering via half-open probes.
+
+The failure contract is total: every request receives a typed JSON
+response — result, partial-with-quarantine, 429, structured rejection,
+or structured failure — never a bare 500. The service's flight bundle
+(spans + request ledger + metrics snapshot, published at close and
+gated by ``obsreport --check``) is the ops record of all of it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import math
+import pathlib
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from yuma_simulation_tpu.resilience.errors import (
+    AdmissionRejected,
+    EngineFailure,
+    QueueOverflow,
+    classify_failure,
+)
+from yuma_simulation_tpu.serve.admission import AdmissionTicket, admit
+from yuma_simulation_tpu.serve.coalescer import (
+    gather_group,
+    slice_simulate_response,
+)
+from yuma_simulation_tpu.serve.lifecycle import CircuitBreaker, warmup
+from yuma_simulation_tpu.serve.quotas import BoundedRunQueue, TenantQuotas
+from yuma_simulation_tpu.utils.logging import log_event
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Service knobs; the CLI (:mod:`.__main__`) exposes the subset an
+    operator tunes. Defaults are sized for the CPU smoke/test scale —
+    a production deployment raises the queue and quota bounds."""
+
+    queue_limit: int = 64
+    coalesce_window_seconds: float = 0.05
+    max_batch: int = 8
+    tenant_rate: float = 20.0
+    tenant_burst: int = 10
+    #: tenant -> (rate, burst) negotiated quota overrides.
+    tenant_overrides: Optional[dict] = None
+    default_deadline_seconds: float = 120.0
+    breaker_threshold: int = 3
+    breaker_cooldown_seconds: float = 30.0
+    #: Flight-bundle directory (spans + request ledger + metrics). None
+    #: disables the on-disk bundle (tests); production sets it.
+    bundle_dir: Optional[str] = None
+    #: `(epochs, V, M)` shapes to pre-compile at startup (warm engines).
+    warmup_shapes: tuple = ()
+    #: Optional device mesh for sharded dispatch (elastic shrink rides
+    #: the supervisor's existing path).
+    mesh: object = None
+    elastic: bool = True
+    drain_estimate_seconds: float = 0.25
+    #: Test-only: construct the service without its dispatcher thread
+    #: (so queue-bound behavior can be observed deterministically).
+    start_dispatcher: bool = True
+
+
+class _Pending:
+    """One admitted request waiting for its dispatch: the ticket plus
+    the handler's rendezvous (`done` event, resolved status/body)."""
+
+    __slots__ = ("ticket", "done", "status", "response", "synthetic")
+
+    def __init__(self, ticket: AdmissionTicket, synthetic: bool = False):
+        self.ticket = ticket
+        self.done = threading.Event()
+        self.status: Optional[int] = None
+        self.response: Optional[dict] = None
+        self.synthetic = synthetic
+
+    def resolve(self, status: int, body: dict) -> None:
+        self.status = status
+        self.response = body
+        self.done.set()
+
+
+class SimulationService:
+    """See the module docstring. Thread-safe: `handle` is called from
+    the HTTP server's per-connection threads; one dispatcher thread
+    drains the queue."""
+
+    def __init__(self, config: Optional[ServeConfig] = None, registry=None):
+        from yuma_simulation_tpu.resilience.supervisor import FailureLedger
+        from yuma_simulation_tpu.telemetry.metrics import get_registry
+        from yuma_simulation_tpu.telemetry.runctx import RunContext
+
+        self.config = config if config is not None else ServeConfig()
+        self.registry = registry if registry is not None else get_registry()
+        self.run = RunContext()
+        self.started_t = time.time()
+        self.quotas = TenantQuotas(
+            rate=self.config.tenant_rate,
+            burst=self.config.tenant_burst,
+            overrides=self.config.tenant_overrides,
+        )
+        self.queue = BoundedRunQueue(
+            self.config.queue_limit,
+            drain_estimate_seconds=self.config.drain_estimate_seconds,
+            registry=self.registry,
+        )
+        self.breaker = CircuitBreaker(
+            threshold=self.config.breaker_threshold,
+            cooldown_seconds=self.config.breaker_cooldown_seconds,
+            registry=self.registry,
+        )
+        bundle_dir = self.config.bundle_dir
+        if bundle_dir is not None:
+            pathlib.Path(bundle_dir).mkdir(parents=True, exist_ok=True)
+        self.ledger = FailureLedger(
+            pathlib.Path(bundle_dir) / "ledger.jsonl"
+            if bundle_dir is not None
+            else None
+        )
+        self._ledger_lock = threading.Lock()
+        # Eager registration: the acceptance surface (queue depth, shed
+        # count, breaker state) must appear on /metrics from request
+        # zero, not after the first increment.
+        self._requests_total = self.registry.counter(
+            "serve_requests_total", help="serving-tier requests handled"
+        )
+        self._admission_rejected = self.registry.counter(
+            "serve_admission_rejected",
+            help="typed admission rejections (pre-compile)",
+        )
+        self._coalesced_lanes = self.registry.counter(
+            "serve_coalesced_lanes",
+            help="requests donor-packed into a shared dispatch",
+        )
+        self._request_seconds = self.registry.histogram(
+            "serve_request_seconds",
+            help="request wall time, admission to reply",
+        )
+        self._counter = itertools.count(1)
+        self._stopping = False
+        self._closed = False
+        if self.config.warmup_shapes:
+            with self.run.activate():
+                warmup(self.config.warmup_shapes)
+        self._dispatcher: Optional[threading.Thread] = None
+        if self.config.start_dispatcher:
+            self.start_dispatcher()
+
+    def start_dispatcher(self) -> None:
+        """Start the queue-draining dispatcher thread (idempotent).
+        Split from construction so tests — and a future multi-process
+        pre-fork — can fill the queue deterministically first."""
+        if self._dispatcher is not None:
+            return
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop,
+            name="yuma-serve-dispatcher",
+            daemon=True,
+        )
+        self._dispatcher.start()
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def _append_ledger(self, event: str, **fields) -> None:
+        with self._ledger_lock:
+            self.ledger.append(event, **fields)
+
+    # -- the request pipeline -------------------------------------------
+
+    def handle(self, kind: str, payload) -> tuple[int, dict, dict]:
+        """One request, end to end; returns `(status, body, headers)`.
+        Total by construction: every exit path is a typed JSON body."""
+        with self.run.activate():
+            t0 = time.perf_counter()
+            self._requests_total.inc()
+            rid = f"r{next(self._counter):06d}"
+            tenant = (
+                payload.get("tenant", "anonymous")
+                if isinstance(payload, dict)
+                else "anonymous"
+            )
+            from yuma_simulation_tpu.telemetry.runctx import span
+
+            with span(
+                f"request:{rid}", tenant=tenant, endpoint=kind, request=rid
+            ) as s:
+                try:
+                    status, body, headers = self._handle_inner(
+                        kind, payload, rid, tenant
+                    )
+                except BaseException as exc:  # noqa: BLE001 — typed below
+                    # The no-bare-500 backstop: anything the pipeline
+                    # did not already structure becomes a typed failure
+                    # body here.
+                    logger.exception("unhandled serve failure for %s", rid)
+                    status, body = self._failure_response(exc, rid)
+                    headers = {}
+                if s is not None:
+                    s.attrs["status"] = status
+                    s.attrs["outcome"] = body.get("status", "?")
+                self._append_ledger(
+                    "request_done",
+                    request=rid,
+                    tenant=tenant,
+                    endpoint=kind,
+                    status=status,
+                    outcome=body.get("status", "?"),
+                )
+                self._request_seconds.observe(time.perf_counter() - t0)
+                return status, body, headers
+
+    def _handle_inner(
+        self, kind: str, payload, rid: str, tenant: str
+    ) -> tuple[int, dict, dict]:
+        if self._stopping:
+            return (
+                503,
+                {
+                    "status": "shutting_down",
+                    "error": "ServiceUnavailable",
+                    "message": "the service is draining; retry elsewhere",
+                    "request_id": rid,
+                },
+                {"Retry-After": "5"},
+            )
+        try:
+            ticket = admit(
+                payload,
+                request_id=rid,
+                kind=kind,
+                default_deadline_seconds=self.config.default_deadline_seconds,
+                # Price sweeps at the unit size _execute_sweep dispatches.
+                max_unit_lanes=self.config.max_batch * 8,
+            )
+        except AdmissionRejected as exc:
+            self._admission_rejected.inc()
+            body = {
+                "status": "rejected",
+                "error": "AdmissionRejected",
+                "reason": exc.reason,
+                "message": str(exc),
+                "request_id": rid,
+            }
+            if exc.suggestion:
+                body["suggestion"] = exc.suggestion
+            return 400, body, {}
+
+        # Deterministic overload drill (test-only hook, one `is None`
+        # check in production): push the armed burst of synthetic
+        # requests through the same quota/queue path first, so the shed
+        # and breaker responses below are exercised under real pressure.
+        from yuma_simulation_tpu.resilience import faults
+
+        overload = faults.active_overload_fault()
+        if overload is not None:
+            self._inject_overload(overload)
+
+        try:
+            try:
+                self.quotas.admit(ticket.tenant)
+            except QueueOverflow:
+                # The queue's put() counts its own sheds; quota sheds
+                # ride the same counter from here.
+                self.queue.record_shed()
+                raise
+            pending = _Pending(ticket)
+            self.queue.put(pending)
+        except QueueOverflow as exc:
+            retry_after = max(0.1, exc.retry_after)
+            self._append_ledger(
+                "request_shed",
+                request=rid,
+                tenant=ticket.tenant,
+                retry_after=round(retry_after, 3),
+            )
+            return (
+                429,
+                {
+                    "status": "shed",
+                    "error": "QueueOverflow",
+                    "message": str(exc),
+                    "retry_after": retry_after,
+                    "request_id": rid,
+                },
+                {"Retry-After": str(int(math.ceil(retry_after)))},
+            )
+
+        if not pending.done.wait(self._wall_cap(ticket)):
+            return (
+                504,
+                {
+                    "status": "failed",
+                    "error": "DeadlineExhausted",
+                    "message": "the request did not complete within its "
+                    "deadline envelope",
+                    "retryable": True,
+                    "request_id": rid,
+                },
+                {},
+            )
+        headers = {}
+        assert pending.status is not None and pending.response is not None
+        if "retry_after" in pending.response:
+            headers["Retry-After"] = str(
+                int(math.ceil(pending.response["retry_after"]))
+            )
+        return pending.status, pending.response, headers
+
+    def _wall_cap(self, ticket: AdmissionTicket) -> float:
+        """The handler's rendezvous bound: generous enough for a full
+        supervised ladder walk (attempts x rungs x (budget + grace)),
+        finite so a lost dispatcher cannot hold a connection forever."""
+        return 12.0 * ticket.deadline_seconds + 60.0
+
+    def _inject_overload(self, fault) -> None:
+        """The armed OverloadFault's synthetic burst: N tiny admitted
+        tickets through the real queue (sheds counted on the same
+        metrics the drill asserts on). Synthetic pendings execute and
+        are dropped — nobody waits on them."""
+        for i in range(fault.requests):
+            try:
+                ticket = admit(
+                    {
+                        "tenant": fault.tenant,
+                        "case": "Case 1",
+                        "deadline_seconds": 30,
+                    },
+                    request_id=f"synthetic-{i:04d}",
+                    kind="simulate",
+                    default_deadline_seconds=30.0,
+                )
+            except AdmissionRejected:  # pragma: no cover — Case 1 is valid
+                return
+            try:
+                self.queue.put(_Pending(ticket, synthetic=True))
+            except QueueOverflow:
+                continue  # counted by the queue; keep pushing the burst
+
+    # -- dispatcher ------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        with self.run.activate():
+            while True:
+                item = self.queue.get(timeout=0.05)
+                if item is None:
+                    if self._stopping:
+                        return
+                    continue
+                if self._stopping:
+                    item.resolve(
+                        503,
+                        {
+                            "status": "shutting_down",
+                            "error": "ServiceUnavailable",
+                            "message": "the service is draining",
+                            "request_id": item.ticket.request_id,
+                        },
+                    )
+                    continue
+                group = gather_group(
+                    self.queue,
+                    item,
+                    window_seconds=self.config.coalesce_window_seconds,
+                    # The sharded path stacks raw shapes (no donor-pack
+                    # miner masks), so a mesh-backed service dispatches
+                    # solo — bucket-mates may differ in raw [V, M].
+                    max_batch=(
+                        1 if self.config.mesh is not None
+                        else self.config.max_batch
+                    ),
+                )
+                self._execute_group(group)
+
+    def _execute_group(self, group: list) -> None:
+        from yuma_simulation_tpu.telemetry.runctx import span
+
+        first = group[0].ticket
+        with span(
+            f"dispatch:{first.kind}",
+            requests=[p.ticket.request_id for p in group],
+            bucket=first.plan.bucket.key,
+        ):
+            try:
+                if first.kind == "simulate":
+                    self._execute_simulate(group)
+                elif first.kind == "sweep":
+                    self._execute_sweep(group[0])
+                else:
+                    self._execute_table(group[0])
+            except BaseException as exc:  # noqa: BLE001 — typed below
+                logger.warning(
+                    "serve dispatch failed for %s",
+                    [p.ticket.request_id for p in group],
+                    exc_info=True,
+                )
+                for p in group:
+                    status, body = self._failure_response(
+                        exc, p.ticket.request_id
+                    )
+                    p.resolve(status, body)
+
+    def _remaining_or_fail(self, group: list) -> Optional[float]:
+        """The batch's conservative remaining deadline (the tightest
+        member's). Exhausted -> every member resolved 504 and None."""
+        remaining = min(p.ticket.remaining_seconds() for p in group)
+        if remaining <= 0.05:
+            for p in group:
+                p.resolve(
+                    504,
+                    {
+                        "status": "failed",
+                        "error": "DeadlineExhausted",
+                        "message": "the deadline expired while queued",
+                        "retryable": True,
+                        "request_id": p.ticket.request_id,
+                    },
+                )
+            return None
+        return remaining
+
+    def _supervisor(
+        self, *, engine: str, quarantine: bool, remaining: float, unit_size: int
+    ):
+        from yuma_simulation_tpu.resilience.retry import default_retry_policy
+        from yuma_simulation_tpu.resilience.supervisor import SweepSupervisor
+        from yuma_simulation_tpu.resilience.watchdog import Deadline
+
+        return SweepSupervisor(
+            directory=None,
+            unit_size=unit_size,
+            deadline=Deadline(
+                budget_seconds=max(0.1, remaining),
+                grace_seconds=max(0.1, remaining),
+            ),
+            retry_policy=default_retry_policy(),
+            quarantine=quarantine,
+            elastic=self.config.elastic,
+            engine=engine,
+        )
+
+    def _feed_breaker(self, start_rung: str, report) -> None:
+        if report.engine_demotions > 0:
+            self.breaker.record_failure(start_rung)
+            for rung in report.engines_used:
+                if rung != start_rung:
+                    self.breaker.record_success(rung)
+        else:
+            self.breaker.record_success(start_rung)
+
+    def _execute_simulate(self, group: list) -> None:
+        remaining = self._remaining_or_fail(group)
+        if remaining is None:
+            return
+        first = group[0].ticket
+        ladder = self.breaker.filter_ladder(first.plan.ladder)
+        start = ladder[0]
+        # The plan this dispatch actually runs: the admission plan,
+        # re-anchored below any tripped rung. record() stamps it (with
+        # the breaker's WHY) on the dispatch span, so flight bundles
+        # show which rung ran and on what grounds.
+        plan = first.plan.demoted(start)
+        plan.record()
+        quarantine = first.quarantine and start == "xla"
+        pack = start == "xla" and self.config.mesh is None
+        real = sum(1 for p in group if not p.synthetic)
+        sup = self._supervisor(
+            engine=start,
+            quarantine=quarantine,
+            remaining=remaining,
+            unit_size=max(1, len(group)),
+        )
+        try:
+            out = sup.run_batch(
+                [p.ticket.scenario for p in group],
+                first.version,
+                first.config,
+                mesh=self.config.mesh if start == "xla" else None,
+                tag=f"serve:{first.plan.bucket.key}",
+                pack=pack,
+            )
+        except BaseException as exc:
+            typed = classify_failure(exc)
+            if isinstance(typed, EngineFailure):
+                self.breaker.record_failure(start)
+            else:
+                # A failure the breaker must not count (caller error,
+                # unclassified crash) still has to release a half-open
+                # probe latch, or the rung stays dead forever.
+                self.breaker.abort_probe(start)
+            raise
+        report = out["report"]
+        self._feed_breaker(start, report)
+        if real > 1:
+            self._coalesced_lanes.inc(real)
+        dividends = np.asarray(out["dividends"])
+        entries = out["quarantine"].entries
+        for lane, p in enumerate(group):
+            if p.synthetic:
+                p.resolve(200, {"status": "ok", "synthetic": True})
+                continue
+            p.resolve(
+                200,
+                slice_simulate_response(
+                    dividends,
+                    lane,
+                    p.ticket,
+                    quarantine_entries=entries,
+                    report=report,
+                    coalesced=real,
+                ),
+            )
+
+    def _execute_sweep(self, pending: _Pending) -> None:
+        remaining = self._remaining_or_fail([pending])
+        if remaining is None:
+            return
+        t = pending.ticket
+        from yuma_simulation_tpu.simulation.sweep import config_grid
+
+        configs, points = config_grid(**t.axes)
+        sup = self._supervisor(
+            engine="xla",
+            quarantine=t.quarantine,
+            remaining=remaining,
+            unit_size=max(1, min(len(points), self.config.max_batch * 8)),
+        )
+        out = sup.run_grid(
+            t.scenario, t.version, configs, tag=f"serve:sweep:{t.request_id}"
+        )
+        report = out["report"]
+        dividends = np.asarray(out["dividends"])  # [P, E, V]
+        entries = out["quarantine"].entries
+        quarantined_points = sorted({e.case for e in entries})
+        body = {
+            "status": "partial" if quarantined_points else "ok",
+            "request_id": t.request_id,
+            "tenant": t.tenant,
+            "points": points,
+            "total_dividends": dividends.sum(axis=1).tolist(),  # [P, V]
+            "degraded": not report.clean,
+            "report": {
+                "stalls_killed": report.stalls_killed,
+                "engine_demotions": report.engine_demotions,
+                "mesh_shrinks": report.mesh_shrinks,
+                "units_retried": report.units_retried,
+                "lanes_quarantined": report.lanes_quarantined,
+                "engines_used": list(report.engines_used),
+            },
+        }
+        if quarantined_points:
+            body["quarantined_points"] = [int(i) for i in quarantined_points]
+        pending.resolve(200, body)
+
+    def _execute_table(self, pending: _Pending) -> None:
+        remaining = self._remaining_or_fail([pending])
+        if remaining is None:
+            return
+        t = pending.ticket
+        from yuma_simulation_tpu.models.config import YumaParams
+        from yuma_simulation_tpu.reporting.tables import (
+            generate_total_dividends_table,
+        )
+        from yuma_simulation_tpu.resilience.watchdog import (
+            Deadline,
+            run_with_deadline,
+        )
+        from yuma_simulation_tpu.scenarios.base import get_cases
+
+        versions = [(v, t.config.yuma_params or YumaParams()) for v in t.versions]
+        df = run_with_deadline(
+            lambda: generate_total_dividends_table(
+                get_cases(), versions, t.config.simulation
+            ),
+            Deadline(budget_seconds=max(0.1, remaining)),
+            label=f"serve:table:{t.request_id}",
+        )
+        pending.resolve(
+            200,
+            {
+                "status": "ok",
+                "request_id": t.request_id,
+                "tenant": t.tenant,
+                "versions": list(t.versions),
+                "csv": df.to_csv(index=False),
+            },
+        )
+
+    def _failure_response(self, exc: BaseException, rid: str) -> tuple[int, dict]:
+        """Every non-admission failure as a typed body: classified
+        engine failures are client-retryable 503s (the ladder already
+        did its best — a later request may find a recovered rung),
+        anything else a structured 503 naming the type. Never a bare
+        500 with a traceback."""
+        typed = classify_failure(exc)
+        name = type(typed if typed is not None else exc).__name__
+        return (
+            503,
+            {
+                "status": "failed",
+                "error": name,
+                "message": str(exc)[:500],
+                "retryable": isinstance(typed, EngineFailure),
+                "request_id": rid,
+            },
+        )
+
+    # -- ops surface -----------------------------------------------------
+
+    def healthz(self) -> dict:
+        return {
+            "status": "draining" if self._stopping else "ok",
+            "uptime_seconds": round(time.time() - self.started_t, 3),
+            "run_id": self.run.run_id,
+            "queue_depth": len(self.queue),
+            "queue_limit": self.queue.limit,
+            "breaker": self.breaker.snapshot(),
+            "requests_total": int(self._requests_total.value),
+        }
+
+    def metrics_text(self) -> str:
+        return self.registry.prometheus_text()
+
+    def close(self) -> None:
+        """Graceful shutdown: stop admitting, drain the queue (queued
+        requests resolve with a structured shutting-down 503), publish
+        the flight bundle. Idempotent."""
+        if self._closed:
+            return
+        self._stopping = True
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout=30.0)
+        # The dispatcher drains on its way out; anything still queued
+        # (dispatcher never started, or died) resolves here.
+        for item in self.queue.take_matching(lambda _p: True):
+            item.resolve(
+                503,
+                {
+                    "status": "shutting_down",
+                    "error": "ServiceUnavailable",
+                    "message": "the service is draining",
+                    "request_id": item.ticket.request_id,
+                },
+            )
+        self._closed = True
+        if self.config.bundle_dir is not None:
+            from yuma_simulation_tpu.telemetry.flight import FlightRecorder
+
+            try:
+                FlightRecorder(self.config.bundle_dir).record(
+                    self.run, registry=self.registry
+                )
+            except Exception:
+                logger.warning(
+                    "serve flight-bundle publish failed for %s",
+                    self.config.bundle_dir,
+                    exc_info=True,
+                )
+        log_event(
+            logger,
+            "serve_closed",
+            level=logging.INFO,
+            requests=int(self._requests_total.value),
+        )

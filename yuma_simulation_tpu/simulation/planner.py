@@ -232,6 +232,32 @@ class DispatchPlan:
             **{k: v for k, v in attr.items() if v is not None},
         )
 
+    def demoted(self, rung: str) -> "DispatchPlan":
+        """A copy of this plan re-anchored at a LOWER ladder rung — what
+        the serving tier's circuit breaker hands out while an upper rung
+        is tripped fleet-wide. Only rungs already in this plan's ladder
+        are legal (demotion must never upgrade a run onto an engine the
+        caller did not ask for, the same invariant `ladder_from` keeps);
+        the consensus impl switches to the pre-resolved XLA fallback
+        when the new rung is "xla"."""
+        if rung == self.engine:
+            return self
+        if rung not in self.ladder:
+            raise ValueError(
+                f"cannot re-anchor plan at {rung!r}: not in ladder "
+                f"{self.ladder} (demotion only walks DOWN)"
+            )
+        return dataclasses.replace(
+            self,
+            engine=rung,
+            consensus_impl=(
+                self.fallback_consensus if rung == "xla" else self.consensus_impl
+            ),
+            ladder=ladder_from(rung),
+            reasons=self.reasons
+            + (f"circuit breaker re-anchored dispatch at {rung!r}",),
+        )
+
     def attach_cost(self, yuma_version: str = "Yuma 1 (paper)") -> "DispatchPlan":
         """A copy of this plan with the chosen rung's AOT cost record
         attached (`telemetry.cost.capture_engine_cost`). COMPILES a

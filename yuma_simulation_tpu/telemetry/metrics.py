@@ -36,6 +36,21 @@ Well-known series (incremented at their SOURCE, exactly once):
 ``live_buffers``        gauge, live jax.Array count at last sample
 ======================  ====================================================
 
+Serving-tier series (:mod:`..serve` — registered eagerly at service
+construction so `/metrics` and flight-bundle snapshots expose them even
+at zero):
+
+===========================  ===============================================
+``serve_requests_total``     requests handled (any outcome)
+``serve_queue_depth``        gauge, run-queue occupancy right now
+``serve_requests_shed``      429-shed requests (tenant quota or queue bound)
+``serve_admission_rejected`` typed admission rejections (pre-compile)
+``serve_coalesced_lanes``    requests donor-packed into a shared dispatch
+``serve_breaker_trips``      circuit-breaker rung trips
+``serve_breaker_open``       gauge, engine rungs currently tripped open
+``serve_request_seconds``    histogram, request wall time admission->reply
+===========================  ===============================================
+
 Host-side ONLY: nothing here may be called from inside traced code (the
 zero-warm-repeat compile budgets of tests/unit/test_recompilation.py and
 jaxlint's impurity rules stay authoritative) — every producer above sits

@@ -141,6 +141,21 @@ class RunContext:
         _CURRENT_RUN.reset(self._token)
         self._token = None
 
+    @contextlib.contextmanager
+    def activate(self) -> Iterator["RunContext"]:
+        """Join this run from ANY thread, concurrently. Unlike
+        ``__enter__`` (exclusive — one entry, the owning scope),
+        ``activate()`` may be held by many threads at once: each thread
+        gets its own contextvar binding, so a long-lived service can
+        stamp every request-handler thread's spans/records with ONE
+        server run without serializing the handlers. Span bookkeeping
+        is lock-protected, so concurrent activations are safe."""
+        token = _CURRENT_RUN.set(self)
+        try:
+            yield self
+        finally:
+            _CURRENT_RUN.reset(token)
+
     # -- span bookkeeping (called by :func:`span`) ---------------------
 
     def _open_span(self, name: str, parent: Optional[Span]) -> Span:

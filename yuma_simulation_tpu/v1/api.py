@@ -40,6 +40,9 @@ from yuma_simulation_tpu.reporting.tables import (  # noqa: F401  (promoted)
     generate_total_dividends_table,
 )
 from yuma_simulation_tpu.scenarios.base import Scenario
+from yuma_simulation_tpu.serve.server import (  # noqa: F401  (promoted)
+    SimulationClient,
+)
 from yuma_simulation_tpu.simulation.engine import run_simulation  # noqa: F401
 from yuma_simulation_tpu.simulation.sweep import (
     pad_scenarios as _pad_scenarios,
@@ -48,10 +51,12 @@ from yuma_simulation_tpu.simulation.sweep import (
 
 #: The frozen ApiVer surface (reference README.md:15-18): exactly these
 #: names are public; everything else in this module is an implementation
-#: detail that may change without notice.
+#: detail that may change without notice. 0.12.0 grows it ADDITIVELY
+#: with the serving tier's entry point + client (MIGRATION.md).
 __all__ = [
     "HTML",
     "Scenario",
+    "SimulationClient",
     "SimulationHyperparameters",
     "YumaConfig",
     "YumaParams",
@@ -59,7 +64,36 @@ __all__ = [
     "generate_chart_table",
     "generate_total_dividends_table",
     "run_simulation",
+    "serve",
 ]
+
+
+def serve(
+    host: str = "127.0.0.1",
+    port: int = 8080,
+    *,
+    background: bool = False,
+    **knobs,
+):
+    """Start the warm-engine simulation service (README "Serving"):
+    `simulate`/`sweep`/chart-table endpoints with admission control,
+    per-tenant quotas, shape-bucket coalescing and graceful degradation,
+    plus `/metrics` and `/healthz`.
+
+    Blocking by default (the CLI behavior: serve until interrupted);
+    `background=True` returns the started
+    :class:`..serve.server.SimulationServer` — call ``.close()`` for a
+    graceful drain. `knobs` are :class:`..serve.service.ServeConfig`
+    fields (``queue_limit``, ``coalesce_window_seconds``,
+    ``tenant_rate``, ``bundle_dir``, ...)."""
+    from yuma_simulation_tpu.serve.server import SimulationServer
+    from yuma_simulation_tpu.serve.service import ServeConfig
+
+    server = SimulationServer(ServeConfig(**knobs), host=host, port=port)
+    if background:
+        return server.start()
+    server.serve_forever()
+    return server
 
 #: Chart rows rendered per case; cases with `plot_incentives` (Cases 10
 #: and 11 of the built-in suite — the reference keys this off positional
