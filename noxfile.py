@@ -96,6 +96,8 @@ TEST_CHUNKS = [
         "tests/unit/test_fabric.py",
         "tests/unit/test_fleet_drill.py",
         "tests/unit/test_serve.py",
+        "tests/unit/test_slo.py",
+        "tests/unit/test_propagation.py",
     ],
 ]
 
@@ -162,6 +164,10 @@ def fleet(session: nox.Session) -> None:
         "python", "-m", "tools.obsreport", bundle,
         "--fleet-drill", "--check",
     )
+    session.run(
+        "python", "-m", "tools.sloreport",
+        os.path.join(bundle, "store"), "--check", "--require",
+    )
 
 
 @nox.session
@@ -184,6 +190,24 @@ def serve(session: nox.Session) -> None:
         "--tenant-burst", "4", "--coalesce-window", "0.3",
     )
     session.run("python", "-m", "tools.obsreport", bundle, "--check")
+    session.run(
+        "python", "-m", "tools.sloreport", bundle, "--check", "--require"
+    )
+
+
+@nox.session
+def slo(session: nox.Session) -> None:
+    """SLO lane (mirrors the CI sloreport gates): the distributed-
+    tracing + SLO test battery — sketch algebra property tests,
+    burn-rate arithmetic against hand-computed windows, the SLO
+    degradation drill, traceparent propagation round-trips and the
+    stitched orphan-span gate."""
+    session.install("-e", ".[test]")
+    session.run(
+        "python", "-m", "pytest",
+        "tests/unit/test_slo.py", "tests/unit/test_propagation.py",
+        "-q",
+    )
 
 
 @nox.session

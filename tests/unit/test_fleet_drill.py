@@ -49,3 +49,33 @@ def test_pod_level_fleet_chaos_drill(tmp_path, capsys):
     assert obsreport_main([summary["store"], "--check"]) == 0
     out = capsys.readouterr().out
     assert "fleet store is sound" in out
+    # ONE stitched trace (ISSUE 9): the report renders a single
+    # cross-process timeline and the per-unit rows name the executing
+    # host inline.
+    assert "stitched trace" in out
+    assert "units (executing host inline):" in out
+
+    # The per-host sloreport gate passes (no host captured an active
+    # fast burn; the SIGKILLed host is skipped, not failed).
+    from tools.sloreport import main as sloreport_main
+
+    assert sloreport_main([summary["store"], "--check", "--require"]) == 0
+    capsys.readouterr()
+
+    # Tamper gate: orphan a host span by deleting the driver's bundle
+    # spans — the stitched check must turn obsreport --check red.
+    import pathlib
+
+    from yuma_simulation_tpu.fabric.simhost import DRIVER_HOST_ID
+
+    driver_spans = (
+        pathlib.Path(summary["store"])
+        / "hosts"
+        / DRIVER_HOST_ID
+        / "spans.jsonl"
+    )
+    assert driver_spans.exists()
+    driver_spans.write_text("")
+    assert obsreport_main([summary["store"], "--check"]) == 2
+    err = capsys.readouterr().err
+    assert "orphan" in err

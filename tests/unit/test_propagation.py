@@ -1,0 +1,445 @@
+"""Cross-process trace propagation: ISSUE 9 acceptance battery (trace
+half).
+
+The contract under test: a `TraceContext` survives every carrier
+(header, env, fleet manifest) byte-exactly; a continued run's spans
+root under the caller's span with collision-free prefixed ids; the
+single-bundle check exempts remote roots while the STITCHED check
+fails on orphans (the tamper gate); the serving tier echoes
+`X-Request-Id` on every response, joins inbound traceparents, and
+returns the critical-path `Server-Timing`; and a fleet host continues
+the sweep-level trace it finds in the manifest."""
+
+import json
+
+import pytest
+
+from yuma_simulation_tpu.telemetry.flight import (
+    FlightRecorder,
+    check_bundle,
+    check_stitched,
+    load_bundle,
+    merge_bundles,
+)
+from yuma_simulation_tpu.telemetry.propagation import (
+    BAGGAGE_ENV,
+    TRACEPARENT_ENV,
+    TraceContext,
+    child_run,
+    continue_trace,
+    current_trace_context,
+    span_prefix_for,
+)
+from yuma_simulation_tpu.telemetry.runctx import (
+    RunContext,
+    current_run,
+    span,
+)
+
+VERSION = "Yuma 1 (paper)"
+
+
+# ------------------------------------------------------------ wire forms
+
+
+def test_traceparent_round_trip_with_dashes_and_baggage():
+    ctx = TraceContext(
+        "run-ab12cd34ef56", "s0007", (("request", "r1"), ("tenant", "t-1"))
+    )
+    back = TraceContext.from_traceparent(
+        ctx.to_traceparent(), ctx.to_baggage()
+    )
+    assert back == ctx
+    # Operator-chosen run ids with extra dashes survive the framing.
+    odd = TraceContext("my-nightly-sweep-2026", "ab12cd34.s0003")
+    assert TraceContext.from_traceparent(odd.to_traceparent()) == odd
+
+
+def test_traceparent_empty_span_round_trips_as_root():
+    ctx = TraceContext("run-x")
+    header = ctx.to_traceparent()
+    assert header == "00-run-x-root-01"
+    assert TraceContext.from_traceparent(header) == ctx
+
+
+@pytest.mark.parametrize(
+    "header",
+    [None, "", "garbage", "00-x-01", "01-run-a-s0001-01", "00--s1-01", 7],
+)
+def test_malformed_traceparent_parses_to_none(header):
+    assert TraceContext.from_traceparent(header) is None
+
+
+def test_env_round_trip_and_scrubbed_env():
+    ctx = TraceContext("run-e", "s0002", (("k", "v"),))
+    env = ctx.to_env()
+    assert TraceContext.from_env(env) == ctx
+    assert TraceContext.from_env({TRACEPARENT_ENV: "", BAGGAGE_ENV: ""}) is None
+    assert TraceContext.from_env({}) is None
+
+
+def test_manifest_round_trip():
+    ctx = TraceContext("run-m", "s0009", (("fleet", "drill"),))
+    manifest = {"num_units": 3, "trace": ctx.to_manifest()}
+    assert TraceContext.from_manifest(manifest) == ctx
+    assert TraceContext.from_manifest({"num_units": 3}) is None
+
+
+def test_current_trace_context_captures_run_and_span():
+    assert current_trace_context() is None
+    with RunContext() as run:
+        ctx = current_trace_context()
+        assert ctx.run_id == run.run_id and ctx.span_id == ""
+        with span("outer") as s:
+            ctx = current_trace_context(tenant="t9")
+            assert ctx.span_id == s.span_id
+            assert dict(ctx.baggage) == {"tenant": "t9"}
+
+
+# ----------------------------------------------------- continued runs
+
+
+def test_child_run_roots_under_remote_parent_with_prefixed_ids():
+    ctx = TraceContext("run-parent", "s0004")
+    child = child_run(ctx, prefix="aabbccdd")
+    with child:
+        with span("hosted") as outer:
+            with span("nested") as inner:
+                pass
+    recs = {r["span_id"]: r for r in child.span_records()}
+    root = recs[outer.span_id]
+    assert root["run_id"] == "run-parent"
+    assert root["parent_id"] == "s0004"
+    assert root["remote_parent"] is True
+    assert root["span_id"].startswith("aabbccdd.")
+    nested = recs[inner.span_id]
+    assert nested["parent_id"] == outer.span_id
+    assert "remote_parent" not in nested  # local parent, no flag
+
+
+def test_span_prefix_rejects_dashes():
+    with pytest.raises(ValueError):
+        RunContext(span_prefix="a-b")
+    assert "-" not in span_prefix_for("host-with-dashes-1234")
+
+
+def test_continue_trace_joins_active_run_first():
+    with RunContext() as outer:
+        with continue_trace(TraceContext("run-other", "s1")) as run:
+            assert run is outer  # in-process callers keep their nesting
+    with continue_trace(None) as run:
+        assert run.run_id.startswith("run-")
+    ctx = TraceContext("run-cont", "s0001")
+    with continue_trace(ctx, prefix="ee00ff11") as run:
+        assert run.run_id == "run-cont"
+        assert current_run() is run
+
+
+def test_record_span_synthesizes_closed_children():
+    with RunContext() as run:
+        with span("request") as s:
+            pass
+    phase = run.record_span(
+        "queue", 100.0, 100.5, parent_id=s.span_id, depth=3
+    )
+    recs = {r["span_id"]: r for r in run.span_records()}
+    rec = recs[phase.span_id]
+    assert rec["parent_id"] == s.span_id
+    assert rec["t_start"] == 100.0 and rec["t_end"] == 100.5
+    assert rec["attrs"] == {"depth": 3}
+
+
+# ------------------------------------------------- bundle checks / stitch
+
+
+def _bundle_pair(tmp_path):
+    """A driver bundle + a continued child bundle in sibling dirs."""
+    driver = RunContext(run_id="run-stitch")
+    with driver:
+        with span("drive") as s:
+            ctx = TraceContext(driver.run_id, s.span_id)
+    child = child_run(ctx, prefix="11223344")
+    with child:
+        with span("hosted"):
+            pass
+    FlightRecorder(tmp_path / "driver").record(driver)
+    FlightRecorder(tmp_path / "child").record(child)
+    return load_bundle(tmp_path / "driver"), load_bundle(tmp_path / "child")
+
+
+def test_remote_root_is_exempt_locally_but_stitches_globally(tmp_path):
+    driver_b, child_b = _bundle_pair(tmp_path)
+    # Single-bundle check: the remote-parent root must NOT be an error.
+    assert check_bundle(child_b) == []
+    # Stitched: the pair resolves; the child alone is an orphan.
+    assert check_stitched([driver_b, child_b]) == []
+    problems = check_stitched([child_b])
+    assert problems and "orphan" in problems[0]
+
+
+def test_stitched_check_fails_on_tampered_bundle(tmp_path):
+    driver_b, child_b = _bundle_pair(tmp_path)
+    # Tamper: drop the driver's span record the child chains to.
+    spans_path = tmp_path / "driver" / "spans.jsonl"
+    kept = [
+        line
+        for line in spans_path.read_text().splitlines()
+        if json.loads(line).get("name") != "drive"
+    ]
+    spans_path.write_text("".join(k + "\n" for k in kept))
+    tampered = load_bundle(tmp_path / "driver")
+    problems = check_stitched([tampered, child_b])
+    assert problems and "orphan" in problems[0]
+
+
+def test_merge_bundles_unions_and_orders(tmp_path):
+    driver_b, child_b = _bundle_pair(tmp_path)
+    union = merge_bundles([driver_b, child_b])
+    ids = {s["span_id"] for s in union.spans}
+    assert any(i.startswith("11223344.") for i in ids)
+    assert "s0001" in ids
+    starts = [s.get("t_start") or 0.0 for s in union.spans]
+    assert starts == sorted(starts)
+
+
+# ------------------------------------------------------- serve carriers
+
+
+def test_serve_echoes_request_id_on_every_response(tmp_path):
+    from yuma_simulation_tpu.serve import (
+        ServeConfig,
+        SimulationClient,
+        SimulationServer,
+        wait_until_ready,
+    )
+
+    server = SimulationServer(
+        ServeConfig(coalesce_window_seconds=0.0)
+    ).start()
+    try:
+        assert wait_until_ready(server.url)
+        client = SimulationClient(server.url, tenant="prop")
+        ok = client.simulate(case="Case 1")
+        assert ok.status == 200 and ok.request_id
+        assert ok.traceparent is not None
+        timing = ok.server_timing
+        for phase in ("queue", "coalesce", "compile", "execute", "total"):
+            assert phase in timing, (phase, timing)
+        rejected = client.simulate(weights=[[1.0]])
+        assert rejected.status == 400 and rejected.request_id
+        missing = client._request("POST", "/v1/nowhere", {})
+        assert missing.status == 404 and missing.request_id
+        health = client.healthz()
+        assert health.request_id
+        # ids are distinct per call — the retry-correlation property.
+        ids = {ok.request_id, rejected.request_id, missing.request_id}
+        assert len(ids) == 3
+    finally:
+        server.close()
+
+
+def test_serve_joins_inbound_traceparent(tmp_path):
+    from yuma_simulation_tpu.serve import (
+        ServeConfig,
+        SimulationClient,
+        SimulationServer,
+        wait_until_ready,
+    )
+
+    bundle_dir = tmp_path / "serve-bundle"
+    server = SimulationServer(
+        ServeConfig(coalesce_window_seconds=0.0, bundle_dir=str(bundle_dir))
+    ).start()
+    try:
+        assert wait_until_ready(server.url)
+        client = SimulationClient(server.url, tenant="traced")
+        with RunContext() as run:
+            with span("caller") as s:
+                r = client.simulate(case="Case 1")
+        assert r.ok and r.request_id
+    finally:
+        server.close()
+
+    bundle = load_bundle(bundle_dir)
+    assert check_bundle(bundle) == []
+    # The request span landed in the CALLER's run, parented under the
+    # caller's span, flagged remote.
+    req = [
+        x
+        for x in bundle.spans
+        if x.get("name") == f"request:{r.request_id}"
+    ]
+    assert req, [x.get("name") for x in bundle.spans]
+    req = req[0]
+    assert req["run_id"] == run.run_id
+    assert req["parent_id"] == s.span_id
+    assert req.get("remote_parent") is True
+    # Critical-path children hang off the request span.
+    kids = {
+        x["name"]
+        for x in bundle.spans
+        if x.get("parent_id") == req["span_id"]
+    }
+    assert {"queue", "execute"} <= kids, kids
+    # And the caller's own bundle stitches with the server's.
+    caller_dir = tmp_path / "caller-bundle"
+    FlightRecorder(caller_dir).record(run)
+    assert (
+        check_stitched([load_bundle(caller_dir), bundle]) == []
+    )
+
+
+def test_obsreport_renders_critical_path(tmp_path):
+    from tools.obsreport import render_serve
+    from yuma_simulation_tpu.serve import ServeConfig, SimulationService
+
+    bundle_dir = tmp_path / "svc-bundle"
+    svc = SimulationService(
+        ServeConfig(
+            coalesce_window_seconds=0.0, bundle_dir=str(bundle_dir)
+        )
+    )
+    try:
+        status, body, headers = svc.handle(
+            "simulate", {"tenant": "cp", "case": "Case 1"}
+        )
+        assert status == 200
+        assert "Server-Timing" in headers and "X-Request-Id" in headers
+    finally:
+        svc.close()
+    bundle = load_bundle(bundle_dir)
+    lines = "\n".join(render_serve(bundle, bundle.latest_run_id()))
+    assert "tenant cp" in lines
+    assert "queue" in lines and "execute" in lines
+
+
+# ------------------------------------------------------- fleet carriers
+
+
+def test_fleet_host_continues_manifest_trace(tmp_path):
+    from yuma_simulation_tpu.fabric.scheduler import (
+        FleetConfig,
+        run_fleet_batch,
+    )
+    from yuma_simulation_tpu.fabric.store import FleetStore
+    from yuma_simulation_tpu.scenarios import get_cases
+
+    cases = get_cases()[:4]
+    store_dir = tmp_path / "store"
+    with RunContext() as run:
+        with span("driver") as s:
+            out = run_fleet_batch(
+                cases,
+                VERSION,
+                FleetConfig(directory=store_dir, unit_size=2, host_id="h-A"),
+            )
+    assert out["report"].units_published == 2
+    store = FleetStore(store_dir)
+    manifest = store.manifest()
+    ctx = TraceContext.from_manifest(manifest)
+    assert ctx is not None
+    assert ctx.run_id == run.run_id and ctx.span_id == s.span_id
+    # The in-process host joined the driver run directly: every span of
+    # its bundle belongs to the driver's run and resolves locally.
+    host_bundle = load_bundle(store.host_dir("h-A"))
+    assert check_bundle(host_bundle) == []
+    assert {x["run_id"] for x in host_bundle.spans} == {run.run_id}
+    # Lease claims carried the trace while held; the manifest trace is
+    # the durable record (leases are released on publish).
+    assert manifest["trace"]["traceparent"].startswith("00-" + run.run_id)
+
+
+def test_late_joiner_inherits_manifest_trace_as_child_run(tmp_path):
+    """A host arriving with NO ambient trace continues the manifest's:
+    its spans land in the driver's run under a fresh prefix, rooted at
+    the driver's span — the orphan-run regression this PR exists to
+    kill."""
+    from yuma_simulation_tpu.fabric.scheduler import (
+        FleetConfig,
+        run_fleet_batch,
+    )
+    from yuma_simulation_tpu.fabric.store import FleetStore
+    from yuma_simulation_tpu.scenarios import get_cases
+
+    cases = get_cases()[:4]
+    store_dir = tmp_path / "store"
+    driver = RunContext()
+    with driver:
+        with span("driver") as s:
+            run_fleet_batch(
+                cases,
+                VERSION,
+                FleetConfig(directory=store_dir, unit_size=2, host_id="h-A"),
+            )
+    # Second invocation, no active run: resumes the finished sweep
+    # (pure collection) and must STILL continue the manifest trace.
+    run_fleet_batch(
+        cases,
+        VERSION,
+        FleetConfig(directory=store_dir, unit_size=2, host_id="h-B"),
+    )
+    store = FleetStore(store_dir)
+    b_bundle = load_bundle(store.host_dir("h-B"))
+    assert {x["run_id"] for x in b_bundle.spans} == {driver.run_id}
+    roots = [x for x in b_bundle.spans if x.get("remote_parent")]
+    assert roots and all(x["parent_id"] == s.span_id for x in roots)
+    prefixes = {x["span_id"].split(".")[0] for x in b_bundle.spans}
+    assert all("." in x["span_id"] for x in b_bundle.spans)
+    # Prefixed ids cannot collide with the driver-joined host's.
+    a_ids = {x["span_id"] for x in load_bundle(store.host_dir("h-A")).spans}
+    b_ids = {x["span_id"] for x in b_bundle.spans}
+    assert not (a_ids & b_ids)
+    # The stitched union of driver + both hosts resolves completely.
+    driver_dir = tmp_path / "driver-bundle"
+    FlightRecorder(driver_dir).record(driver)
+    bundles = [
+        load_bundle(driver_dir),
+        load_bundle(store.host_dir("h-A")),
+        b_bundle,
+    ]
+    assert check_stitched(bundles) == []
+    assert len(prefixes) == 1
+
+
+def test_lease_claim_records_trace(tmp_path):
+    from yuma_simulation_tpu.fabric.lease import LeaseStore
+
+    leases = LeaseStore(tmp_path, "host-lease-test")
+    with RunContext() as run:
+        with span("claiming"):
+            claim = leases.try_claim(3)
+            assert claim is not None
+            rec = json.loads(leases.lease_path(3).read_text())
+    assert rec["host"] == "host-lease-test"
+    assert rec["trace"].startswith("00-" + run.run_id)
+    parsed = TraceContext.from_traceparent(rec["trace"])
+    assert parsed.run_id == run.run_id
+
+
+def test_manifest_trace_excluded_from_identity_check(tmp_path):
+    from yuma_simulation_tpu.fabric.store import FleetStore
+
+    store = FleetStore(tmp_path / "s")
+    meta = dict(
+        num_units=2,
+        unit_lanes=[(0, 1), (1, 2)],
+        tag="t",
+        config={"v": 1},
+    )
+    store.ensure_manifest(
+        **meta, trace=TraceContext("run-first", "s1").to_manifest()
+    )
+    # A host arriving with a DIFFERENT ambient trace still joins; the
+    # first writer's trace stands.
+    found = store.ensure_manifest(
+        **meta, trace=TraceContext("run-second", "s9").to_manifest()
+    )
+    assert found["trace"]["traceparent"].startswith("00-run-first")
+    # Genuine sweep-identity mismatches still refuse.
+    with pytest.raises(ValueError):
+        store.ensure_manifest(
+            num_units=2,
+            unit_lanes=[(0, 1), (1, 2)],
+            tag="t",
+            config={"v": 2},
+        )

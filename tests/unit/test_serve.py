@@ -200,6 +200,42 @@ def test_admission_rejects_malformed_payloads():
         svc.close()
 
 
+def test_admission_clamps_priority_to_negotiated_ceiling():
+    """The payload ``priority`` field is untrusted: with a
+    ``tenant_priority`` ceiling table installed, a tenant rides at most
+    its negotiated entry (absent tenants at 0) — a client cannot opt
+    out of SLO-driven shedding by claiming priority in the body. No
+    table (default) keeps the payload-trusting behavior."""
+    from yuma_simulation_tpu.serve.admission import admit
+
+    kw = dict(
+        request_id="r1", kind="simulate", default_deadline_seconds=30.0
+    )
+    assert admit({"case": "Case 1", "priority": 7}, **kw).priority == 7
+    assert (
+        admit(
+            {"case": "Case 1", "priority": 7}, tenant_priority={}, **kw
+        ).priority
+        == 0
+    )
+    assert (
+        admit(
+            {"case": "Case 1", "tenant": "vip", "priority": 7},
+            tenant_priority={"vip": 2},
+            **kw,
+        ).priority
+        == 2
+    )
+    assert (
+        admit(
+            {"case": "Case 1", "tenant": "vip", "priority": 1},
+            tenant_priority={"vip": 2},
+            **kw,
+        ).priority
+        == 1
+    )
+
+
 def test_admission_preflight_rejects_with_suggestion(monkeypatch):
     """The analytic HBM preflight prices the request BEFORE any compile:
     under a nano device spec the shape is rejected with the planner's

@@ -1,0 +1,526 @@
+"""SLO engine: ISSUE 9 acceptance battery (judgment half).
+
+The contract under test: sketch merge is associative/commutative and
+quantiles hold the declared relative-error bound under randomized
+splits across "hosts"; burn-rate arithmetic pins against hand-computed
+windows on a fake clock; the degradation drill — a synthetic
+latency/error burst flips the named SLO to fast-burn, `/healthz`
+reflects it, admission sheds (typed `SloShed`, counted on the shed
+metrics) BEFORE `QueueOverflow`, `sloreport --check` exits non-zero on
+the captured bundle, and recovery un-flips it."""
+
+import json
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.resilience import (
+    QueueOverflow,
+    SloShed,
+    classify_failure,
+)
+from yuma_simulation_tpu.telemetry.slo import (
+    DEFAULT_SLO_SPECS,
+    LatencySketch,
+    SLOEngine,
+    SLOSpec,
+    get_slo_engine,
+    observe_duration,
+    peek_slo_engine,
+    set_slo_engine,
+)
+
+VERSION = "Yuma 1 (paper)"
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_000.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------------- sketches
+
+
+def test_sketch_quantiles_hold_relative_error_bound():
+    rng = np.random.default_rng(7)
+    alpha = 0.01
+    values = np.concatenate(
+        [
+            rng.lognormal(mean=-2.0, sigma=2.0, size=4000),
+            rng.uniform(0.0001, 100.0, size=1000),
+        ]
+    )
+    sketch = LatencySketch(alpha)
+    for v in values:
+        sketch.observe(float(v))
+    ordered = np.sort(values)
+    for q in (0.01, 0.25, 0.5, 0.9, 0.99, 0.999):
+        rank = min(len(ordered) - 1, max(0, int(np.ceil(q * len(ordered))) - 1))
+        true = float(ordered[rank])
+        est = sketch.quantile(q)
+        assert abs(est - true) / true <= 2 * alpha + 1e-12, (q, est, true)
+
+
+def test_sketch_merge_is_associative_and_commutative():
+    rng = np.random.default_rng(11)
+    values = rng.lognormal(sigma=3.0, size=3000).tolist()
+    for trial in range(5):
+        # Randomized split across "hosts", merged in two random orders.
+        k = int(rng.integers(2, 7))
+        assignment = rng.integers(0, k, size=len(values))
+        hosts = [LatencySketch() for _ in range(k)]
+        for host, v in zip(assignment, values):
+            hosts[host].observe(v)
+        order_a = list(rng.permutation(k))
+        order_b = list(rng.permutation(k))
+        merged_a = LatencySketch()
+        for i in order_a:
+            merged_a.merge(hosts[i])
+        # Associativity: fold pairwise sub-merges instead of a chain.
+        half = LatencySketch()
+        for i in order_b[: k // 2]:
+            half.merge(hosts[i])
+        rest = LatencySketch()
+        for i in order_b[k // 2 :]:
+            rest.merge(hosts[i])
+        merged_b = LatencySketch().merge(half).merge(rest)
+        ja, jb = merged_a.to_json(), merged_b.to_json()
+        assert ja["counts"] == jb["counts"]
+        assert ja["count"] == jb["count"] == len(values)
+        assert ja["min"] == jb["min"] and ja["max"] == jb["max"]
+        assert ja["sum"] == pytest.approx(jb["sum"])
+        for q in (0.5, 0.99):
+            assert merged_a.quantile(q) == merged_b.quantile(q)
+
+
+def test_sketch_merged_quantiles_match_single_sketch_exactly():
+    rng = np.random.default_rng(3)
+    values = rng.lognormal(sigma=2.0, size=2000).tolist()
+    single = LatencySketch()
+    parts = [LatencySketch() for _ in range(4)]
+    for i, v in enumerate(values):
+        single.observe(v)
+        parts[i % 4].observe(v)
+    merged = LatencySketch()
+    for p in parts:
+        merged.merge(p)
+    assert merged.to_json()["counts"] == single.to_json()["counts"]
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert merged.quantile(q) == single.quantile(q)
+
+
+def test_sketch_json_round_trip_and_edge_values():
+    sketch = LatencySketch()
+    for v in (0.0, -1.0, 1e-9, 5.0):
+        sketch.observe(v)
+    rec = sketch.to_json()
+    back = LatencySketch.from_json(json.loads(json.dumps(rec)))
+    assert back.to_json() == rec
+    assert back.count == 4
+    # Non-positive values occupy the zero bucket; low quantiles read 0.
+    assert back.quantile(0.25) == 0.0
+    assert LatencySketch().quantile(0.5) is None
+    with pytest.raises(ValueError):
+        sketch.quantile(1.5)
+
+
+def test_sketch_merge_rejects_mismatched_accuracy():
+    with pytest.raises(ValueError):
+        LatencySketch(0.01).merge(LatencySketch(0.05))
+    with pytest.raises(ValueError):
+        LatencySketch(relative_accuracy=0.0)
+
+
+# ------------------------------------------------------------ burn rates
+
+
+def _latency_spec(**overrides) -> SLOSpec:
+    base = dict(
+        name="lat",
+        objective=0.9,
+        sketch="m",
+        threshold_seconds=1.0,
+        fast_window_seconds=60.0,
+        fast_burn_threshold=5.0,
+        slow_window_seconds=600.0,
+        slow_burn_threshold=2.0,
+        min_events=1,
+    )
+    base.update(overrides)
+    return SLOSpec(**base)
+
+
+def test_burn_rate_arithmetic_pinned_hand_computed():
+    clock = FakeClock(10_000.0)
+    eng = SLOEngine([_latency_spec()], clock=clock)
+    # 10 good + 10 bad in the fast window: bad fraction 0.5, error
+    # budget 0.1 -> burn 5.0 exactly.
+    for _ in range(10):
+        eng.observe("m", 0.5)
+    for _ in range(10):
+        eng.observe("m", 2.0)
+    status = eng.evaluate()["lat"]
+    assert status["fast_burn_rate"] == pytest.approx(5.0)
+    assert status["fast_window"] == {"good": 10, "bad": 10}
+    assert status["state"] == "fast_burn"
+    # Aging: 120s later the fast window is empty (burn 0) but the slow
+    # window still holds all 20 -> burn 5 >= slow threshold 2.
+    clock.advance(120.0)
+    status = eng.evaluate()["lat"]
+    assert status["fast_burn_rate"] == 0.0
+    assert status["slow_burn_rate"] == pytest.approx(5.0)
+    assert status["state"] == "slow_burn"
+    # 700s total: everything aged out of both windows -> ok.
+    clock.advance(580.0)
+    status = eng.evaluate()["lat"]
+    assert status["slow_burn_rate"] == 0.0
+    assert status["state"] == "ok"
+    # The alert history tells the whole walk, recovery included.
+    assert [a["to"] for a in eng.alerts()][-3:] == [
+        "fast_burn",
+        "slow_burn",
+        "ok",
+    ]
+
+
+def test_burn_rate_boundary_exact_threshold_fires():
+    clock = FakeClock()
+    eng = SLOEngine(
+        [_latency_spec(fast_burn_threshold=2.0)], clock=clock
+    )
+    # 4/5 good: bad fraction 0.2 / budget 0.1 = burn 2.0 == threshold.
+    for v in (0.5, 0.5, 0.5, 0.5, 9.0):
+        eng.observe("m", v)
+    assert eng.evaluate()["lat"]["state"] == "fast_burn"
+
+
+def test_min_events_suppresses_sparse_windows():
+    clock = FakeClock()
+    eng = SLOEngine([_latency_spec(min_events=10)], clock=clock)
+    for _ in range(9):
+        eng.observe("m", 9.0)  # 9 bad events, all below min_events
+    status = eng.evaluate()["lat"]
+    assert status["state"] == "ok"
+    assert status["fast_burn_rate"] == 0.0
+    eng.observe("m", 9.0)  # the 10th arms the window
+    assert eng.evaluate()["lat"]["state"] == "fast_burn"
+
+
+def test_event_based_slo_and_degrade_flag():
+    clock = FakeClock()
+    eng = SLOEngine(
+        [
+            SLOSpec(
+                "errors",
+                objective=0.9,
+                event="ok_stream",
+                fast_window_seconds=60.0,
+                fast_burn_threshold=2.0,
+                degrade=True,
+            ),
+            SLOSpec(
+                "sheds",
+                objective=0.9,
+                event="admitted",
+                fast_window_seconds=60.0,
+                fast_burn_threshold=2.0,
+                degrade=False,
+            ),
+        ],
+        clock=clock,
+    )
+    for _ in range(5):
+        eng.event("ok_stream", False)
+        eng.event("admitted", False)
+    assert set(eng.fast_burning()) == {"errors", "sheds"}
+    # Only degrade=True SLOs drive admission shedding.
+    assert eng.degraded() == ("errors",)
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        SLOSpec("x", objective=1.0, event="e")
+    with pytest.raises(ValueError):
+        SLOSpec("x", objective=0.9)  # neither sketch nor event
+    with pytest.raises(ValueError):
+        SLOSpec("x", objective=0.9, sketch="m")  # sketch w/o threshold
+    with pytest.raises(ValueError):
+        SLOSpec("x", objective=0.9, event="e", min_events=0)
+    with pytest.raises(ValueError):
+        SLOEngine([_latency_spec(), _latency_spec()])  # duplicate names
+
+
+def test_transitions_feed_metrics_and_hook():
+    from yuma_simulation_tpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    seen = []
+    clock = FakeClock()
+    eng = SLOEngine(
+        [_latency_spec()],
+        clock=clock,
+        registry=reg,
+        on_transition=seen.append,
+    )
+    for _ in range(10):
+        eng.observe("m", 5.0)
+    assert reg.snapshot()["gauges"]["slo_fast_burn_active"] == 1
+    assert reg.snapshot()["counters"]["slo_alerts_total"] >= 1
+    clock.advance(2_000.0)
+    eng.evaluate()
+    assert reg.snapshot()["gauges"]["slo_fast_burn_active"] == 0
+    assert [r["to"] for r in seen][-1] == "ok"
+    assert all({"slo", "from", "to", "burn_rate"} <= set(r) for r in seen)
+
+
+def test_process_engine_fed_by_supervisor_and_defaults_are_calm():
+    from yuma_simulation_tpu.resilience import SweepSupervisor
+    from yuma_simulation_tpu.scenarios import get_cases
+
+    previous = set_slo_engine(None)
+    try:
+        assert peek_slo_engine() is None
+        SweepSupervisor(directory=None, unit_size=2).run_batch(
+            get_cases()[:4], VERSION
+        )
+        eng = peek_slo_engine()
+        assert eng is not None, "supervisor must create the process engine"
+        assert eng.sketch("unit_seconds").count >= 2
+        # CPU-scale units never trip the deliberately generous defaults.
+        assert eng.fast_burning() == ()
+        assert {s.name for s in DEFAULT_SLO_SPECS} == {
+            "serve_latency",
+            "serve_errors",
+            "serve_shed",
+            "unit_duration",
+            "cold_start",
+        }
+        observe_duration("unit_seconds", 0.01)  # the no-plumbing helper
+        assert get_slo_engine() is eng
+    finally:
+        set_slo_engine(previous)
+
+
+# -------------------------------------------------------- classification
+
+
+def test_slo_shed_is_typed_and_immune_to_markers():
+    exc = SloShed(
+        "SLO fast burn (serve_latency): shedding priority<1 work "
+        "deadline exceeded heartbeat timeout",  # hostile phrasing
+        retry_after=5.0,
+        slos=("serve_latency",),
+    )
+    assert isinstance(exc, QueueOverflow)
+    assert exc.retryable and exc.retry_after == 5.0
+    assert exc.slos == ("serve_latency",)
+    # Typed non-engine failures never reclassify on message markers.
+    assert classify_failure(exc) is None
+
+
+# ------------------------------------------------------ the serve drill
+
+
+def _drill_specs() -> tuple:
+    return (
+        SLOSpec(
+            "serve_latency",
+            objective=0.9,
+            sketch="serve_request_seconds",
+            threshold_seconds=0.0,  # synthetic: EVERY request is "slow"
+            fast_window_seconds=60.0,
+            fast_burn_threshold=5.0,
+            slow_window_seconds=600.0,
+            slow_burn_threshold=3.0,
+            min_events=3,
+        ),
+    )
+
+
+def test_service_close_releases_process_slo_hooks():
+    """A service with operator specs installs itself as the process
+    engine and claims the transition hook; close() must release BOTH,
+    so a successor service in the same process gets the hook and the
+    supervisor/sentinel `observe_duration` feeds fall back to whatever
+    engine preceded the closed service."""
+    from yuma_simulation_tpu.serve import ServeConfig, SimulationService
+
+    previous = set_slo_engine(None)
+    try:
+        svc = SimulationService(
+            ServeConfig(
+                coalesce_window_seconds=0.0,
+                slo_specs=_drill_specs(),
+                start_dispatcher=False,
+            )
+        )
+        assert peek_slo_engine() is svc.slo
+        assert svc.slo.on_transition is not None
+        svc.close()
+        assert peek_slo_engine() is None, "process engine not restored"
+        assert svc.slo.on_transition is None, "transition hook leaked"
+        # A successor can now claim the hook on a shared engine.
+        svc2 = SimulationService(
+            ServeConfig(coalesce_window_seconds=0.0, start_dispatcher=False)
+        )
+        try:
+            assert svc2.slo.on_transition is not None
+        finally:
+            svc2.close()
+    finally:
+        set_slo_engine(previous)
+
+
+def test_slo_degradation_drill_shed_before_overflow(tmp_path):
+    """The acceptance drill: burst -> fast burn -> /healthz degraded ->
+    low-priority requests shed typed SloShed BEFORE QueueOverflow ->
+    priority traffic still rides -> sloreport --check fails on the
+    captured bundle -> recovery un-flips everything."""
+    from tools.sloreport import check_slo, load_slo, main as slo_main
+    from yuma_simulation_tpu.serve import ServeConfig, SimulationService
+    from yuma_simulation_tpu.telemetry.metrics import get_registry
+
+    clock = FakeClock()
+    engine = SLOEngine(_drill_specs(), clock=clock)
+    bundle_dir = tmp_path / "slo-bundle"
+    svc = SimulationService(
+        ServeConfig(
+            coalesce_window_seconds=0.0,
+            bundle_dir=str(bundle_dir),
+            queue_limit=64,
+            tenant_rate=10_000.0,
+            tenant_burst=1_000,
+        ),
+        slo_engine=engine,
+    )
+    try:
+        # The burst: every request scores "bad" against the synthetic
+        # threshold; at min_events=3 the third observation arms the
+        # window with burn = (1.0 bad fraction) / 0.1 = 10 >= 5.
+        for _ in range(3):
+            status, body, _h = svc.handle(
+                "simulate", {"tenant": "burst", "case": "Case 1"}
+            )
+            assert status == 200, body
+        health = svc.healthz()
+        assert health["status"] == "degraded"
+        assert health["ready"] is False
+        assert health["slo"]["fast_burn"] == ["serve_latency"]
+        assert health["slo"]["degraded"] == ["serve_latency"]
+
+        # Low-priority work sheds typed — BEFORE any queue pressure.
+        shed = get_registry().snapshot()["counters"]["serve_requests_shed"]
+        status, body, headers = svc.handle(
+            "simulate", {"tenant": "victim", "case": "Case 1"}
+        )
+        assert status == 429, body
+        assert body["error"] == "SloShed"
+        assert body["slo"] == ["serve_latency"]
+        assert "Retry-After" in headers
+        assert len(svc.queue) == 0  # shed pre-queue, not queued-then-dropped
+        assert (
+            get_registry().snapshot()["counters"]["serve_requests_shed"]
+            == shed + 1
+        )
+
+        # Priority traffic still rides through the same pipeline.
+        status, body, _h = svc.handle(
+            "simulate",
+            {"tenant": "vip", "case": "Case 1", "priority": 2},
+        )
+        assert status == 200, body
+    finally:
+        svc.close()
+
+    # The captured bundle records the ACTIVE fast burn: the gate fails.
+    snap = load_slo(bundle_dir)
+    assert snap is not None
+    problems = check_slo(snap)
+    assert problems and "FAST-BURNING" in problems[0]
+    assert slo_main([str(bundle_dir), "--check"]) == 2
+    # Typed ledger events landed, resolvable in the bundle.
+    from yuma_simulation_tpu.telemetry.flight import (
+        check_bundle,
+        load_bundle,
+    )
+
+    bundle = load_bundle(bundle_dir)
+    assert check_bundle(bundle) == []
+    events = [r.get("event") for r in bundle.ledger]
+    assert "slo_alert" in events
+    shed_recs = [r for r in bundle.ledger if r.get("event") == "request_shed"]
+    assert any(r.get("slos") == ["serve_latency"] for r in shed_recs)
+
+    # Recovery: the window drains on the fake clock and un-flips.
+    clock.advance(3_600.0)
+    assert engine.evaluate()["serve_latency"]["state"] == "ok"
+    assert engine.degraded() == ()
+    assert [a["to"] for a in engine.alerts()][-1] == "ok"
+
+
+def test_sloreport_passes_on_healthy_bundle(tmp_path, capsys):
+    from tools.sloreport import main as slo_main
+    from yuma_simulation_tpu.serve import ServeConfig, SimulationService
+
+    clock = FakeClock()
+    engine = SLOEngine(
+        (
+            SLOSpec(
+                "serve_latency",
+                objective=0.9,
+                sketch="serve_request_seconds",
+                threshold_seconds=300.0,  # generous: everything good
+                fast_window_seconds=60.0,
+                min_events=1,
+            ),
+        ),
+        clock=clock,
+    )
+    bundle_dir = tmp_path / "healthy-bundle"
+    svc = SimulationService(
+        ServeConfig(
+            coalesce_window_seconds=0.0, bundle_dir=str(bundle_dir)
+        ),
+        slo_engine=engine,
+    )
+    try:
+        status, _b, _h = svc.handle(
+            "simulate", {"tenant": "calm", "case": "Case 1"}
+        )
+        assert status == 200
+    finally:
+        svc.close()
+    assert slo_main([str(bundle_dir), "--check", "--require"]) == 0
+    out = capsys.readouterr().out
+    assert "serve_latency" in out and "none fast-burning" in out
+    # --require fails when nothing recorded anything.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert slo_main([str(empty), "--check", "--require"]) == 2
+    assert slo_main([str(empty), "--check"]) == 0
+
+
+def test_flight_recorder_publishes_slo_json_for_process_engine(tmp_path):
+    from yuma_simulation_tpu.resilience import SweepSupervisor
+    from yuma_simulation_tpu.scenarios import get_cases
+    from yuma_simulation_tpu.telemetry.flight import load_bundle
+
+    previous = set_slo_engine(None)
+    try:
+        out = SweepSupervisor(
+            directory=str(tmp_path / "sweep"), unit_size=2
+        ).run_batch(get_cases()[:4], VERSION)
+        assert out["report"].units_total == 2
+        bundle = load_bundle(tmp_path / "sweep")
+        assert bundle.slo is not None
+        states = bundle.slo["states"]
+        assert states["unit_duration"]["state"] == "ok"
+        assert bundle.slo["sketches"]["unit_seconds"]["count"] >= 2
+    finally:
+        set_slo_engine(previous)

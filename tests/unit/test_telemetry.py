@@ -240,6 +240,72 @@ def test_snapshot_and_prometheus_text():
     assert text.endswith("\n")
 
 
+def test_prometheus_exposition_conformance():
+    """Parse prometheus_text() output and assert the exposition
+    contract: for every histogram, bucket lines appear in ascending
+    `le` order ending with a cumulative +Inf bucket, counts are
+    monotone non-decreasing, the +Inf bucket equals _count, and _sum/
+    _count lines exist; HELP text is escaped (no raw newlines); every
+    series name matches the metric-name grammar."""
+    import re
+
+    reg = MetricsRegistry()
+    reg.counter("good_total", help="with\nnewline and back\\slash").inc(2)
+    reg.gauge("depth").set(3.5)
+    h = reg.histogram("lat_seconds", help="latency", buckets=(0.1, 1.0, 5.0))
+    for v in (0.05, 0.5, 0.5, 3.0, 100.0):
+        h.observe(v)
+    # Non-finite caller bounds are dropped; +Inf still comes from the
+    # total, never from a caller bound.
+    h2 = reg.histogram(
+        "weird_seconds", buckets=(float("inf"), 2.0, float("nan"), 2.0)
+    )
+    h2.observe(10.0)
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    assert text.endswith("\n")
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    bucket_re = re.compile(r'^(\w+)_bucket\{le="([^"]+)"\} (\d+)$')
+    for line in lines:
+        assert "\n" not in line
+        if line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            assert name_re.match(line.split()[2])
+    # HELP escaping: the newline survives as literal backslash-n.
+    assert "# HELP good_total with\\nnewline and back\\\\slash" in text
+
+    histograms = {}
+    for line in lines:
+        m = bucket_re.match(line)
+        if m:
+            histograms.setdefault(m.group(1), []).append(
+                (m.group(2), int(m.group(3)))
+            )
+    assert set(histograms) == {"lat_seconds", "weird_seconds"}
+    for name, buckets in histograms.items():
+        les = [le for le, _ in buckets]
+        counts = [c for _, c in buckets]
+        assert les[-1] == "+Inf", buckets
+        finite = [float(le) for le in les[:-1]]
+        assert finite == sorted(finite)
+        assert all(a <= b for a, b in zip(counts, counts[1:])), buckets
+        count_line = next(
+            ln for ln in lines if ln.startswith(f"{name}_count ")
+        )
+        assert int(count_line.split()[1]) == counts[-1]
+        assert any(ln.startswith(f"{name}_sum ") for ln in lines)
+    # The +Inf bucket counts the overflow observation (100.0 / 10.0).
+    assert dict(histograms["lat_seconds"])["+Inf"] == 5
+    assert dict(histograms["weird_seconds"]) == {"2.0": 0, "+Inf": 1}
+
+
+def test_histogram_rejects_all_nonfinite_bounds():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.histogram("bad_seconds", buckets=(float("inf"),))
+
+
 def test_publish_snapshot_jsonl_accumulates_and_tolerates_torn_tail(tmp_path):
     reg = MetricsRegistry()
     reg.counter("epochs_total").inc(5)
@@ -627,6 +693,41 @@ def test_obsreport_empty_directory_reports_gracefully(tmp_path, capsys):
 
     assert obsreport_main([str(tmp_path)]) == 0
     assert "no runs recorded" in capsys.readouterr().out
+
+
+def test_flight_append_spans_then_record_merges(tmp_path):
+    """`FlightRecorder.append_spans` (the serving tier's O(batch)
+    ingress flush) appends without a whole-file merge; a later full
+    `record` with the same runs as `extra_runs` merges by identity —
+    appended spans are replaced, not duplicated — and the final bundle
+    is sound."""
+    from yuma_simulation_tpu.telemetry.flight import FlightRecorder
+
+    rec = FlightRecorder(tmp_path)
+    with RunContext("ingress-a") as ra:
+        with span("request:r1"):
+            pass
+    with RunContext("ingress-b") as rb:
+        with span("request:r2"):
+            pass
+    rec.append_spans([ra])
+    rec.append_spans([rb])
+    appended = load_bundle(tmp_path).spans
+    assert {s["run_id"] for s in appended} == {ra.run_id, rb.run_id}
+
+    with RunContext("server") as main:
+        with span("lifetime"):
+            pass
+    rec.record(main, extra_runs=[ra, rb])
+    bundle = load_bundle(tmp_path)
+    assert check_bundle(bundle) == []
+    keys = [(s["run_id"], s["span_id"]) for s in bundle.spans]
+    assert len(keys) == len(set(keys)), "append + record must not duplicate"
+    assert {s["run_id"] for s in bundle.spans} == {
+        ra.run_id,
+        rb.run_id,
+        main.run_id,
+    }
 
 
 def test_check_bundle_flags_unresolvable_span(tmp_path):

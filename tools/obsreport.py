@@ -202,16 +202,49 @@ def render_plans(bundle, run_id: str) -> list[str]:
     return lines
 
 
+#: Critical-path phase spans the serving tier synthesizes per request.
+_PHASE_NAMES = ("queue", "coalesce", "compile", "execute")
+
+
+def _phase_index(bundle) -> dict:
+    """``(run_id, parent_id) -> [phase span]`` in one pass over the
+    bundle, so per-request breakdowns are a dict hit instead of a full
+    span rescan per request row."""
+    index: dict[tuple, list] = {}
+    for s in bundle.spans:
+        if s.get("name") in _PHASE_NAMES:
+            index.setdefault(
+                (s.get("run_id"), s.get("parent_id")), []
+            ).append(s)
+    return index
+
+
+def _phase_breakdown(phases: dict, request_span) -> str:
+    """The request's critical-path children (queue/coalesce/compile/
+    execute) inline, e.g. ``(queue 0.2ms | execute 81.0ms)``."""
+    rid, sid = request_span.get("run_id"), request_span.get("span_id")
+    parts = []
+    for s in phases.get((rid, sid), ()):
+        name = s.get("name")
+        t0, t1 = s.get("t_start"), s.get("t_end")
+        if t0 is None or t1 is None:
+            continue
+        parts.append((_PHASE_NAMES.index(name), f"{name} {1000 * (t1 - t0):.1f}ms"))
+    if not parts:
+        return ""
+    return " (" + " | ".join(p for _, p in sorted(parts)) + ")"
+
+
 def render_serve(bundle, run_id: str) -> list[str]:
-    """The per-tenant request timeline of a SERVING run: one section
+    """The per-tenant request timeline of a SERVING bundle: one section
     per tenant, one line per ``request:*`` span — arrival time,
-    endpoint, outcome, HTTP status, wall duration — so a server's
-    flight bundle answers "what did each tenant see" without grepping
-    the ledger. Renders only when the bundle carries serve spans."""
+    endpoint, outcome, HTTP status, wall duration, critical-path
+    breakdown — so a server's flight bundle answers "what did each
+    tenant see" without grepping the ledger. Scans EVERY run in the
+    bundle: a request joining a caller's distributed trace records its
+    span under the CALLER's run_id, not the server run's."""
     requests = []
     for s in bundle.spans:
-        if s.get("run_id") != run_id:
-            continue
         if not str(s.get("name", "")).startswith("request:"):
             continue
         requests.append(s)
@@ -222,6 +255,7 @@ def render_serve(bundle, run_id: str) -> list[str]:
         attrs = s.get("attrs") or {}
         by_tenant.setdefault(str(attrs.get("tenant", "?")), []).append(s)
     lines = [f"serve requests ({len(requests)} across {len(by_tenant)} tenant(s)):"]
+    phases = _phase_index(bundle)
     for tenant in sorted(by_tenant):
         spans = sorted(
             by_tenant[tenant], key=lambda s: float(s.get("t_start") or 0.0)
@@ -240,10 +274,13 @@ def render_serve(bundle, run_id: str) -> list[str]:
             t0, t1 = s.get("t_start"), s.get("t_end")
             dur = f"{t1 - t0:.3f}s" if t0 and t1 else "?"
             lines.append(
-                f"    {_fmt_ts(t0)}  {s.get('name')} "
-                f"{attrs.get('endpoint', '?')} "
-                f"-> {attrs.get('status', '?')} "
-                f"{attrs.get('outcome', '')} {dur}".rstrip()
+                (
+                    f"    {_fmt_ts(t0)}  {s.get('name')} "
+                    f"{attrs.get('endpoint', '?')} "
+                    f"-> {attrs.get('status', '?')} "
+                    f"{attrs.get('outcome', '')} {dur}"
+                ).rstrip()
+                + _phase_breakdown(phases, s)
             )
     return lines
 
@@ -425,13 +462,85 @@ def run_drill(directory: str) -> None:
     )
 
 
+def render_fleet_units(store, merged: list) -> list[str]:
+    """The per-unit roster with the host that EXECUTED each unit
+    inline (its accepted ``unit_ok`` record — previously the reader
+    had to cross-reference lease tombstones by hand), plus lanes,
+    steal generation, engine, and recovery counts."""
+    last_ok: dict[int, dict] = {}
+    for rec in merged:
+        if rec.get("event") == "unit_ok" and "unit" in rec:
+            last_ok[rec["unit"]] = rec
+    try:
+        num_units = store.manifest()["num_units"]
+    except Exception:
+        num_units = max(last_ok) + 1 if last_ok else 0
+    if not num_units:
+        return []
+    lines = ["units (executing host inline):"]
+    for unit in range(num_units):
+        rec = last_ok.get(unit)
+        if rec is None:
+            lines.append(f"  unit {unit}: UNPUBLISHED")
+            continue
+        lanes = rec.get("lanes") or ["?", "?"]
+        extras = []
+        if rec.get("generation"):
+            extras.append(f"gen={rec['generation']}")
+        for key in ("stalls", "demotions", "mesh_shrinks"):
+            if rec.get(key):
+                extras.append(f"{key}={rec[key]}")
+        if rec.get("quarantined"):
+            extras.append(f"quarantined={len(rec['quarantined'])}")
+        lines.append(
+            f"  unit {unit} lanes=[{lanes[0]},{lanes[1]}) "
+            f"host={rec.get('host', '?')} "
+            f"engine={rec.get('engine', '?')}"
+            + ("  " + " ".join(extras) if extras else "")
+        )
+    return lines
+
+
+def render_stitched(store, bundles: dict) -> list[str]:
+    """The ONE cross-process timeline: when several host bundles share
+    a run (the propagated sweep-level trace), render their span UNION
+    as a single tree — driver root down through every host's claims,
+    units, attempts and engine rungs."""
+    from yuma_simulation_tpu.telemetry.flight import merge_bundles
+
+    hosts_by_run: dict[str, list] = {}
+    for host_id, b in bundles.items():
+        for rid in {s.get("run_id") for s in b.spans}:
+            if rid:
+                hosts_by_run.setdefault(rid, []).append(host_id)
+    shared = {
+        rid: hosts
+        for rid, hosts in hosts_by_run.items()
+        if len(hosts) >= 2
+    }
+    if not shared:
+        return []
+    union = merge_bundles(bundles.values(), directory=store.directory)
+    lines = []
+    for rid in sorted(shared):
+        lines.append(
+            f"--- stitched trace {rid} "
+            f"(hosts: {', '.join(sorted(shared[rid]))}) ---"
+        )
+        lines.append(render_run(union, rid))
+    return lines
+
+
 def render_fleet(directory: str) -> str:
     """The fleet-store report: manifest + merged FleetHealthReport +
+    the stitched cross-process trace (hosts sharing one propagated
+    run render as ONE tree) + the per-unit executing-host roster +
     one per-host timeline section (each host's bundle through the
     existing single-run renderer)."""
     from yuma_simulation_tpu.fabric.health import (
         build_fleet_report,
         load_fleet_report,
+        merged_ledger,
     )
     from yuma_simulation_tpu.fabric.store import FleetStore
     from yuma_simulation_tpu.telemetry.flight import load_bundle
@@ -462,6 +571,8 @@ def render_fleet(directory: str) -> str:
         f"finished={list(report.hosts_finished)} "
         f"lost={list(report.hosts_lost)}",
     ]
+    if manifest.get("trace"):
+        lines.append(f"trace: {manifest['trace'].get('traceparent')}")
     if published is None:
         lines.append("fleet_report.json: not finalized (derived above)")
     for deg in report.degradations:
@@ -469,32 +580,57 @@ def render_fleet(directory: str) -> str:
             f"  host roster {deg.from_devices}->{deg.to_devices} "
             f"(lost {', '.join(deg.lost_device_ids)}: {deg.reason})"
         )
+    units = render_fleet_units(store, merged_ledger(store))
+    if units:
+        lines.append("")
+        lines.extend(units)
+    bundles = {
+        host_id: load_bundle(store.host_dir(host_id))
+        for host_id in store.host_ids()
+    }
+    stitched = render_stitched(store, bundles)
+    if stitched:
+        lines.append("")
+        lines.extend(stitched)
     for host_id in store.host_ids():
         lines.append("")
         lines.append(f"--- host {host_id} ---")
-        lines.append(render(load_bundle(store.host_dir(host_id)), None))
+        lines.append(render(bundles[host_id], None))
     return "\n".join(lines)
 
 
 def check_fleet_store(directory: str) -> list[str]:
-    """The fleet ``--check`` gate: the fleet-level consistency check
-    plus the per-host bundle check for every FINISHED host (a SIGKILLed
+    """The fleet ``--check`` gate: the fleet-level consistency check,
+    the per-host bundle check for every FINISHED host (a SIGKILLed
     host never ran its bundle-publish finally — its ledger is the
     surviving record; demanding spans of the dead would be a false
-    positive)."""
+    positive), and the STITCHED orphan-span gate — every span flagged
+    as continuing a remote parent must resolve in some sibling host
+    bundle; a bundle tampered to orphan a span fails here."""
     from yuma_simulation_tpu.fabric.health import (
         build_fleet_report,
         check_fleet,
     )
     from yuma_simulation_tpu.fabric.store import FleetStore
-    from yuma_simulation_tpu.telemetry.flight import check_bundle, load_bundle
+    from yuma_simulation_tpu.telemetry.flight import (
+        check_bundle,
+        check_stitched,
+        load_bundle,
+    )
 
     problems = list(check_fleet(directory))
     store = FleetStore(directory)
     report = build_fleet_report(store)
+    bundles = {
+        host_id: load_bundle(store.host_dir(host_id))
+        for host_id in store.host_ids()
+    }
     for host_id in report.hosts_finished:
-        bundle = load_bundle(store.host_dir(host_id))
+        bundle = bundles.get(host_id) or load_bundle(
+            store.host_dir(host_id)
+        )
         problems.extend(f"host {host_id}: {p}" for p in check_bundle(bundle))
+    problems.extend(check_stitched(bundles.values()))
     return problems
 
 

@@ -1,0 +1,217 @@
+"""sloreport: render and gate a flight bundle's SLO state.
+
+The judgment half of the observability CLI pair (``tools/obsreport.py``
+renders what happened; this renders whether it was ACCEPTABLE). Reads
+the ``slo.json`` a flight-recorder publish leaves beside the spans —
+declarative :class:`yuma_simulation_tpu.telemetry.slo.SLOSpec`
+objectives, per-SLO burn state, mergeable latency sketches with their
+headline quantiles, and the alert history — and renders one report per
+bundle. Fleet stores are detected automatically: every host bundle
+under ``hosts/`` reports (a SIGKILLed host that never published is
+skipped, not failed — its ledger is its record).
+
+Usage::
+
+    python -m tools.sloreport BUNDLE_DIR            # render the state
+    python -m tools.sloreport BUNDLE_DIR --check    # CI gate: exit 2 if
+                                                    # any SLO was
+                                                    # fast-burning at
+                                                    # capture, or the
+                                                    # state is malformed
+    python -m tools.sloreport BUNDLE_DIR --json     # machine-readable
+
+``--check`` semantics: the bundle is the service's last word — a bundle
+captured while an SLO fast-burns its error budget records an outage the
+deploy pipeline must not wave through, so the gate exits non-zero;
+recovery before capture un-flips the state and the gate passes. A
+bundle with no ``slo.json`` passes with a note (old bundles stay
+valid — the format is additive) unless ``--require`` demands one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+_VALID_STATES = ("ok", "slow_burn", "fast_burn")
+
+
+def load_slo(directory: str | pathlib.Path) -> dict | None:
+    """The bundle's ``slo.json``, or None when absent/undecodable."""
+    path = pathlib.Path(directory) / "slo.json"
+    if not path.exists():
+        return None
+    try:
+        return json.loads(path.read_text())
+    except (json.JSONDecodeError, OSError):
+        return None
+
+
+def check_slo(snapshot: dict | None) -> list[str]:
+    """Gate problems for one bundle's SLO state (empty = pass):
+    structural rot (unknown states, specs/states mismatch) and any SLO
+    captured in ``fast_burn`` — the state the serving tier sheds under,
+    which a green pipeline must never carry forward silently."""
+    if snapshot is None:
+        return []
+    problems: list[str] = []
+    states = snapshot.get("states")
+    if not isinstance(states, dict):
+        return ["slo.json carries no states mapping"]
+    spec_names = {
+        s.get("name") for s in snapshot.get("specs", ()) if isinstance(s, dict)
+    }
+    for name, st in sorted(states.items()):
+        state = st.get("state") if isinstance(st, dict) else st
+        if state not in _VALID_STATES:
+            problems.append(f"SLO {name}: unknown state {state!r}")
+            continue
+        if state == "fast_burn":
+            burn = (
+                st.get("fast_burn_rate") if isinstance(st, dict) else None
+            )
+            problems.append(
+                f"SLO {name} was FAST-BURNING at capture"
+                + (f" (burn rate {burn})" if burn is not None else "")
+            )
+        if spec_names and name not in spec_names:
+            problems.append(f"SLO {name} has state but no spec")
+    return problems
+
+
+def render_slo(directory: str, snapshot: dict | None) -> str:
+    lines = [f"SLO report: {directory}"]
+    if snapshot is None:
+        lines.append(
+            "no slo.json recorded (pre-0.13.0 bundle, or the process "
+            "observed no SLO signals)"
+        )
+        return "\n".join(lines)
+    states = snapshot.get("states", {})
+    specs = {
+        s.get("name"): s
+        for s in snapshot.get("specs", ())
+        if isinstance(s, dict)
+    }
+    for name, st in sorted(states.items()):
+        spec = specs.get(name, {})
+        state = st.get("state", "?") if isinstance(st, dict) else st
+        flag = {"ok": " ", "slow_burn": "~", "fast_burn": "!"}.get(state, "?")
+        parts = [
+            f"  [{flag}] {name}: {state}",
+            f"objective={st.get('objective', spec.get('objective', '?'))}",
+            f"fast_burn={st.get('fast_burn_rate', '?')}"
+            f"/{spec.get('fast_burn_threshold', '?')}",
+            f"slow_burn={st.get('slow_burn_rate', '?')}"
+            f"/{spec.get('slow_burn_threshold', '?')}",
+        ]
+        fw = st.get("fast_window") if isinstance(st, dict) else None
+        if isinstance(fw, dict):
+            parts.append(f"window={fw.get('good', 0)}g/{fw.get('bad', 0)}b")
+        if spec.get("description"):
+            parts.append(f"({spec['description']})")
+        lines.append(" ".join(parts))
+    sketches = snapshot.get("sketches", {})
+    if sketches:
+        lines.append("sketches:")
+        for metric, rec in sorted(sketches.items()):
+            q = rec.get("quantiles", {})
+
+            def fmt(key: str) -> str:
+                v = q.get(key)
+                return "?" if v is None else f"{v:.4g}s"
+
+            lines.append(
+                f"  {metric}: n={rec.get('count', 0)} "
+                f"p50={fmt('0.5')} p90={fmt('0.9')} p99={fmt('0.99')} "
+                f"max={rec.get('max')}"
+            )
+    alerts = snapshot.get("alerts", ())
+    if alerts:
+        lines.append(f"alerts ({len(alerts)}):")
+        for a in alerts[-10:]:
+            lines.append(
+                f"  {a.get('slo')}: {a.get('from')} -> {a.get('to')} "
+                f"(burn {a.get('burn_rate')})"
+            )
+    return "\n".join(lines)
+
+
+def _targets(directory: str) -> list[tuple[str, pathlib.Path]]:
+    """The bundle directories to report: the fleet store's per-host
+    bundles, or the directory itself."""
+    from yuma_simulation_tpu.fabric.store import FleetStore, is_fleet_store
+
+    if is_fleet_store(directory):
+        store = FleetStore(directory)
+        return [
+            (f"host {host_id}", store.host_dir(host_id))
+            for host_id in store.host_ids()
+        ]
+    return [("bundle", pathlib.Path(directory))]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="sloreport", description=__doc__.split("\n\n")[0]
+    )
+    parser.add_argument("directory", help="flight bundle or fleet store")
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 2 when any SLO was fast-burning at capture or the "
+        "recorded state is malformed",
+    )
+    parser.add_argument(
+        "--require",
+        action="store_true",
+        help="with --check: a missing slo.json is itself a failure",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the state as JSON"
+    )
+    args = parser.parse_args(argv)
+
+    targets = _targets(args.directory)
+    snapshots = {label: load_slo(path) for label, path in targets}
+    if args.json:
+        print(
+            json.dumps(
+                {label: snap for label, snap in snapshots.items()},
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        first = True
+        for label, path in targets:
+            if not first:
+                print()
+            first = False
+            print(render_slo(f"{label} ({path})", snapshots[label]))
+    if args.check:
+        problems: list[str] = []
+        recorded = 0
+        for label, _path in targets:
+            snap = snapshots[label]
+            if snap is not None:
+                recorded += 1
+            problems.extend(f"{label}: {p}" for p in check_slo(snap))
+        if args.require and recorded == 0:
+            problems.append("no slo.json found in any target bundle")
+        if problems:
+            print("\nsloreport --check FAILED:", file=sys.stderr)
+            for p in problems:
+                print(f"  - {p}", file=sys.stderr)
+            return 2
+        print(
+            f"\nsloreport --check: {recorded}/{len(targets)} bundle(s) "
+            "recorded SLO state; none fast-burning"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -207,14 +207,26 @@ class LeaseStore:
                 torn=info.torn,
                 by=self.host_id,
             )
-        payload = json.dumps(
-            {
-                "unit": unit,
-                "host": self.host_id,
-                "claimed_at": round(time.time(), 6),
-            },
-            sort_keys=True,
-        ).encode()
+        record = {
+            "unit": unit,
+            "host": self.host_id,
+            "claimed_at": round(time.time(), 6),
+        }
+        # The claim carries the claimer's trace identity (additive —
+        # old readers only look at "host"): a lease on disk names not
+        # just WHO holds the unit but which distributed trace the work
+        # lands in, so a stuck claim is greppable back to its sweep.
+        try:
+            from yuma_simulation_tpu.telemetry.propagation import (
+                current_trace_context,
+            )
+
+            ctx = current_trace_context()
+            if ctx is not None:
+                record["trace"] = ctx.to_traceparent()
+        except Exception:
+            pass  # propagation must never break claiming
+        payload = json.dumps(record, sort_keys=True).encode()
         tmp = self.directory / (
             f".claim.{self.host_id}.{uuid.uuid4().hex[:8]}.tmp"
         )

@@ -173,22 +173,40 @@ class FleetHost:
         from yuma_simulation_tpu.resilience.supervisor import FailureLedger
         from yuma_simulation_tpu.telemetry import (
             FlightRecorder,
-            ensure_run,
             get_registry,
             span,
         )
+        from yuma_simulation_tpu.telemetry.propagation import (
+            TraceContext,
+            continue_trace,
+            current_trace_context,
+            span_prefix_for,
+        )
 
-        self.store.ensure_manifest(
+        # Sweep-level trace continuity: the ambient context (an active
+        # driver run, or the env a drill driver handed this subprocess)
+        # is stamped into the write-once manifest; joiners with no
+        # ambient trace inherit the manifest's, so every host of one
+        # fleet sweep continues ONE trace instead of minting orphans.
+        ctx = current_trace_context()
+        if ctx is None:
+            ctx = TraceContext.from_env()
+        manifest = self.store.ensure_manifest(
             num_units=num_units,
             unit_lanes=unit_lanes,
             tag=tag,
             config=config_fingerprint,
+            trace=ctx.to_manifest() if ctx is not None else None,
         )
+        if ctx is None:
+            ctx = TraceContext.from_manifest(manifest)
         ledger = FailureLedger(self.host_dir / "ledger.jsonl")
         registry = get_registry()
         published = stolen = abandoned = duplicates = 0
         cfg = self.config
-        with ensure_run() as run:
+        with continue_trace(
+            ctx, prefix=span_prefix_for(cfg.host_id)
+        ) as run:
             try:
                 with span(
                     f"host:{cfg.host_id}", units=num_units, fleet=tag

@@ -46,6 +46,12 @@ DRILL_NUM_CASES = 10
 DRILL_UNIT_SIZE = 2
 DRILL_TTL = 3.0
 
+#: The drill DRIVER's bundle directory name under ``hosts/``: the root
+#: of the stitched cross-process trace (every simulated host's spans
+#: chain up to the driver's ``fleet_drill`` span through the env-
+#: propagated trace context).
+DRIVER_HOST_ID = "driver"
+
 
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -200,13 +206,21 @@ def main(argv=None) -> int:
 # -------------------------------------------------------------- the drill
 
 
-def _spawn_host(store: str, host_args: list[str], out_dir: pathlib.Path):
+def _spawn_host(
+    store: str,
+    host_args: list[str],
+    out_dir: pathlib.Path,
+    extra_env: dict | None = None,
+):
     """One simulated host subprocess with file-backed stdio (a crashing
-    host's traceback must not deadlock a pipe)."""
+    host's traceback must not deadlock a pipe). `extra_env` carries the
+    driver's trace context (``YUMA_TRACEPARENT``) so the host's run
+    continues the drill-level trace."""
     repo = pathlib.Path(__file__).resolve().parents[2]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # 1 virtual device: simhosts are unsharded
+    env.update(extra_env or {})
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in [str(repo), env.get("PYTHONPATH", "")] if p
     )
@@ -243,7 +257,18 @@ def run_drill(
         quarantine_entries,
     )
     from yuma_simulation_tpu.fabric.store import FleetStore
-    from yuma_simulation_tpu.telemetry.flight import check_bundle, load_bundle
+    from yuma_simulation_tpu.telemetry.flight import (
+        FlightRecorder,
+        check_bundle,
+        check_stitched,
+        load_bundle,
+    )
+    from yuma_simulation_tpu.telemetry.propagation import (
+        BAGGAGE_ENV,
+        TRACEPARENT_ENV,
+        current_trace_context,
+    )
+    from yuma_simulation_tpu.telemetry.runctx import RunContext, span
 
     target = pathlib.Path(directory)
     if target.exists() and any(target.iterdir()):
@@ -298,29 +323,52 @@ def run_drill(
             "--host-id", "oracle-host",
         ]),
     }
+    # The drill is ONE distributed trace: the driver opens the root run
+    # + span, hands its context to the faulted hosts through the env
+    # (the oracle runs a SEPARATE sweep into its own store and gets a
+    # scrubbed env so its self-contained bundle stays self-resolving),
+    # and publishes its own bundle under hosts/driver so every host
+    # span's parent chain roots at the driver's run on disk.
+    driver_run = RunContext()
     procs = {}
     files = []
-    for host_id, (host_store, host_args) in hosts.items():
-        proc, out, err = _spawn_host(host_store, host_args, logs)
-        procs[host_id] = proc
-        files.extend([out, err])
     results = {}
-    try:
-        deadline_t = time.monotonic() + timeout
-        for host_id, proc in procs.items():
-            remaining = max(1.0, deadline_t - time.monotonic())
-            rc = proc.wait(timeout=remaining)
-            results[host_id] = rc
-    except subprocess.TimeoutExpired:
-        for proc in procs.values():
-            proc.kill()
-        raise
-    finally:
-        streams = {}
-        for f in files:
-            f.seek(0)
-            streams[pathlib.Path(f.name).name] = f.read()
-            f.close()
+    with driver_run:
+        with span("fleet_drill", hosts=list(hosts)):
+            ctx = current_trace_context()
+            assert ctx is not None  # the driver run/span is open
+            trace_env = ctx.to_env()
+            scrubbed = {TRACEPARENT_ENV: "", BAGGAGE_ENV: ""}
+            for host_id, (host_store, host_args) in hosts.items():
+                proc, out, err = _spawn_host(
+                    host_store,
+                    host_args,
+                    logs,
+                    extra_env=(
+                        scrubbed if host_id == "oracle-host" else trace_env
+                    ),
+                )
+                procs[host_id] = proc
+                files.extend([out, err])
+            try:
+                deadline_t = time.monotonic() + timeout
+                for host_id, proc in procs.items():
+                    remaining = max(1.0, deadline_t - time.monotonic())
+                    rc = proc.wait(timeout=remaining)
+                    results[host_id] = rc
+            except subprocess.TimeoutExpired:
+                for proc in procs.values():
+                    proc.kill()
+                raise
+            finally:
+                streams = {}
+                for f in files:
+                    f.seek(0)
+                    streams[pathlib.Path(f.name).name] = f.read()
+                    f.close()
+    FlightRecorder(
+        FleetStore(store_dir).host_dir(DRIVER_HOST_ID)
+    ).record(driver_run)
 
     def _log(host_id: str, stream: str) -> str:
         return streams.get(f"{host_id}.{stream}", "")
@@ -413,6 +461,51 @@ def run_drill(
     derived = build_fleet_report(store)
     if derived != report:
         problems.append("re-derived fleet report differs from published")
+
+    # ONE stitched trace: the union of every host bundle (driver
+    # included) must resolve — no orphan spans — and every span in
+    # every FINISHED host's bundle must chain up to a root span of the
+    # DRIVER's run (the env-propagated trace actually took).
+    all_bundles = [
+        load_bundle(store.host_dir(h)) for h in store.host_ids()
+    ]
+    problems.extend(check_stitched(all_bundles))
+    union: dict = {}
+    for b in all_bundles:
+        for s in b.spans:
+            union[s.get("span_id")] = s
+    driver_span_ids = {
+        s.get("span_id")
+        for b in all_bundles
+        if b.directory.name == DRIVER_HOST_ID
+        for s in b.spans
+    }
+    def _chain_root(s: dict):
+        cur = s
+        for _ in range(len(union) + 1):
+            parent = cur.get("parent_id", "")
+            if not parent:
+                return cur
+            cur = union.get(parent)
+            if cur is None:
+                return None  # broken chain (check_stitched flagged it)
+        return None  # cycle (check_stitched flagged it)
+
+    for host_id in report.hosts_finished:
+        for s in load_bundle(store.host_dir(host_id)).spans:
+            if s.get("run_id") != driver_run.run_id:
+                problems.append(
+                    f"host {host_id} span {s.get('span_id')} minted run "
+                    f"{s.get('run_id')} instead of continuing the "
+                    f"driver's {driver_run.run_id}"
+                )
+                continue
+            root = _chain_root(s)
+            if root is not None and root.get("span_id") not in driver_span_ids:
+                problems.append(
+                    f"host {host_id} span {s.get('span_id')} roots at "
+                    f"{root.get('span_id')}, not a driver span"
+                )
 
     if problems:
         raise AssertionError(

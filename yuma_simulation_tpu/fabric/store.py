@@ -39,6 +39,7 @@ import hashlib
 import io
 import json
 import logging
+import os
 import pathlib
 import uuid
 from typing import Optional
@@ -102,13 +103,22 @@ class FleetStore:
         unit_lanes=None,
         tag: str = "",
         config=None,
+        trace: Optional[dict] = None,
     ) -> dict:
         """Write the manifest once, validate it ever after (the
         `CheckpointedSweep` rule: a store directory must never silently
         mix sweeps). Every host of a fleet calls this with identical
         arguments; the first to arrive writes, the rest verify. Two
         hosts racing the first write publish byte-identical content, so
-        the race is harmless."""
+        the race is harmless.
+
+        `trace` (a :meth:`..telemetry.propagation.TraceContext
+        .to_manifest` dict) rides the manifest so every joining host
+        continues the SWEEP-LEVEL trace instead of minting an orphan
+        run. It is deliberately EXCLUDED from the identity check: the
+        trace names who drove the sweep, not what the sweep is — the
+        first writer's trace wins, and hosts arriving with a different
+        (or no) ambient trace still join."""
         path = self.directory / MANIFEST_NAME
         meta = None
         if num_units is not None:
@@ -133,13 +143,14 @@ class FleetStore:
                 raise ValueError(
                     "unit_lanes must carry one [lo, hi] pair per unit"
                 )
-        if path.exists():
-            found = json.loads(path.read_text())
+            if trace:
+                meta["trace"] = dict(trace)
+        def _verify(found: dict) -> dict:
             if meta is not None:
                 mismatched = {
                     k: (found.get(k), v)
                     for k, v in meta.items()
-                    if found.get(k) != v
+                    if k != "trace" and found.get(k) != v
                 }
                 if mismatched:
                     raise ValueError(
@@ -147,12 +158,28 @@ class FleetStore:
                         f"sweep: {mismatched}"
                     )
             return found
+
+        if path.exists():
+            return _verify(json.loads(path.read_text()))
         if meta is None:
             raise FileNotFoundError(
                 f"fleet store {self.directory} has no manifest and none "
                 "was provided (num_units/unit_lanes)"
             )
-        publish_atomic(path, json.dumps(meta, sort_keys=True).encode())
+        # Exactly-one-winner first write (the lease-claim idiom): two
+        # hosts racing here may carry DIFFERENT traces, so last-rename-
+        # wins would let the loser proceed on a trace the manifest does
+        # not record. The hard link makes the first writer's manifest
+        # authoritative; the loser verifies and joins it.
+        staged = path.with_name(f".{MANIFEST_NAME}.{uuid.uuid4().hex}.stage")
+        publish_atomic(staged, json.dumps(meta, sort_keys=True).encode())
+        try:
+            os.link(staged, path)
+        except FileExistsError:
+            return _verify(json.loads(path.read_text()))
+        finally:
+            staged.unlink(missing_ok=True)
+        _fsync_dir(path.parent)
         return meta
 
     def manifest(self) -> dict:

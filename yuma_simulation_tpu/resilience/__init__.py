@@ -39,6 +39,7 @@ from yuma_simulation_tpu.resilience.errors import (  # noqa: F401
     NonFiniteOutputError,
     QueueOverflow,
     ResilienceError,
+    SloShed,
     classify_failure,
 )
 from yuma_simulation_tpu.resilience.faults import (  # noqa: F401

@@ -154,6 +154,27 @@ class QueueOverflow(ResilienceError):
         self.queue_depth = queue_depth
 
 
+class SloShed(QueueOverflow):
+    """The serving tier shed this request because an SLO is
+    FAST-BURNING its error budget (:mod:`..telemetry.slo`) and the
+    request's priority sits below the degradation floor — load is
+    dropped while there is still budget left, BEFORE the queue
+    overflows. Inherits :class:`QueueOverflow`'s whole contract
+    (client-retryable, ``retry_after``, 429 + ``Retry-After``, immune
+    to engine-failure marker matching); carries the burning SLO names
+    so the structured body says WHICH objective forced the shed."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        retry_after: float = 1.0,
+        slos=(),
+    ):
+        super().__init__(message, retry_after=retry_after)
+        self.slos = tuple(slos)
+
+
 class EngineLadderExhausted(EngineFailure):
     """Every rung of the degradation ladder failed. Carries the
     per-demotion records so the caller can see the full walk."""

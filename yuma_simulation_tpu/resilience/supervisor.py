@@ -629,7 +629,10 @@ class SweepSupervisor:
         executions: dict[int, int] = {}
 
         def unit_fn(idx: int) -> dict:
+            from yuma_simulation_tpu.telemetry.slo import observe_duration
+
             lo, hi = units[idx]
+            unit_t0 = time.perf_counter()
             with span(f"unit{idx}", lanes=[lo, hi]):
                 executions[idx] = executions.get(idx, 0) + 1
                 if executions[idx] > 1:
@@ -646,9 +649,17 @@ class SweepSupervisor:
                     try:
                         with span(f"attempt{attempt + 1}"):
                             ys = dispatch_unit(idx, lo, hi, attempt, outcome)
-                            return self._accept_unit(
+                            accepted = self._accept_unit(
                                 idx, lo, hi, ys, outcome, ledger
                             )
+                            # The unit-duration SLO signal: wall time of
+                            # the accepted execution, retries included
+                            # (what the caller actually waited).
+                            observe_duration(
+                                "unit_seconds",
+                                time.perf_counter() - unit_t0,
+                            )
+                            return accepted
                     except BaseException as exc:  # noqa: BLE001 — classified
                         typed = classify_failure(exc)
                         if typed is None:

@@ -66,20 +66,38 @@ def run_smoke(args) -> int:
         expect(wait_until_ready(server.url), "server answers /healthz")
         client = SimulationClient(server.url, tenant="smoke")
 
-        # Happy path: a built-in case through the full pipeline.
+        # Happy path: a built-in case through the full pipeline, with
+        # the 0.13.0 correlation contract — an X-Request-Id echo that
+        # resolves into the flight bundle and a Server-Timing
+        # critical-path breakdown.
         r = client.simulate(case="Case 1")
         expect(
             r.status == 200 and r.body.get("status") == "ok",
             f"happy path simulate -> 200 ok (got {r.status} "
             f"{r.body.get('status')})",
         )
+        expect(
+            r.request_id is not None,
+            f"happy path echoes X-Request-Id (got {r.request_id})",
+        )
+        timing = r.server_timing
+        expect(
+            "execute" in timing and "queue" in timing,
+            f"happy path returns Server-Timing critical path "
+            f"(got {sorted(timing)})",
+        )
 
-        # Structured admission rejection: malformed payload, typed 400.
+        # Structured admission rejection: malformed payload, typed 400 —
+        # STILL carrying the request id (rejections must correlate too).
         r = client.simulate(weights=[[1.0]])  # wrong rank, no stakes
         expect(
             r.status == 400 and r.body.get("error") == "AdmissionRejected",
             f"malformed payload -> 400 AdmissionRejected (got {r.status} "
             f"{r.body.get('error')})",
+        )
+        expect(
+            r.request_id is not None,
+            "rejection echoes X-Request-Id",
         )
 
         # Quota shed: exhaust one tenant's burst back-to-back; the
@@ -124,6 +142,13 @@ def run_smoke(args) -> int:
             "serve_breaker_open",
         ):
             expect(series in metrics, f"/metrics exposes {series}")
+
+        # The SLO surface: /healthz reflects burn state (healthy here).
+        h = client.healthz()
+        expect(
+            h.body.get("ready") is True and "slo" in h.body,
+            f"/healthz reports SLO readiness (got {h.body.get('slo')})",
+        )
     finally:
         server.close()
 

@@ -69,6 +69,10 @@ class AdmissionTicket:
     #: Donor-packing group key: requests sharing it ride one batched
     #: dispatch. None = never coalesced (sweep/table/fused requests).
     coalesce_key: Optional[tuple] = None
+    #: Degradation priority: while an SLO fast-burn has the service
+    #: shedding, requests below the configured floor are dropped first
+    #: (0 = normal traffic; negotiated tenants send higher).
+    priority: int = 0
 
     def remaining_seconds(self) -> float:
         return self.deadline_seconds - (time.monotonic() - self.admitted_t)
@@ -243,6 +247,7 @@ def admit(
     kind: str,
     default_deadline_seconds: float,
     max_unit_lanes: int = 64,
+    tenant_priority: Optional[dict] = None,
 ) -> AdmissionTicket:
     """Validate and price one request; returns the ticket or raises a
     typed :class:`AdmissionRejected`. Zero compiles by construction."""
@@ -264,6 +269,15 @@ def admit(
     deadline = payload.get("deadline_seconds", default_deadline_seconds)
     if not isinstance(deadline, (int, float)) or deadline <= 0:
         _reject("field 'deadline_seconds' must be a positive number")
+    priority = payload.get("priority", 0)
+    if not isinstance(priority, int) or isinstance(priority, bool):
+        _reject("field 'priority' must be an integer")
+    if tenant_priority is not None:
+        # The payload field is untrusted: with a negotiated ceiling
+        # table installed, a tenant rides at most its entry (absent
+        # tenants at 0), so degradation cannot be opted out of by
+        # simply claiming priority in the request body.
+        priority = min(priority, int(tenant_priority.get(tenant, 0)))
     config, config_key = _build_config(payload.get("config"))
     quarantine = bool(
         payload.get("quarantine", engine in ("auto", "xla"))
@@ -391,4 +405,5 @@ def admit(
         deadline_seconds=float(deadline),
         admitted_t=time.monotonic(),
         coalesce_key=coalesce_key,
+        priority=priority,
     )

@@ -9,13 +9,23 @@ bounding, so the transport stays dumb:
 
 - ``POST /v1/simulate`` / ``POST /v1/sweep`` / ``POST /v1/table`` —
   JSON request -> :meth:`..serve.service.SimulationService.handle`;
-- ``GET /healthz`` — liveness + queue/breaker state (JSON);
+- ``GET /healthz`` — liveness + queue/breaker/SLO burn state (JSON);
 - ``GET /metrics`` — the process metrics registry in Prometheus text
   exposition (the PR 4 surface, now scrapeable).
 
 Every response this layer produces is typed JSON (or Prometheus text):
 a malformed body is a structured 400, an unknown route a structured
 404, and the service's own contract covers the rest — no bare 500s.
+
+Distributed-trace identity (0.13.0): EVERY response — rejections
+included — carries ``X-Request-Id``; an inbound ``traceparent`` (+
+``baggage``) header joins the caller's trace so the request's span
+tree roots under the caller's span
+(:mod:`..telemetry.propagation`), and dispatched requests return a
+``Server-Timing`` header with the critical-path breakdown (queue /
+coalesce / compile / execute). :class:`SimulationClient` generates a
+traceparent per call and surfaces the echoed id on
+:class:`ServeResponse` so user-side retries are correlatable.
 """
 
 from __future__ import annotations
@@ -63,12 +73,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
-        for k, v in (headers or {}).items():
+        merged = dict(headers or {})
+        # EVERY response carries the request's identity — rejections
+        # included — so a client-side retry loop is correlatable.
+        if getattr(self, "_rid", None) and "X-Request-Id" not in merged:
+            merged["X-Request-Id"] = self._rid
+        for k, v in merged.items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(payload)
 
     def do_GET(self) -> None:  # noqa: N802 — http.server API
+        self._rid = self.service.mint_request_id()
         try:
             if self.path == "/healthz":
                 self._send_json(200, self.service.healthz())
@@ -79,6 +95,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "Content-Type", "text/plain; version=0.0.4"
                 )
                 self.send_header("Content-Length", str(len(text)))
+                self.send_header("X-Request-Id", self._rid)
                 self.end_headers()
                 self.wfile.write(text)
             else:
@@ -91,6 +108,7 @@ class _Handler(BaseHTTPRequestHandler):
             pass
 
     def do_POST(self) -> None:  # noqa: N802 — http.server API
+        self._rid = self.service.mint_request_id()
         try:
             kind = _ROUTES.get(self.path)
             if kind is None:
@@ -126,7 +144,19 @@ class _Handler(BaseHTTPRequestHandler):
                      "message": str(exc)[:200]},
                 )
                 return
-            status, body, headers = self.service.handle(kind, payload)
+            from yuma_simulation_tpu.telemetry.propagation import (
+                BAGGAGE_HEADER,
+                TRACEPARENT_HEADER,
+                TraceContext,
+            )
+
+            trace = TraceContext.from_traceparent(
+                self.headers.get(TRACEPARENT_HEADER),
+                self.headers.get(BAGGAGE_HEADER),
+            )
+            status, body, headers = self.service.handle(
+                kind, payload, request_id=self._rid, trace=trace
+            )
             self._send_json(status, body, headers)
         except BrokenPipeError:
             pass
@@ -201,12 +231,17 @@ class SimulationServer:
 @dataclass
 class ServeResponse:
     """One client-side result: HTTP status + parsed JSON body (+ the
-    Retry-After header, parsed, when the server sent one)."""
+    Retry-After header, parsed, when the server sent one), plus the
+    correlation identity — the server-echoed ``X-Request-Id`` and the
+    ``traceparent`` this call sent, so a user-side retry loop can tie
+    every attempt to its server-side request span."""
 
     status: int
     body: dict
     retry_after: Optional[float] = None
     headers: dict = field(default_factory=dict)
+    #: the traceparent header value this call sent (one per call).
+    traceparent: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -214,6 +249,34 @@ class ServeResponse:
             "ok",
             "partial",
         )
+
+    @property
+    def request_id(self) -> Optional[str]:
+        """The server's ``X-Request-Id`` echo (header first, body
+        fallback) — the join key into the server's flight bundle."""
+        return self.headers.get("X-Request-Id") or self.body.get(
+            "request_id"
+        )
+
+    @property
+    def server_timing(self) -> dict:
+        """The ``Server-Timing`` critical-path breakdown as
+        ``{phase: milliseconds}`` (empty when the server sent none)."""
+        out: dict = {}
+        raw = self.headers.get("Server-Timing", "")
+        for item in raw.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            name, _, params = item.partition(";")
+            for p in params.split(";"):
+                k, _, v = p.partition("=")
+                if k.strip() == "dur":
+                    try:
+                        out[name.strip()] = float(v)
+                    except ValueError:
+                        pass
+        return out
 
 
 class SimulationClient:
@@ -229,12 +292,35 @@ class SimulationClient:
         self.tenant = tenant
         self.timeout = timeout
 
+    def _trace_headers(self) -> dict:
+        """One traceparent per call: the caller's active run + innermost
+        span when one exists (so the server's request span tree roots
+        under the CALLER's trace), else a fresh client-run identity —
+        either way the server can be asked "what did my call do"."""
+        from yuma_simulation_tpu.telemetry.propagation import (
+            BAGGAGE_HEADER,
+            TRACEPARENT_HEADER,
+            TraceContext,
+            current_trace_context,
+        )
+        from yuma_simulation_tpu.telemetry.runctx import new_run_id
+
+        ctx = current_trace_context()
+        if ctx is None:
+            ctx = TraceContext(run_id=new_run_id())
+        ctx = ctx.with_baggage(tenant=self.tenant)
+        return {
+            TRACEPARENT_HEADER: ctx.to_traceparent(),
+            BAGGAGE_HEADER: ctx.to_baggage(),
+        }
+
     def _request(
         self, method: str, path: str, payload: Optional[dict] = None
     ) -> ServeResponse:
         url = self.base_url + path
         data = None
         headers = {"Accept": "application/json"}
+        headers.update(self._trace_headers())
         if payload is not None:
             data = json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"
@@ -261,7 +347,11 @@ class SimulationClient:
             except ValueError:
                 pass
         return ServeResponse(
-            status=status, body=body, retry_after=retry_after, headers=hdrs
+            status=status,
+            body=body,
+            retry_after=retry_after,
+            headers=hdrs,
+            traceparent=headers.get("traceparent"),
         )
 
     def _post(self, path: str, payload: dict) -> ServeResponse:

@@ -47,6 +47,7 @@ from yuma_simulation_tpu.resilience.errors import (
     AdmissionRejected,
     EngineFailure,
     QueueOverflow,
+    SloShed,
     classify_failure,
 )
 from yuma_simulation_tpu.serve.admission import AdmissionTicket, admit
@@ -87,6 +88,23 @@ class ServeConfig:
     mesh: object = None
     elastic: bool = True
     drain_estimate_seconds: float = 0.25
+    #: SLO objectives this service evaluates (:mod:`..telemetry.slo`).
+    #: None = the process engine with its default specs; a tuple of
+    #: `SLOSpec` builds a service-owned engine over exactly those.
+    slo_specs: Optional[tuple] = None
+    #: While any `degrade=True` SLO fast-burns, requests with
+    #: ``priority`` below this floor shed 429 BEFORE touching the
+    #: queue (observability driving degradation). Default floor 1:
+    #: normal traffic (priority 0) sheds, negotiated priority>=1 rides.
+    shed_priority_below: int = 1
+    #: tenant -> maximum accepted ``priority`` (the negotiated
+    #: ceiling). When set, admission clamps the untrusted payload
+    #: field to the tenant's entry (absent tenants to 0) so a client
+    #: cannot opt out of SLO-driven shedding by claiming priority.
+    #: None (default) trusts the payload — single-operator deployments.
+    tenant_priority: Optional[dict] = None
+    #: Retry-After for SLO-driven sheds (seconds).
+    slo_shed_retry_after: float = 5.0
     #: Test-only: construct the service without its dispatcher thread
     #: (so queue-bound behavior can be observed deterministically).
     start_dispatcher: bool = True
@@ -94,9 +112,23 @@ class ServeConfig:
 
 class _Pending:
     """One admitted request waiting for its dispatch: the ticket plus
-    the handler's rendezvous (`done` event, resolved status/body)."""
+    the handler's rendezvous (`done` event, resolved status/body) and
+    the critical-path timestamps the dispatcher stamps as the request
+    moves — queue wait / coalesce wait / compile / execute become
+    request-span children and the ``Server-Timing`` response header."""
 
-    __slots__ = ("ticket", "done", "status", "response", "synthetic")
+    __slots__ = (
+        "ticket",
+        "done",
+        "status",
+        "response",
+        "synthetic",
+        "t_enqueued",
+        "t_taken",
+        "t_exec_start",
+        "t_exec_end",
+        "compile_seconds",
+    )
 
     def __init__(self, ticket: AdmissionTicket, synthetic: bool = False):
         self.ticket = ticket
@@ -104,10 +136,20 @@ class _Pending:
         self.status: Optional[int] = None
         self.response: Optional[dict] = None
         self.synthetic = synthetic
+        self.t_enqueued = time.time()
+        self.t_taken: Optional[float] = None
+        self.t_exec_start: Optional[float] = None
+        self.t_exec_end: Optional[float] = None
+        self.compile_seconds = 0.0
 
     def resolve(self, status: int, body: dict) -> None:
         self.status = status
         self.response = body
+        # Stamp the execute end HERE (not only in the dispatcher's
+        # finally): the handler thread wakes on `done` and must never
+        # observe a half-stamped critical path.
+        if self.t_exec_start is not None and self.t_exec_end is None:
+            self.t_exec_end = time.time()
         self.done.set()
 
 
@@ -116,14 +158,53 @@ class SimulationService:
     the HTTP server's per-connection threads; one dispatcher thread
     drains the queue."""
 
-    def __init__(self, config: Optional[ServeConfig] = None, registry=None):
+    def __init__(
+        self,
+        config: Optional[ServeConfig] = None,
+        registry=None,
+        slo_engine=None,
+    ):
         from yuma_simulation_tpu.resilience.supervisor import FailureLedger
         from yuma_simulation_tpu.telemetry.metrics import get_registry
         from yuma_simulation_tpu.telemetry.runctx import RunContext
+        from yuma_simulation_tpu.telemetry.slo import SLOEngine, get_slo_engine
 
         self.config = config if config is not None else ServeConfig()
         self.registry = registry if registry is not None else get_registry()
         self.run = RunContext()
+        self._slo_installed = False
+        if slo_engine is not None:
+            self.slo = slo_engine
+        elif self.config.slo_specs is not None:
+            self.slo = SLOEngine(
+                self.config.slo_specs, registry=self.registry
+            )
+            # Operator-declared objectives replace the process engine so
+            # the supervisor's unit durations and the sentinel's compile
+            # seconds (which feed through `observe_duration` -> the
+            # process engine) land on THESE specs, not the defaults.
+            # Restored at close() if still installed.
+            from yuma_simulation_tpu.telemetry.slo import set_slo_engine
+
+            self._slo_previous = set_slo_engine(self.slo)
+            self._slo_installed = True
+        else:
+            self.slo = get_slo_engine()
+        # SLO transitions are typed ledger events: alert + recovery land
+        # in the request ledger under their own span. Unhooked at
+        # close() so a later service sharing the process engine can
+        # claim the hook.
+        if self.slo.on_transition is None:
+            self.slo.on_transition = self._slo_transition
+        #: Per-request ingress runs continuing REMOTE traces — held for
+        #: the bundle publish so their spans resolve; flushed to disk in
+        #: batches so a long-lived server's memory stays bounded. The
+        #: publish lock serializes flush vs close: two concurrent
+        #: read-merge-write passes over one spans.jsonl would drop
+        #: whichever batch lands first.
+        self._ingress_lock = threading.Lock()
+        self._ingress_runs: list = []
+        self._publish_lock = threading.Lock()
         self.started_t = time.time()
         self.quotas = TenantQuotas(
             rate=self.config.tenant_rate,
@@ -196,27 +277,113 @@ class SimulationService:
         with self._ledger_lock:
             self.ledger.append(event, **fields)
 
+    def _slo_transition(self, rec: dict) -> None:
+        """The burn-rate engine's alert hook: every transition is a
+        typed ledger record under its own span of the SERVICE run (a
+        transition may fire from any handler thread, traced or not)."""
+        from yuma_simulation_tpu.telemetry.runctx import span
+
+        with self.run.activate():
+            # root=True: a transition may fire mid-request of a CONTINUED
+            # trace, where the innermost span belongs to the caller's
+            # run — inheriting it would record an unresolvable parent.
+            with span(f"slo:{rec['slo']}", root=True, state=rec["to"]):
+                self._append_ledger(
+                    "slo_alert" if rec["to"] != "ok" else "slo_recovered",
+                    slo=rec["slo"],
+                    state=rec["to"],
+                    was=rec["from"],
+                    burn_rate=rec["burn_rate"],
+                )
+
+    def mint_request_id(self) -> str:
+        """Process-unique request id — the HTTP layer mints one per
+        connection-handled request so even pre-pipeline rejections
+        (404/413/bad JSON) echo ``X-Request-Id``."""
+        return f"r{next(self._counter):06d}"
+
+    def _remember_ingress(self, run) -> None:
+        """Keep a completed ingress run (a remote trace's request spans)
+        for the bundle publish; flush batches to disk so memory stays
+        bounded on a long-lived server."""
+        flush = None
+        with self._ingress_lock:
+            self._ingress_runs.append(run)
+            if len(self._ingress_runs) > 256:
+                flush, self._ingress_runs = self._ingress_runs, []
+        if flush and self.config.bundle_dir is not None:
+            from yuma_simulation_tpu.telemetry.flight import (
+                METRICS_NAME,
+                FlightRecorder,
+            )
+
+            try:
+                with self._publish_lock:
+                    # Append-only (no whole-file merge) so the unlucky
+                    # 257th request's handler thread pays O(batch), not
+                    # O(total-spans); close() merge-republishes.
+                    rec = FlightRecorder(self.config.bundle_dir)
+                    rec.append_spans(flush)
+                    self.registry.publish_snapshot(
+                        pathlib.Path(self.config.bundle_dir)
+                        / METRICS_NAME,
+                        run_id=self.run.run_id,
+                    )
+                    rec.record_slo(self.slo, run_id=self.run.run_id)
+            except Exception:
+                logger.warning(
+                    "ingress span flush failed for %s",
+                    self.config.bundle_dir,
+                    exc_info=True,
+                )
+
     # -- the request pipeline -------------------------------------------
 
-    def handle(self, kind: str, payload) -> tuple[int, dict, dict]:
+    def handle(
+        self, kind: str, payload, *, request_id=None, trace=None
+    ) -> tuple[int, dict, dict]:
         """One request, end to end; returns `(status, body, headers)`.
-        Total by construction: every exit path is a typed JSON body."""
-        with self.run.activate():
-            t0 = time.perf_counter()
-            self._requests_total.inc()
-            rid = f"r{next(self._counter):06d}"
-            tenant = (
-                payload.get("tenant", "anonymous")
-                if isinstance(payload, dict)
-                else "anonymous"
-            )
-            from yuma_simulation_tpu.telemetry.runctx import span
+        Total by construction: every exit path is a typed JSON body
+        carrying ``X-Request-Id`` (and ``Server-Timing`` with the
+        request's critical-path breakdown once it was dispatched).
 
+        `trace` (a :class:`..telemetry.propagation.TraceContext` or a
+        raw traceparent header value) JOINS the caller's distributed
+        trace: the request span tree roots under the caller's span in
+        the caller's run, published into this server's flight bundle."""
+        from yuma_simulation_tpu.telemetry.propagation import (
+            TraceContext,
+            child_run,
+            span_prefix_for,
+        )
+        from yuma_simulation_tpu.telemetry.runctx import span
+
+        if isinstance(trace, str):
+            trace = TraceContext.from_traceparent(trace)
+        rid = request_id if request_id else self.mint_request_id()
+        t0 = time.perf_counter()
+        t_wall0 = time.time()
+        self._requests_total.inc()
+        tenant = (
+            payload.get("tenant", "anonymous")
+            if isinstance(payload, dict)
+            else "anonymous"
+        )
+        if trace is not None:
+            run = child_run(trace, prefix=span_prefix_for())
+            cm = run
+            ingress = run
+        else:
+            run = self.run
+            cm = self.run.activate()
+            ingress = None
+        with cm:
             with span(
                 f"request:{rid}", tenant=tenant, endpoint=kind, request=rid
             ) as s:
+                pending = None
                 try:
-                    status, body, headers = self._handle_inner(
+                    status, body, headers, pending = self._handle_inner(
                         kind, payload, rid, tenant
                     )
                 except BaseException as exc:  # noqa: BLE001 — typed below
@@ -229,6 +396,13 @@ class SimulationService:
                 if s is not None:
                     s.attrs["status"] = status
                     s.attrs["outcome"] = body.get("status", "?")
+                timing = self._record_phases(
+                    run, s, pending, t_wall0, time.time()
+                )
+                headers = dict(headers)
+                headers.setdefault("X-Request-Id", rid)
+                if timing:
+                    headers.setdefault("Server-Timing", timing)
                 self._append_ledger(
                     "request_done",
                     request=rid,
@@ -237,12 +411,54 @@ class SimulationService:
                     status=status,
                     outcome=body.get("status", "?"),
                 )
-                self._request_seconds.observe(time.perf_counter() - t0)
-                return status, body, headers
+        elapsed = time.perf_counter() - t0
+        self._request_seconds.observe(elapsed)
+        # The SLO signals: request latency, error rate (5xx), shed rate.
+        self.slo.observe("serve_request_seconds", elapsed)
+        self.slo.event("serve_request_ok", status < 500)
+        self.slo.event("serve_admitted", status != 429)
+        if ingress is not None:
+            self._remember_ingress(ingress)
+        return status, body, headers
+
+    def _record_phases(
+        self, run, request_span, pending, t_wall0: float, t_wall1: float
+    ) -> str:
+        """Synthesize the request's critical-path child spans from the
+        dispatcher's timestamps and return the ``Server-Timing`` header
+        value (RFC 9211 metric syntax, durations in ms)."""
+        parts = []
+        parent = request_span.span_id if request_span is not None else ""
+
+        def phase(name: str, t0, t1, **attrs) -> None:
+            if t0 is None or t1 is None or t1 < t0:
+                return
+            run.record_span(name, t0, t1, parent_id=parent, **attrs)
+            parts.append(f"{name};dur={1000.0 * (t1 - t0):.1f}")
+
+        if pending is not None and pending.t_exec_end is not None:
+            phase("queue", pending.t_enqueued, pending.t_taken)
+            phase("coalesce", pending.t_taken, pending.t_exec_start)
+            if pending.compile_seconds > 0 and pending.t_exec_start is not None:
+                phase(
+                    "compile",
+                    pending.t_exec_start,
+                    pending.t_exec_start + pending.compile_seconds,
+                )
+            else:
+                parts.append("compile;dur=0.0")
+            phase(
+                "execute",
+                pending.t_exec_start,
+                pending.t_exec_end,
+                compile_s=round(pending.compile_seconds, 6),
+            )
+        parts.append(f"total;dur={1000.0 * (t_wall1 - t_wall0):.1f}")
+        return ", ".join(parts)
 
     def _handle_inner(
         self, kind: str, payload, rid: str, tenant: str
-    ) -> tuple[int, dict, dict]:
+    ) -> tuple[int, dict, dict, Optional[_Pending]]:
         if self._stopping:
             return (
                 503,
@@ -253,6 +469,7 @@ class SimulationService:
                     "request_id": rid,
                 },
                 {"Retry-After": "5"},
+                None,
             )
         try:
             ticket = admit(
@@ -262,6 +479,7 @@ class SimulationService:
                 default_deadline_seconds=self.config.default_deadline_seconds,
                 # Price sweeps at the unit size _execute_sweep dispatches.
                 max_unit_lanes=self.config.max_batch * 8,
+                tenant_priority=self.config.tenant_priority,
             )
         except AdmissionRejected as exc:
             self._admission_rejected.inc()
@@ -274,7 +492,7 @@ class SimulationService:
             }
             if exc.suggestion:
                 body["suggestion"] = exc.suggestion
-            return 400, body, {}
+            return 400, body, {}, None
 
         # Deterministic overload drill (test-only hook, one `is None`
         # check in production): push the armed burst of synthetic
@@ -287,6 +505,18 @@ class SimulationService:
             self._inject_overload(overload)
 
         try:
+            # SLO-driven degradation FIRST: while a degrade=True SLO
+            # fast-burns, lowest-priority work sheds here — before it
+            # can fill the queue and before the quota spends a token on
+            # work the service has already decided to drop.
+            burning = self.slo.degraded()
+            if burning and ticket.priority < self.config.shed_priority_below:
+                raise SloShed(
+                    f"SLO fast burn ({', '.join(burning)}): shedding "
+                    f"priority<{self.config.shed_priority_below} work",
+                    retry_after=self.config.slo_shed_retry_after,
+                    slos=burning,
+                )
             try:
                 self.quotas.admit(ticket.tenant)
             except QueueOverflow:
@@ -298,22 +528,32 @@ class SimulationService:
             self.queue.put(pending)
         except QueueOverflow as exc:
             retry_after = max(0.1, exc.retry_after)
+            if isinstance(exc, SloShed):
+                self.queue.record_shed()
+            shed_fields = {}
+            if isinstance(exc, SloShed):
+                shed_fields["slos"] = list(exc.slos)
             self._append_ledger(
                 "request_shed",
                 request=rid,
                 tenant=ticket.tenant,
                 retry_after=round(retry_after, 3),
+                **shed_fields,
             )
+            body = {
+                "status": "shed",
+                "error": type(exc).__name__,
+                "message": str(exc),
+                "retry_after": retry_after,
+                "request_id": rid,
+            }
+            if isinstance(exc, SloShed):
+                body["slo"] = list(exc.slos)
             return (
                 429,
-                {
-                    "status": "shed",
-                    "error": "QueueOverflow",
-                    "message": str(exc),
-                    "retry_after": retry_after,
-                    "request_id": rid,
-                },
+                body,
                 {"Retry-After": str(int(math.ceil(retry_after)))},
+                None,
             )
 
         if not pending.done.wait(self._wall_cap(ticket)):
@@ -328,6 +568,7 @@ class SimulationService:
                     "request_id": rid,
                 },
                 {},
+                pending,
             )
         headers = {}
         assert pending.status is not None and pending.response is not None
@@ -335,7 +576,7 @@ class SimulationService:
             headers["Retry-After"] = str(
                 int(math.ceil(pending.response["retry_after"]))
             )
-        return pending.status, pending.response, headers
+        return pending.status, pending.response, headers, pending
 
     def _wall_cap(self, ticket: AdmissionTicket) -> float:
         """The handler's rendezvous bound: generous enough for a full
@@ -377,6 +618,7 @@ class SimulationService:
                     if self._stopping:
                         return
                     continue
+                item.t_taken = time.time()
                 if self._stopping:
                     item.resolve(
                         503,
@@ -406,6 +648,21 @@ class SimulationService:
         from yuma_simulation_tpu.telemetry.runctx import span
 
         first = group[0].ticket
+        now = time.time()
+        compile_hist = self.registry.histogram(
+            "compile_seconds",
+            help=(
+                "wall seconds of sentinel regions that added "
+                "jit-cache entries (compile-time upper bound)"
+            ),
+        )
+        compile_before = compile_hist.snapshot()["sum"]
+        for p in group:
+            # Coalesce-gathered members were taken off the queue by
+            # gather_group, not the dispatcher's get(): stamp them now.
+            if p.t_taken is None:
+                p.t_taken = now
+            p.t_exec_start = now
         with span(
             f"dispatch:{first.kind}",
             requests=[p.ticket.request_id for p in group],
@@ -429,6 +686,15 @@ class SimulationService:
                         exc, p.ticket.request_id
                     )
                     p.resolve(status, body)
+            finally:
+                t_end = time.time()
+                compile_delta = max(
+                    0.0, compile_hist.snapshot()["sum"] - compile_before
+                )
+                for p in group:
+                    if p.t_exec_end is None:
+                        p.t_exec_end = t_end
+                    p.compile_seconds = compile_delta
 
     def _remaining_or_fail(self, group: list) -> Optional[float]:
         """The batch's conservative remaining deadline (the tightest
@@ -638,14 +904,37 @@ class SimulationService:
     # -- ops surface -----------------------------------------------------
 
     def healthz(self) -> dict:
+        slo_states = self.slo.evaluate()
+        fast = sorted(
+            name
+            for name, s in slo_states.items()
+            if s["state"] == "fast_burn"
+        )
+        degraded = [n for n in fast if slo_states[n]["degrade"]]
+        if self._stopping:
+            status = "draining"
+        elif fast:
+            status = "degraded"
+        else:
+            status = "ok"
         return {
-            "status": "draining" if self._stopping else "ok",
+            "status": status,
+            # Readiness: a fast-burning service is alive but should not
+            # receive fresh low-priority traffic.
+            "ready": not self._stopping and not fast,
             "uptime_seconds": round(time.time() - self.started_t, 3),
             "run_id": self.run.run_id,
             "queue_depth": len(self.queue),
             "queue_limit": self.queue.limit,
             "breaker": self.breaker.snapshot(),
             "requests_total": int(self._requests_total.value),
+            "slo": {
+                "states": {
+                    name: s["state"] for name, s in slo_states.items()
+                },
+                "fast_burn": fast,
+                "degraded": degraded,
+            },
         }
 
     def metrics_text(self) -> str:
@@ -673,13 +962,35 @@ class SimulationService:
                 },
             )
         self._closed = True
+        # Release the process-global SLO hooks: a later service in the
+        # same process must be able to claim the transition hook, and
+        # the supervisor/sentinel `observe_duration` feeds must fall
+        # back to whatever engine was installed before us.
+        # `==`, not `is`: each attribute access mints a fresh bound
+        # method; equality compares the underlying (self, func) pair.
+        if self.slo.on_transition == self._slo_transition:
+            self.slo.on_transition = None
+        if self._slo_installed:
+            from yuma_simulation_tpu.telemetry.slo import (
+                peek_slo_engine,
+                set_slo_engine,
+            )
+
+            if peek_slo_engine() is self.slo:
+                set_slo_engine(self._slo_previous)
         if self.config.bundle_dir is not None:
             from yuma_simulation_tpu.telemetry.flight import FlightRecorder
 
+            with self._ingress_lock:
+                ingress, self._ingress_runs = self._ingress_runs, []
             try:
-                FlightRecorder(self.config.bundle_dir).record(
-                    self.run, registry=self.registry
-                )
+                with self._publish_lock:
+                    FlightRecorder(self.config.bundle_dir).record(
+                        self.run,
+                        registry=self.registry,
+                        extra_runs=ingress,
+                        slo_engine=self.slo,
+                    )
             except Exception:
                 logger.warning(
                     "serve flight-bundle publish failed for %s",

@@ -3,7 +3,7 @@ telemetry, and the sweep flight recorder.
 
 The observability substrate under the resilience tier (SURVEY.md §5:
 the reference has bare prints; PRs 1-3 added recovery but no identity
-or rates). Five modules:
+or rates). The modules:
 
 - :mod:`.cost` — the compile-time half: AOT cost/memory capture per
   engine rung (``cost_analysis``/``memory_analysis`` + HLO
@@ -19,8 +19,17 @@ or rates). Five modules:
 - :mod:`.device` — HBM/live-buffer/jit-cache sampling at span
   boundaries (graceful None on CPU);
 - :mod:`.flight` — the per-run on-disk bundle (ledger + spans +
-  metrics + report) and its loader/consistency checks, rendered by
-  ``python -m tools.obsreport``.
+  metrics + report + SLO state) and its loader/consistency checks —
+  the single-bundle AND stitched multi-bundle orphan gates — rendered
+  by ``python -m tools.obsreport``;
+- :mod:`.propagation` — cross-process trace continuation: the
+  serializable `TraceContext` that rides HTTP headers, fleet manifests,
+  lease records and subprocess environments so serve -> supervisor ->
+  fleet is ONE trace;
+- :mod:`.slo` — mergeable log-bucketed latency sketches, declarative
+  `SLOSpec` objectives, and the burn-rate engine whose fast-burn
+  alerts drive the serving tier's admission degradation
+  (``python -m tools.sloreport`` renders and gates the state).
 
 Everything is host-side: the layer adds zero compiles (the warm-repeat
 budgets of tests/unit/test_recompilation.py stay at 0) and no reads
@@ -54,8 +63,10 @@ from yuma_simulation_tpu.telemetry.flight import (  # noqa: F401
     FlightRecorder,
     build_timeline,
     check_bundle,
+    check_stitched,
     ledger_counts,
     load_bundle,
+    merge_bundles,
 )
 from yuma_simulation_tpu.telemetry.metrics import (  # noqa: F401
     Counter,
@@ -64,6 +75,13 @@ from yuma_simulation_tpu.telemetry.metrics import (  # noqa: F401
     MetricsRegistry,
     get_registry,
     record_epoch_rate,
+)
+from yuma_simulation_tpu.telemetry.propagation import (  # noqa: F401
+    TraceContext,
+    child_run,
+    continue_trace,
+    current_trace_context,
+    span_prefix_for,
 )
 from yuma_simulation_tpu.telemetry.runctx import (  # noqa: F401
     RunContext,
@@ -75,4 +93,13 @@ from yuma_simulation_tpu.telemetry.runctx import (  # noqa: F401
     ensure_run,
     new_run_id,
     span,
+)
+from yuma_simulation_tpu.telemetry.slo import (  # noqa: F401
+    DEFAULT_SLO_SPECS,
+    LatencySketch,
+    SLOEngine,
+    SLOSpec,
+    get_slo_engine,
+    observe_duration,
+    set_slo_engine,
 )

@@ -31,6 +31,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import pathlib
 from typing import Optional, Union
 
@@ -47,6 +48,7 @@ SPANS_NAME = "spans.jsonl"
 METRICS_NAME = "metrics.jsonl"
 COSTS_NAME = "costs.jsonl"
 REPORT_NAME = "report.json"
+SLO_NAME = "slo.json"
 
 #: The SweepHealthReport action counts the ledger must reproduce exactly
 #: (report field -> derivation, see :func:`ledger_counts`).
@@ -83,6 +85,8 @@ class FlightRecorder:
         *,
         registry: Optional[MetricsRegistry] = None,
         report=None,
+        extra_runs=(),
+        slo_engine=None,
     ) -> None:
         """Append `run`'s spans to ``spans.jsonl``, one registry
         snapshot line to ``metrics.jsonl``, and (when given) publish the
@@ -92,12 +96,26 @@ class FlightRecorder:
         mid-run publish records still-open ancestors as
         ``status="open"``, and a later publish of the same run (a second
         supervised sweep under one operator RunContext) replaces them
-        with their closed form instead of duplicating them."""
+        with their closed form instead of duplicating them.
+        `extra_runs` (further :class:`RunContext`s — e.g. a server's
+        per-request ingress runs continuing remote traces) merge into
+        the SAME republish so a bundle publish stays one atomic write
+        per sink.
+
+        The process SLO state (:mod:`..slo`) publishes alongside as
+        ``slo.json`` whenever an engine with specs exists — pass
+        `slo_engine` to pin a specific one (the serving tier's), default
+        is the process engine. SLO capture failures are contained: the
+        span/metrics record above must never be misreported as failed
+        because the SLO snapshot was."""
         from yuma_simulation_tpu.utils.checkpoint import publish_atomic
 
         spans_path = self.directory / SPANS_NAME
         merged: dict[tuple, dict] = {}
-        for rec in _read_jsonl(spans_path) + run.span_records():
+        new_records: list = run.span_records()
+        for extra in extra_runs:
+            new_records.extend(extra.span_records())
+        for rec in _read_jsonl(spans_path) + new_records:
             merged[(rec.get("run_id"), rec.get("span_id"))] = rec
         payload = "".join(
             json.dumps(s, sort_keys=True) + "\n" for s in merged.values()
@@ -120,6 +138,61 @@ class FlightRecorder:
                     sort_keys=True,
                 ).encode(),
             )
+        try:
+            self.record_slo(slo_engine, run_id=run.run_id)
+        except Exception:
+            logger.warning(
+                "SLO snapshot publish failed for %s", self.directory,
+                exc_info=True,
+            )
+
+    def append_spans(self, runs) -> None:
+        """Append completed runs' span records to ``spans.jsonl``
+        WITHOUT the whole-file merge :meth:`record` does — O(batch),
+        for a long-lived server's periodic ingress flushes (a full
+        merge republish there is O(total-spans) on a request handler
+        thread and quadratic over the server's lifetime). Callers must
+        serialize against concurrent publishes to the same directory
+        (the serving tier's publish lock) and flush each run at most
+        once: nothing here dedupes — the next full :meth:`record`
+        (close) merges by identity and republishes atomically, which
+        also heals a torn tail from a crash mid-append (readers are
+        torn-tail tolerant)."""
+        records: list = []
+        for run in runs:
+            records.extend(run.span_records())
+        if not records:
+            return
+        payload = "".join(
+            json.dumps(s, sort_keys=True) + "\n" for s in records
+        )
+        self.directory.mkdir(parents=True, exist_ok=True)
+        with open(self.directory / SPANS_NAME, "ab") as fh:
+            fh.write(payload.encode())
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def record_slo(self, engine=None, *, run_id: Optional[str] = None) -> None:
+        """Publish the SLO engine's state (specs, per-SLO burn state,
+        sketches, alert history) as ``slo.json`` — what
+        ``tools/sloreport.py`` renders and gates. No engine / no specs
+        -> no file (a bundle without SLOs stays additive for old
+        readers)."""
+        from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+
+        if engine is None:
+            from yuma_simulation_tpu.telemetry.slo import peek_slo_engine
+
+            engine = peek_slo_engine()
+        if engine is None or not engine.specs:
+            return
+        snap = engine.snapshot()
+        if run_id is not None:
+            snap["run_id"] = run_id
+        publish_atomic(
+            self.directory / SLO_NAME,
+            json.dumps(snap, sort_keys=True).encode(),
+        )
 
     def record_costs(self, records, *, run_id: Optional[str] = None) -> None:
         """Append AOT cost records (``CostRecord`` instances or their
@@ -167,6 +240,7 @@ class Bundle:
     ledger: list
     report: Optional[dict] = None
     costs: list = dataclasses.field(default_factory=list)
+    slo: Optional[dict] = None
 
     def run_ids(self) -> list[str]:
         """Distinct run ids, first-seen order (spans then ledger)."""
@@ -184,20 +258,25 @@ class Bundle:
 
 def load_bundle(directory: Union[str, pathlib.Path]) -> Bundle:
     directory = pathlib.Path(directory)
-    report = None
-    report_path = directory / REPORT_NAME
-    if report_path.exists():
+
+    def _json_file(name: str) -> Optional[dict]:
+        path = directory / name
+        if not path.exists():
+            return None
         try:
-            report = json.loads(report_path.read_text())
+            return json.loads(path.read_text())
         except json.JSONDecodeError:
-            logger.warning("undecodable %s in %s", REPORT_NAME, directory)
+            logger.warning("undecodable %s in %s", name, directory)
+            return None
+
     return Bundle(
         directory=directory,
         spans=_read_jsonl(directory / SPANS_NAME),
         metrics=_read_jsonl(directory / METRICS_NAME),
         ledger=_read_jsonl(directory / LEDGER_NAME),
-        report=report,
+        report=_json_file(REPORT_NAME),
         costs=_read_jsonl(directory / COSTS_NAME),
+        slo=_json_file(SLO_NAME),
     )
 
 
@@ -239,7 +318,10 @@ def check_bundle(bundle: Bundle) -> list[str]:
 
     - every ledger record must carry ``run_id``/``span_id`` resolving to
       a recorded span of that run (the obsreport ``--check`` gate);
-    - every span's ``parent_id`` must resolve within its run;
+    - every span's ``parent_id`` must resolve within its run — EXCEPT
+      spans flagged ``remote_parent`` (a continued cross-process trace,
+      :mod:`..propagation`): their parent lives in a sibling process's
+      bundle and is checked by :func:`check_stitched` instead;
     - when ``report.json`` is present, its action counts must match the
       ledger-derived counts exactly (:data:`CROSS_CHECKED_COUNTS`);
     - every ``costs.jsonl`` record must name its engine, and a null
@@ -264,6 +346,8 @@ def check_bundle(bundle: Bundle) -> list[str]:
         )
     for s in bundle.spans:
         parent = s.get("parent_id", "")
+        if s.get("remote_parent"):
+            continue  # resolved across bundles by check_stitched
         if parent and parent not in spans_by_run.get(s.get("run_id", ""), ()):
             problems.append(
                 f"span {s.get('span_id')} (run {s.get('run_id')}) has "
@@ -296,6 +380,83 @@ def check_bundle(bundle: Bundle) -> list[str]:
                         f"report.{key}={fields[key]} but the ledger "
                         f"derives {derived[key]} for run {rid}"
                     )
+    return problems
+
+
+def merge_bundles(bundles, directory=None) -> Bundle:
+    """The UNION of several sibling bundles (one per process of a
+    distributed run) as one logical bundle: spans/ledger/metrics/costs
+    concatenated, deduped by identity, time-ordered — what the stitched
+    cross-process timeline renders. `report`/`slo` keep the first
+    non-None (the driver's, by caller convention)."""
+    spans: dict[tuple, dict] = {}
+    ledger: list = []
+    metrics: list = []
+    costs: list = []
+    report = None
+    slo = None
+    for b in bundles:
+        for s in b.spans:
+            spans.setdefault((s.get("run_id"), s.get("span_id")), s)
+        ledger.extend(b.ledger)
+        metrics.extend(b.metrics)
+        costs.extend(b.costs)
+        if report is None:
+            report = b.report
+        if slo is None:
+            slo = b.slo
+    ledger.sort(key=lambda r: float(r.get("t") or 0.0))
+    return Bundle(
+        directory=pathlib.Path(directory) if directory else pathlib.Path("."),
+        spans=sorted(
+            spans.values(), key=lambda s: float(s.get("t_start") or 0.0)
+        ),
+        metrics=metrics,
+        ledger=ledger,
+        report=report,
+        costs=costs,
+        slo=slo,
+    )
+
+
+def check_stitched(bundles) -> list[str]:
+    """The cross-process half of the orphan-span gate: over the UNION of
+    sibling bundles, every span flagged ``remote_parent`` must resolve
+    to a recorded span of the same run in SOME bundle, and every parent
+    chain must terminate at a true root (empty ``parent_id``) without a
+    cycle. A span whose remote parent no sibling recorded is an orphan —
+    a tampered, truncated, or mis-propagated trace — and fails the
+    check. Empty list = one sound stitched trace."""
+    bundles = list(bundles)
+    by_run: dict[str, dict[str, dict]] = {}
+    for b in bundles:
+        for s in b.spans:
+            rid, sid = s.get("run_id", ""), s.get("span_id")
+            if sid:
+                by_run.setdefault(rid, {})[sid] = s
+    problems: list[str] = []
+    for rid, spans in sorted(by_run.items()):
+        for sid, s in sorted(spans.items()):
+            parent = s.get("parent_id", "")
+            if parent and parent not in spans:
+                problems.append(
+                    f"span {sid} (run {rid}) is an orphan: parent "
+                    f"{parent!r} resolves in no sibling bundle"
+                )
+        # Chain termination: walk each span to a root, bounded by the
+        # span count so a cycle cannot hang the gate.
+        for sid in sorted(spans):
+            cur, hops = sid, 0
+            while cur and hops <= len(spans):
+                parent = spans[cur].get("parent_id", "")
+                if not parent or parent not in spans:
+                    break
+                cur = parent
+                hops += 1
+            if hops > len(spans):
+                problems.append(
+                    f"span {sid} (run {rid}) sits on a parent cycle"
+                )
     return problems
 
 

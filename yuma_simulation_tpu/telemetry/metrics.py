@@ -61,6 +61,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import pathlib
 import re
 import threading
@@ -151,9 +152,19 @@ class Histogram:
     ):
         self.name = _check_name(name)
         self.help = help
-        bounds = tuple(sorted(float(b) for b in buckets))
+        # Finite bounds only: the cumulative +Inf bucket is ALWAYS
+        # emitted from the total count (exposition conformance), so a
+        # caller-passed inf/nan bound would only shadow it with a
+        # malformed `le` label.
+        bounds = tuple(
+            sorted(
+                {float(b) for b in buckets if math.isfinite(float(b))}
+            )
+        )
         if not bounds:
-            raise ValueError("histogram needs at least one bucket bound")
+            raise ValueError(
+                "histogram needs at least one finite bucket bound"
+            )
         self.bounds = bounds
         self._lock = threading.Lock()
         self._counts = [0] * (len(bounds) + 1)  # last = +Inf overflow
@@ -279,18 +290,28 @@ class MetricsRegistry:
 
     def prometheus_text(self) -> str:
         """The registry in Prometheus text exposition format (0.0.4) —
-        serve or dump this for scraping; no client library needed."""
+        serve or dump this for scraping; no client library needed.
+
+        Conformance contract (pinned by the exposition test in
+        tests/unit/test_telemetry.py): every histogram emits its
+        buckets in ascending ``le`` order ending with a cumulative
+        ``+Inf`` bucket equal to ``_count``; bucket counts are monotone
+        non-decreasing (cumulative by construction, the ``+Inf`` total
+        included); HELP text is escaped per the format (backslash and
+        newline)."""
         with self._lock:
             metrics = dict(self._metrics)
         out: list[str] = []
         for name, m in sorted(metrics.items()):
             if m.help:
-                out.append(f"# HELP {name} {m.help}")
+                out.append(f"# HELP {name} {_escape_help(m.help)}")
             out.append(f"# TYPE {name} {m.kind}")
             if isinstance(m, (Counter, Gauge)):
                 out.append(f"{name} {_fmt_value(m.value)}")
             else:
                 snap = m.snapshot()
+                # snapshot() yields bounds in ascending order with the
+                # "+Inf" total last; emit in exactly that order.
                 for le, c in snap["buckets"].items():
                     out.append(f'{name}_bucket{{le="{le}"}} {c}')
                 out.append(f"{name}_sum {_fmt_value(snap['sum'])}")
@@ -300,6 +321,12 @@ class MetricsRegistry:
 
 def _fmt_value(v: float) -> str:
     return repr(int(v)) if float(v).is_integer() else repr(v)
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format: backslash first,
+    then newline — a help string must never break line framing."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 _REGISTRY = MetricsRegistry()

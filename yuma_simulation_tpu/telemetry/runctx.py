@@ -92,6 +92,11 @@ class Span:
     #: host-side annotations (e.g. the profiler step numbers of the
     #: dispatches issued under this span) — flat JSON-able values only.
     attrs: dict = field(default_factory=dict)
+    #: ``parent_id`` lives in ANOTHER process's bundle (a continued
+    #: cross-process trace, :mod:`.propagation`): the single-bundle
+    #: consistency check must not demand local resolution, and the
+    #: stitched multi-bundle check must demand sibling resolution.
+    remote: bool = False
 
     def to_record(self, run_id: str) -> dict:
         rec = {
@@ -103,6 +108,8 @@ class Span:
             "t_end": None if self.t_end is None else round(self.t_end, 6),
             "status": self.status,
         }
+        if self.remote:
+            rec["remote_parent"] = True
         if self.attrs:
             rec["attrs"] = dict(self.attrs)
         return rec
@@ -117,10 +124,32 @@ class RunContext:
     Span ids are minted per run (`s0001`, `s0002`, ...) under a lock, so
     a span tree is readable in ledger order and safe to grow from the
     watchdog's worker threads.
+
+    Cross-process continuation (:mod:`.propagation`): a child process
+    joining an upstream trace passes the caller's ``run_id`` plus a
+    process-unique ``span_prefix`` (ids become ``<prefix>.s0001`` so two
+    processes minting spans in one run can never collide) and the
+    caller's span as ``remote_parent`` — every span this context opens
+    with no LOCAL parent roots under the caller's span instead of
+    floating as an orphan.
     """
 
-    def __init__(self, run_id: Optional[str] = None):
+    def __init__(
+        self,
+        run_id: Optional[str] = None,
+        *,
+        span_prefix: str = "",
+        remote_parent: str = "",
+    ):
         self.run_id = run_id if run_id else new_run_id()
+        if span_prefix and ("-" in span_prefix or " " in span_prefix):
+            # Span ids must survive the traceparent header's dash-split
+            # framing (propagation.TraceContext) and log tokenization.
+            raise ValueError(
+                f"span_prefix {span_prefix!r} must not contain '-' or spaces"
+            )
+        self.span_prefix = span_prefix
+        self.remote_parent = remote_parent
         self.t_start = time.time()
         self._lock = threading.Lock()
         self._next = itertools.count(1)
@@ -158,16 +187,60 @@ class RunContext:
 
     # -- span bookkeeping (called by :func:`span`) ---------------------
 
+    def _mint_span_id(self) -> str:
+        sid = f"s{next(self._next):04d}"
+        return f"{self.span_prefix}.{sid}" if self.span_prefix else sid
+
     def _open_span(self, name: str, parent: Optional[Span]) -> Span:
+        if parent is not None:
+            parent_id, remote = parent.span_id, False
+        else:
+            parent_id, remote = self.remote_parent, bool(self.remote_parent)
         s = Span(
             span_id="",
-            parent_id=parent.span_id if parent is not None else "",
+            parent_id=parent_id,
             name=name,
             t_start=time.time(),
+            remote=remote,
         )
         with self._lock:
-            s.span_id = f"s{next(self._next):04d}"
+            s.span_id = self._mint_span_id()
             self._open[s.span_id] = s
+        return s
+
+    def record_span(
+        self,
+        name: str,
+        t_start: float,
+        t_end: float,
+        *,
+        parent_id: str = "",
+        status: str = "ok",
+        **attrs,
+    ) -> Span:
+        """Append one ALREADY-CLOSED span with explicit wall-clock
+        bounds — how the serving tier reconstructs a request's critical
+        path (queue wait, coalesce wait, execute) from timestamps taken
+        on other threads, after the fact. ``parent_id`` defaults to a
+        root (or the run's remote parent when continuing a trace)."""
+        if not parent_id and self.remote_parent:
+            parent_id, remote = self.remote_parent, True
+        else:
+            remote = False
+        s = Span(
+            span_id="",
+            parent_id=parent_id,
+            name=name,
+            t_start=float(t_start),
+            t_end=float(t_end),
+            status=status,
+            remote=remote,
+        )
+        if attrs:
+            s.attrs.update(attrs)
+        with self._lock:
+            s.span_id = self._mint_span_id()
+            self._closed.append(s)
         return s
 
     def _close_span(self, s: Span) -> None:
@@ -227,16 +300,25 @@ def current_fields() -> dict:
 
 
 @contextlib.contextmanager
-def span(name: str, **attrs) -> Iterator[Optional[Span]]:
+def span(
+    name: str, *, root: bool = False, **attrs
+) -> Iterator[Optional[Span]]:
     """Open one named span under the innermost open span of the active
     run. No active run -> a no-op yielding None (library code can span
     unconditionally). An exception inside the span marks it
-    ``status="error"`` and propagates; the span always closes."""
+    ``status="error"`` and propagates; the span always closes.
+
+    ``root=True`` detaches from the caller's innermost span and opens
+    directly under the run's root (or its remote parent) — for records
+    emitted ON one run from a thread whose innermost span belongs to a
+    DIFFERENT run (e.g. an SLO transition fired mid-request of a
+    continued trace), where inheriting the foreign span would record an
+    unresolvable parent."""
     run = _CURRENT_RUN.get()
     if run is None:
         yield None
         return
-    s = run._open_span(name, _CURRENT_SPAN.get())
+    s = run._open_span(name, None if root else _CURRENT_SPAN.get())
     if attrs:
         s.attrs.update(attrs)
     token = _CURRENT_SPAN.set(s)

@@ -176,6 +176,14 @@ class RecompilationSentinel:
                         "jit-cache entries (compile-time upper bound)"
                     ),
                 ).observe(wall_seconds)
+                # The cold-start SLO signal (telemetry.slo): the same
+                # wall-seconds upper bound, into the mergeable sketch
+                # the burn-rate engine evaluates.
+                from yuma_simulation_tpu.telemetry.slo import (
+                    observe_duration,
+                )
+
+                observe_duration("compile_seconds", wall_seconds)
             except Exception:
                 pass
         if self.new_entries > self.budget:
