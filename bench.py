@@ -59,6 +59,7 @@ import argparse
 import json
 import time
 from functools import partial
+from typing import Optional
 
 import numpy as np
 
@@ -135,15 +136,27 @@ def _time_best(run, n, max_n=MAX_EPOCHS, granularity=1, label=None):
     return rate
 
 
-@partial(jax.jit, static_argnames=("spec", "reps", "epoch_impl"))
-def _true_weights_reps(W_e, S_e, config, spec, reps, epoch_impl):
+@partial(
+    jax.jit,
+    static_argnames=("spec", "reps", "epoch_impl", "capture_numerics"),
+)
+def _true_weights_reps(
+    W_e, S_e, config, spec, reps, epoch_impl, capture_numerics=False
+):
     """`reps` sequential passes over a true per-epoch-weights workload
     (`W_e [E, V, M]`, `S_e [E, V]`) inside ONE dispatch, so the remote
     tunnel's per-call milliseconds amortize away. Each pass scales the
     stakes by a fresh near-1 factor: numerically neutral (the kernel
     normalizes stakes per epoch) but the operands differ, so XLA cannot
     CSE the passes into one; the accumulator chains them so none is
-    dead-code-eliminated."""
+    dead-code-eliminated.
+
+    `capture_numerics=True` is the numerics-overhead twin (XLA rung
+    only): the in-scan per-epoch sketch capture (telemetry.numerics)
+    rides the same program, its leaves folded into the accumulator
+    through a `* 0.0` (f32 `x * 0` is not foldable — NaN/Inf
+    semantics — so XLA cannot dead-code-eliminate the capture while
+    the measured value stays bit-identical)."""
     from yuma_simulation_tpu.ops.pallas_epoch import fused_case_scan
     from yuma_simulation_tpu.simulation.engine import fused_hparams
 
@@ -167,8 +180,15 @@ def _true_weights_reps(W_e, S_e, config, spec, reps, epoch_impl):
             ys = _simulate_scan(
                 W_e, S_r, ri, ri, config, spec,
                 save_bonds=False, save_incentives=False,
+                capture_numerics=capture_numerics,
             )
             acc = acc + ys["dividends"].sum()
+            if capture_numerics:
+                live = sum(
+                    jnp.sum(leaf.astype(W_e.dtype))
+                    for leaf in jax.tree.leaves(ys["numerics"])
+                )
+                acc = acc + live * jnp.asarray(0.0, W_e.dtype)
         return acc, scale * 1.0000001
 
     acc, _ = lax.fori_loop(
@@ -356,6 +376,36 @@ def _bench(args) -> None:
         1,
     )
 
+    # Numerics-capture overhead (0.14.0): the SAME true-weights XLA
+    # workload with the in-scan per-epoch sketch capture ON — finite
+    # fraction, min/max/absmax, bit-cast-u32 fingerprint per epoch
+    # (telemetry.numerics, kept live against DCE inside the jit). The
+    # acceptance bar is < 5% epochs/s overhead; perfgate gates
+    # `numerics.overhead_frac` against that bar (cv-widened) on every
+    # capture, structural lane included.
+    def true_weights_numerics(n):
+        reps = max(1, n // true_e)
+        return _true_weights_reps(
+            W_e, S_e, config, spec, reps, "xla", capture_numerics=True
+        )
+
+    numerics_on = _time_best(
+        true_weights_numerics, true_e, granularity=true_e,
+        label="true_weights_xla_numerics",
+    )
+    secondary["true_weights_xla_numerics"] = round(numerics_on, 1)
+    numerics_off = secondary["true_weights_xla"]
+    numerics_overhead = {
+        "workload": "true_weights_xla",
+        "epochs_per_sec_off": numerics_off,
+        "epochs_per_sec_on": round(numerics_on, 1),
+        "overhead_frac": (
+            round(1.0 - numerics_on / numerics_off, 4)
+            if numerics_off
+            else None
+        ),
+    }
+
     # DOUBLE-BUFFERED chunked streaming: the beyond-HBM workload shape —
     # a 10k-epoch [E, V, M] stack would be ~41 GiB, so only ~2 slabs may
     # be live at a time. simulate_streamed now overlaps slab k+1's
@@ -490,7 +540,8 @@ def _bench(args) -> None:
 
     if not args.no_history:
         _append_history(line, primary_impl, primary, smoke=args.smoke,
-                        skip_costs=args.skip_costs, history=args.history)
+                        skip_costs=args.skip_costs, history=args.history,
+                        numerics=numerics_overhead)
 
 
 def _append_history(
@@ -501,6 +552,7 @@ def _append_history(
     smoke: bool,
     skip_costs: bool,
     history: str,
+    numerics: Optional[dict] = None,
 ) -> dict:
     """One richer record per run into the JSONL history perfgate gates
     on: the stdout fields + per-metric dispersion + the AOT cost report
@@ -548,6 +600,9 @@ def _append_history(
         "cv": {k: v for k, v in sorted(_CVS.items())},
         "costs": costs,
         "rooflines": rooflines,
+        # Numerics-capture overhead (in-scan sketch capture on vs off
+        # over the same workload) — a tracked, perfgate-gated metric.
+        "numerics": numerics if numerics is not None else {},
         # Declared floors for perfgate's attained-fraction gate: the
         # distance-to-ceiling itself is gated, not just absolute rates.
         "attained_floor": dict(ATTAINED_FLOORS),

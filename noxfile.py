@@ -98,6 +98,7 @@ TEST_CHUNKS = [
         "tests/unit/test_serve.py",
         "tests/unit/test_slo.py",
         "tests/unit/test_propagation.py",
+        "tests/unit/test_numerics.py",
     ],
 ]
 
@@ -131,10 +132,14 @@ def chaos(session: nox.Session) -> None:
     # the working tree.
     import os
 
+    bundle = os.path.join(session.create_tmp(), "chaos-bundle")
     session.run(
-        "python", "-m", "tools.obsreport",
-        os.path.join(session.create_tmp(), "chaos-bundle"),
-        "--drill", "--check",
+        "python", "-m", "tools.obsreport", bundle, "--drill", "--check",
+    )
+    # The numerics drift gate: the unfaulted drill bundle's per-epoch
+    # fingerprint stream must compare drift-clean.
+    session.run(
+        "python", "-m", "tools.driftreport", bundle, "--check", "--require",
     )
 
 
@@ -168,6 +173,10 @@ def fleet(session: nox.Session) -> None:
         "python", "-m", "tools.sloreport",
         os.path.join(bundle, "store"), "--check", "--require",
     )
+    session.run(
+        "python", "-m", "tools.driftreport",
+        os.path.join(bundle, "store"), "--check", "--require",
+    )
 
 
 @nox.session
@@ -192,6 +201,22 @@ def serve(session: nox.Session) -> None:
     session.run("python", "-m", "tools.obsreport", bundle, "--check")
     session.run(
         "python", "-m", "tools.sloreport", bundle, "--check", "--require"
+    )
+    session.run(
+        "python", "-m", "tools.driftreport", bundle, "--check", "--require"
+    )
+
+
+@nox.session
+def drift(session: nox.Session) -> None:
+    """Numerics drift lane (mirrors the CI driftreport gates): the
+    numerics flight-recorder battery — sketch invariance property tests
+    (monolithic == streamed == sharded, bitwise), the injected-DriftFault
+    end-to-end drill (engine_drift ledger event, driftreport exit != 0,
+    serve /healthz degraded), and resume survival of numerics.jsonl."""
+    session.install("-e", ".[test]")
+    session.run(
+        "python", "-m", "pytest", "tests/unit/test_numerics.py", "-q"
     )
 
 

@@ -327,6 +327,14 @@ def _history_record(value, cv=0.02, smoke=False, backend="cpu", t=0.0,
         "secondary": tracked,
         "cv": {"primary": cv}, "costs": costs, "rooflines": {},
         "attained_floor": {"xla": 0.001},
+        # The 0.14.0 schema: the numerics-capture overhead is a
+        # first-class gated metric (structural + ceiling gates).
+        "numerics": {
+            "workload": "true_weights_xla",
+            "epochs_per_sec_off": value / 10,
+            "epochs_per_sec_on": value / 10 * 0.99,
+            "overhead_frac": 0.01,
+        },
     }
     record.update(overrides)
     return record

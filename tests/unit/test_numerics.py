@@ -1,0 +1,627 @@
+"""Numerics flight recorder: per-epoch tensor-stat telemetry, the
+cross-engine drift canary, and the driftreport gate — ISSUE 10
+acceptance battery.
+
+Covers the capture half (fingerprint algebra, sketch invariance across
+monolithic / streamed-all-chunkings / sharded execution), the
+comparison half (supervisor + serve canaries, the typed `engine_drift`
+ledger event, the drift SLO), the gate (`tools/driftreport --check`
+exit codes on clean vs drifted bundles), the bundle-stream contract
+(numerics.jsonl survives a failed/resumed sweep), the one-switch
+disable (`YUMA_NUMERICS=0`), and the zero-warm-repeat-compile pin."""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tests.conftest import HAS_JAX_SHARD_MAP
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.resilience import (
+    DriftFault,
+    FaultPlan,
+    RetryPolicy,
+    SweepSupervisor,
+    inject_faults,
+)
+from yuma_simulation_tpu.scenarios import get_cases
+from yuma_simulation_tpu.simulation.engine import simulate, simulate_streamed
+
+VERSION = "Yuma 1 (paper)"
+POLICY = RetryPolicy(max_attempts_per_rung=2, backoff_base=0.0, seed=0)
+
+SKETCH_FIELDS = ("finite_frac", "lo", "hi", "absmax", "fingerprint")
+
+
+def _supervisor(directory=None, **kw):
+    kw.setdefault("unit_size", 2)
+    kw.setdefault("deadline", None)
+    kw.setdefault("retry_policy", POLICY)
+    return SweepSupervisor(directory=directory, **kw)
+
+
+def _assert_sketches_equal(a: dict, b: dict, streams=None) -> None:
+    keys = streams if streams is not None else (set(a) & set(b))
+    assert keys, "no overlapping numerics streams to compare"
+    for stream in keys:
+        for field in SKETCH_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a[stream], field)),
+                np.asarray(getattr(b[stream], field)),
+                err_msg=f"{stream}.{field} not bitwise identical",
+            )
+
+
+# ------------------------------------------------------- fingerprint ops
+
+
+def test_fingerprint_is_order_independent_and_ulp_sensitive():
+    """The wrapping-u32 bit sum is partition-invariant by construction
+    (integer addition commutes exactly), and a single-ulp flip moves
+    the fingerprint by EXACTLY 1 — the property driftreport's
+    ulp-distance render rests on."""
+    from yuma_simulation_tpu.ops.fingerprint import (
+        fingerprint_u32,
+        flip_ulp,
+        ulp_delta,
+    )
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.random((16, 32)), jnp.float32)
+    full = int(fingerprint_u32(x))
+    # Any re-partitioning of the reduction produces the same u32 sum.
+    by_rows = int(jnp.sum(fingerprint_u32(x, axes=(1,)), dtype=jnp.uint32))
+    shuffled = int(fingerprint_u32(x.ravel()[::-1]))
+    assert full == by_rows == shuffled
+    # One-ulp flip of one element: delta exactly +1.
+    flipped = x.at[3, 5].set(flip_ulp(x[3, 5]))
+    assert ulp_delta(full, int(fingerprint_u32(flipped))) == 1
+    # ulp_delta is signed and minimal-magnitude mod 2^32.
+    assert ulp_delta(5, 3) == -2
+    assert ulp_delta(0, (1 << 32) - 1) == -1
+
+
+def test_epoch_sketch_stats_handle_nonfinite():
+    """finite_frac carries the failure signal while the masked min/max/
+    absmax stay informative (a NaN-poisoned epoch must not read as
+    min=nan, absmax=nan)."""
+    from yuma_simulation_tpu.telemetry.numerics import epoch_sketch
+
+    x = jnp.asarray([1.0, -2.0, np.nan, np.inf], jnp.float32)
+    sk = epoch_sketch(x)
+    assert float(sk.finite_frac) == pytest.approx(0.5)
+    assert float(sk.lo) == -2.0
+    assert float(sk.hi) == 1.0
+    assert float(sk.absmax) == 2.0
+
+
+def test_first_divergence_and_diff_records():
+    from yuma_simulation_tpu.telemetry.numerics import (
+        diff_records,
+        first_divergence,
+    )
+
+    assert first_divergence([1, 2, 3], [1, 2, 3]) is None
+    assert first_divergence([1, 2, 3], [1, 5, 3]) == (1, 3)
+    # Length mismatch diverges at the shorter length.
+    assert first_divergence([1, 2], [1, 2, 3]) == (2, 0)
+    primary = {"fingerprint": [[1, 2], [3, 4]], "lanes": [0, 2]}
+    canary = {"fingerprint": [[1, 2], [3, 5]], "lanes": [0, 2]}
+    out = diff_records(primary, canary)
+    assert out == [
+        {"lane": 1, "first_divergent_epoch": 1, "ulp_distance": 1}
+    ]
+
+
+# ------------------------------------------------ sketch invariance
+
+
+def test_sketches_bitwise_invariant_monolithic_streamed_sharded():
+    """The ISSUE 10 invariance property: per-epoch stats + fingerprints
+    are bitwise identical across monolithic, chunk-streamed (several
+    chunkings, aligned and ragged) and miner-sharded execution of the
+    same case — every sketch reduction is exact and order-independent,
+    so the merge is concatenation and the psum is the unsharded sum."""
+    case = get_cases()[0]
+    cfg = YumaConfig()
+    mono = simulate(case, VERSION, cfg)
+    assert mono.numerics is not None
+    assert set(mono.numerics) == {"dividends", "consensus"}
+
+    W = np.asarray(case.weights, np.float32)
+    S = np.asarray(case.stakes, np.float32)
+    E = W.shape[0]
+
+    def gen(chunk):
+        for lo in range(0, E, chunk):
+            yield (W[lo : lo + chunk], S[lo : lo + chunk])
+
+    for chunk in (E, 8, 7, 3):  # monolithic-as-one-chunk, even, ragged
+        streamed = simulate_streamed(gen(chunk), VERSION, cfg)
+        assert streamed.numerics is not None
+        _assert_sketches_equal(mono.numerics, streamed.numerics)
+
+    if HAS_JAX_SHARD_MAP:
+        from yuma_simulation_tpu.parallel import make_mesh
+
+        sharded = simulate(case, VERSION, cfg, mesh=make_mesh())
+        assert sharded.numerics is not None
+        _assert_sketches_equal(mono.numerics, sharded.numerics)
+
+
+@pytest.mark.skipif(
+    not HAS_JAX_SHARD_MAP, reason="needs jax.shard_map (jax>=0.7)"
+)
+def test_batch_sketches_bitwise_invariant_under_scenario_sharding():
+    """simulate_batch_sharded's gathered numerics pytree is bitwise the
+    unsharded vmap's — the shard-invariant merge the sharded layer
+    advertises."""
+    from yuma_simulation_tpu.models.variants import variant_for_version
+    from yuma_simulation_tpu.parallel import make_mesh
+    from yuma_simulation_tpu.parallel.sharded import simulate_batch_sharded
+    from yuma_simulation_tpu.simulation.sweep import (
+        simulate_batch,
+        stack_scenarios,
+    )
+
+    cases = get_cases()[:4]
+    cfg = YumaConfig()
+    W, S, ri, re = stack_scenarios(cases)
+    solo = simulate_batch(
+        W, S, ri, re, cfg, variant_for_version(VERSION)
+    )
+    sharded = simulate_batch_sharded(
+        cases, VERSION, cfg, mesh=make_mesh()
+    )
+    assert "numerics" in sharded
+    _assert_sketches_equal(solo["numerics"], sharded["numerics"])
+
+
+def test_numerics_env_switch_disables_capture(monkeypatch):
+    """The one config/env switch: YUMA_NUMERICS=0 turns the whole
+    stream off — engines return no sketches, supervisors write no
+    records."""
+    monkeypatch.setenv("YUMA_NUMERICS", "0")
+    from yuma_simulation_tpu.telemetry.numerics import numerics_enabled
+
+    assert not numerics_enabled()
+    res = simulate(get_cases()[0], VERSION, YumaConfig())
+    assert res.numerics is None
+    out = _supervisor(canary_fraction=1.0).run_batch(
+        get_cases()[:2], VERSION
+    )
+    assert out["numerics_records"] == []
+
+
+# --------------------------------------------- supervisor canary + gate
+
+
+def test_supervisor_canary_clean_and_bundle_stream(tmp_path):
+    """A canaried supervised sweep: every selected unit re-executes on
+    the demoted rung, compares bitwise clean, ledgers one unit_canary
+    per canary, publishes primary+canary numerics records, and passes
+    both check_bundle and driftreport --check."""
+    from tools.driftreport import main as driftreport_main
+    from yuma_simulation_tpu.telemetry.flight import (
+        check_bundle,
+        load_bundle,
+    )
+
+    cases = get_cases()[:4]
+    out = _supervisor(tmp_path, canary_fraction=1.0).run_batch(
+        cases, VERSION
+    )
+    rep = out["report"]
+    assert rep.canaries_run == 2 and rep.drift_events == 0 and rep.clean
+    bundle = load_bundle(tmp_path)
+    assert check_bundle(bundle) == []
+    roles = {(r["unit"], r["role"], r["stream"]) for r in bundle.numerics}
+    assert {(0, "primary", "dividends"), (0, "canary", "dividends")} <= roles
+    canaries = [
+        r for r in bundle.ledger if r.get("event") == "unit_canary"
+    ]
+    assert len(canaries) == 2
+    assert all(r["drift_streams"] == 0 for r in canaries)
+    assert driftreport_main([str(tmp_path), "--check", "--require"]) == 0
+
+
+def test_supervisor_canary_fraction_strides_deterministically():
+    cases = get_cases()[:4]  # 2 units at unit_size=2
+    out = _supervisor(canary_fraction=0.5).run_batch(cases, VERSION)
+    assert out["report"].canaries_run == 1  # unit 0 only
+    out = _supervisor(canary_fraction=0.0).run_batch(cases, VERSION)
+    assert out["report"].canaries_run == 0
+
+
+@pytest.mark.faultinject
+def test_drift_drill_end_to_end(tmp_path):
+    """THE acceptance drill: an injected single-ulp DriftFault in one
+    lane produces a typed engine_drift ledger event localizing the
+    exact (lane, first divergent epoch, ulp distance), a degraded
+    report, a fast-burning engine_drift SLO, and driftreport --check
+    exit != 0 — while healthy streams stay bitwise clean."""
+    from tools.driftreport import main as driftreport_main
+    from yuma_simulation_tpu.telemetry.flight import (
+        check_bundle,
+        load_bundle,
+    )
+    from yuma_simulation_tpu.telemetry.slo import (
+        get_slo_engine,
+        set_slo_engine,
+    )
+
+    previous = set_slo_engine(None)  # fresh engine for the drill
+    try:
+        cases = get_cases()[:4]
+        with inject_faults(FaultPlan(drift=DriftFault(epoch=5, case=1))):
+            out = _supervisor(tmp_path, canary_fraction=1.0).run_batch(
+                cases, VERSION
+            )
+        rep = out["report"]
+        assert rep.canaries_run == 2
+        assert rep.drift_events == 2  # one per unit's dividends stream
+        assert not rep.clean
+        bundle = load_bundle(tmp_path)
+        assert check_bundle(bundle) == []  # drift is consistent, not rot
+        drifts = [
+            r for r in bundle.ledger if r.get("event") == "engine_drift"
+        ]
+        assert len(drifts) == 2
+        # Unit 1 (lanes [2, 4)) local lane 1 -> GLOBAL lane 3; the flip
+        # at epoch 5 is localized with ulp distance exactly 1.
+        assert drifts[1]["stream"] == "dividends"
+        assert drifts[1]["lanes"] == [[3, 5, 1]]
+        # The drift SLO fast-burns on the bad canary events.
+        assert get_slo_engine().state("engine_drift") == "fast_burn"
+        # The gate fails the bundle.
+        assert driftreport_main([str(tmp_path), "--check"]) == 1
+    finally:
+        set_slo_engine(previous)
+
+
+@pytest.mark.faultinject
+def test_drift_fault_inert_outside_canary_scope(tmp_path):
+    """The DriftFault fires ONLY inside canary re-executions: with no
+    canaries armed, an armed plan perturbs nothing (primaries trace the
+    exact production program) and the sweep stays bitwise clean."""
+    cases = get_cases()[:2]
+    clean = _supervisor().run_batch(cases, VERSION)
+    with inject_faults(FaultPlan(drift=DriftFault(epoch=5))):
+        armed = _supervisor().run_batch(cases, VERSION)
+    np.testing.assert_array_equal(clean["dividends"], armed["dividends"])
+    assert armed["report"].clean
+
+
+def test_numerics_stream_survives_failed_and_resumed_sweep(tmp_path):
+    """The bundle-stream contract: a resumed sweep keeps the prior
+    run's numerics records for units it never re-executed, and a
+    requeued (torn-chunk) unit's re-capture REPLACES its records
+    instead of duplicating them — exactly like costs.jsonl."""
+    from yuma_simulation_tpu.telemetry.flight import load_bundle
+
+    cases = get_cases()[:4]
+    _supervisor(tmp_path, canary_fraction=1.0).run_batch(cases, VERSION)
+    first = {
+        (r["unit"], r["role"], r["stream"]): r["fingerprint"]
+        for r in load_bundle(tmp_path).numerics
+    }
+    assert len(first) == 8  # 2 units x 2 roles x 2 streams
+
+    # Tear unit 1's chunk: the resume requeues EXACTLY that unit.
+    (tmp_path / "chunk_00001.npz").write_bytes(b"torn")
+    _supervisor(tmp_path, canary_fraction=1.0).run_batch(cases, VERSION)
+    bundle = load_bundle(tmp_path)
+    second = {
+        (r["unit"], r["role"], r["stream"]): r["fingerprint"]
+        for r in bundle.numerics
+    }
+    # No duplicates, nothing lost, and the re-executed capture is
+    # bitwise the original (units are pure).
+    assert second == first
+    requeues = [
+        r for r in bundle.ledger if r.get("event") == "unit_requeued"
+    ]
+    assert {r["unit"] for r in requeues} == {1}
+
+
+def test_append_numerics_is_append_only_and_merge_heals(tmp_path):
+    """The long-lived-server flush path: `append_numerics` appends
+    without rewriting the file (O(batch) on a handler thread), and the
+    next full `record_numerics` merge dedupes appended duplicates by
+    identity — the `append_spans` contract on the numerics stream."""
+    from yuma_simulation_tpu.telemetry.flight import (
+        FlightRecorder,
+        load_bundle,
+    )
+
+    rec = {
+        "unit": 0, "lanes": [0, 1], "stream": "dividends",
+        "engine": "xla", "role": "primary", "label": "t", "epochs": 1,
+        "fingerprint": [[7]],
+    }
+    recorder = FlightRecorder(tmp_path)
+    recorder.append_numerics([rec])
+    recorder.append_numerics([rec])  # duplicate identity, appended
+    assert len(load_bundle(tmp_path).numerics) == 2
+    recorder.record_numerics([], run_id="run-x")  # the close-time merge
+    assert len(load_bundle(tmp_path).numerics) == 1
+
+
+# ------------------------------------------------------- serve canary
+
+
+@pytest.mark.faultinject
+def test_serve_canary_drift_degrades_healthz_and_trips_breaker(tmp_path):
+    """The serving half of the drill: a DriftFault during the
+    background canary tick yields a typed engine_drift ledger event,
+    /healthz degraded (the engine_drift SLO fast-burns), a tripped
+    primary-rung breaker, and driftreport --check exit != 0 on the
+    serve bundle — while an unfaulted tick stays drift-clean."""
+    from tools.driftreport import main as driftreport_main
+    from yuma_simulation_tpu.serve.service import (
+        ServeConfig,
+        SimulationService,
+    )
+    from yuma_simulation_tpu.telemetry.slo import set_slo_engine
+
+    previous = set_slo_engine(None)
+    service = SimulationService(
+        ServeConfig(
+            bundle_dir=str(tmp_path),
+            warmup_shapes=((6, 3, 2),),
+            breaker_threshold=1,
+            start_dispatcher=False,
+        )
+    )
+    try:
+        state = service.run_canary_once()
+        assert state == {"ticks": 1, "drift": 0, "last_bucket": "6x3x2"}
+        assert service.healthz()["status"] == "ok"
+
+        with inject_faults(FaultPlan(drift=DriftFault(epoch=2))):
+            state = service.run_canary_once()
+        assert state["drift"] >= 1
+        h = service.healthz()
+        assert h["status"] == "degraded"
+        assert "engine_drift" in h["slo"]["fast_burn"]
+        assert h["breaker"]["xla"]["state"] == "open"
+        assert h["canary"]["drift"] >= 1
+        drifts = service.ledger.entries("engine_drift")
+        assert drifts and drifts[0]["bucket"] == "6x3x2"
+        assert drifts[0]["lanes"][0][1] == 2  # first divergent epoch
+    finally:
+        service.close()
+        set_slo_engine(previous)
+    assert driftreport_main([str(tmp_path), "--check", "--require"]) == 1
+
+
+def test_serve_request_populates_numerics_and_canary_bucket(tmp_path):
+    """A real request both stashes its supervised dispatch's numerics
+    records into the bundle and registers its shape as a canary
+    bucket; the clean bundle passes driftreport."""
+    from tools.driftreport import main as driftreport_main
+    from yuma_simulation_tpu.serve.service import (
+        ServeConfig,
+        SimulationService,
+    )
+
+    service = SimulationService(ServeConfig(bundle_dir=str(tmp_path)))
+    try:
+        status, body, _headers = service.handle(
+            "simulate", {"case": "Case 1", "tenant": "t"}
+        )
+        assert status == 200 and body["status"] == "ok"
+        snap = service._canary_snapshot()
+        assert snap["buckets"] >= 1
+        assert service.run_canary_once()["drift"] == 0
+    finally:
+        service.close()
+    assert (tmp_path / "numerics.jsonl").exists()
+    assert driftreport_main([str(tmp_path), "--check", "--require"]) == 0
+
+
+# ------------------------------------------------------ fleet + report
+
+
+def test_fleet_canary_counts_and_unit_engines(tmp_path):
+    """FleetHealthReport surfaces per-unit executed engine rungs and
+    the canary/drift counts derived from the merged ledgers; the store
+    passes check_fleet and driftreport."""
+    from tools.driftreport import main as driftreport_main
+    from yuma_simulation_tpu.fabric.health import check_fleet
+    from yuma_simulation_tpu.fabric.scheduler import (
+        FleetConfig,
+        run_fleet_batch,
+    )
+
+    out = run_fleet_batch(
+        get_cases()[:4],
+        VERSION,
+        FleetConfig(
+            directory=tmp_path, unit_size=2, canary_fraction=1.0
+        ),
+    )
+    rep = out["report"]
+    assert rep.canaries_run == 2 and rep.drift_events == 0
+    assert rep.unit_engines == ((0, "xla"), (1, "xla"))
+    assert rep.clean
+    assert check_fleet(tmp_path) == []
+    assert (
+        driftreport_main([str(tmp_path), "--check", "--require"]) == 0
+    )
+
+
+def test_fleet_canary_fraction_strides_at_fleet_scope(tmp_path):
+    """The stride selection happens at FLEET scope: a fraction of 0.5
+    over 4 fleet units canaries exactly 2 of them — not all 4, which is
+    what per-unit local supervisors (each seeing only local idx 0)
+    would do on their own."""
+    from yuma_simulation_tpu.fabric.scheduler import (
+        FleetConfig,
+        _fleet_canary_fraction,
+        run_fleet_batch,
+    )
+
+    assert [_fleet_canary_fraction(0.5, i) for i in range(4)] == [
+        1.0, 0.0, 1.0, 0.0,
+    ]
+    assert [_fleet_canary_fraction(0.0, i) for i in range(4)] == [0.0] * 4
+    out = run_fleet_batch(
+        get_cases()[:4],
+        VERSION,
+        FleetConfig(
+            directory=tmp_path, unit_size=1, canary_fraction=0.5
+        ),
+    )
+    assert out["report"].canaries_run == 2
+
+
+def test_fleet_report_cross_check_catches_canary_tampering(tmp_path):
+    """The canary counts are CROSS-CHECKED: a published fleet report
+    whose canaries_run disagrees with the merged ledgers fails
+    check_fleet (the counts are auditable, not decorative)."""
+    from yuma_simulation_tpu.fabric.health import check_fleet
+    from yuma_simulation_tpu.fabric.scheduler import (
+        FleetConfig,
+        run_fleet_batch,
+    )
+    from yuma_simulation_tpu.fabric.store import FLEET_REPORT_NAME
+
+    run_fleet_batch(
+        get_cases()[:2],
+        VERSION,
+        FleetConfig(directory=tmp_path, unit_size=2, canary_fraction=1.0),
+    )
+    report_path = pathlib.Path(tmp_path) / FLEET_REPORT_NAME
+    rec = json.loads(report_path.read_text())
+    rec["canaries_run"] = 99
+    report_path.write_text(json.dumps(rec))
+    problems = check_fleet(tmp_path)
+    assert any("canaries_run" in p for p in problems)
+
+
+# ----------------------------------------------------- gate + SLO units
+
+
+def test_driftreport_expected_class_renders_but_passes(tmp_path):
+    """A canary record stamped `expected` (the codified u16-fallback
+    pairing class, ADVICE r5) renders as drift but does NOT fail the
+    gate — codified-accepted, not silently dropped."""
+    from tools.driftreport import main as driftreport_main
+
+    records = [
+        {
+            "unit": 0, "lanes": [0, 1], "stream": "dividends",
+            "engine": "fused_scan", "role": "primary", "label": "t",
+            "epochs": 3, "fingerprint": [[1, 2, 3]],
+            "finite_frac": [[1, 1, 1]], "min": [[0, 0, 0]],
+            "max": [[1, 1, 1]], "absmax": [[1, 1, 1]],
+        },
+        {
+            "unit": 0, "lanes": [0, 1], "stream": "dividends",
+            "engine": "xla", "role": "canary", "label": "t",
+            "epochs": 3, "fingerprint": [[1, 2, 4]],
+            "finite_frac": [[1, 1, 1]], "min": [[0, 0, 0]],
+            "max": [[1, 1, 1]], "absmax": [[1, 1, 1]],
+            "expected": "u16-quantize fallback pairing",
+        },
+    ]
+    (tmp_path / "numerics.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in records)
+    )
+    assert driftreport_main([str(tmp_path), "--check"]) == 0
+    # Strip the expected stamp: the same divergence now fails.
+    del records[1]["expected"]
+    (tmp_path / "numerics.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in records)
+    )
+    assert driftreport_main([str(tmp_path), "--check"]) == 1
+
+
+def test_driftreport_malformed_records_exit_2(tmp_path):
+    from tools.driftreport import main as driftreport_main
+
+    (tmp_path / "numerics.jsonl").write_text(
+        json.dumps({"unit": 0, "role": "primary"}) + "\n"
+    )
+    assert driftreport_main([str(tmp_path), "--check"]) == 2
+
+
+def test_driftreport_require_flags_missing_stream(tmp_path):
+    from tools.driftreport import main as driftreport_main
+
+    assert driftreport_main([str(tmp_path), "--check"]) == 0
+    assert driftreport_main([str(tmp_path), "--check", "--require"]) == 1
+
+
+def test_engine_drift_slo_fast_burns_on_single_event():
+    """The drift SLOSpec is min_events=1 by design: ONE confirmed drift
+    is an incident (the stream carries only deliberate canary
+    comparisons), and recovery un-flips it when the window passes."""
+    from yuma_simulation_tpu.telemetry.slo import (
+        DEFAULT_SLO_SPECS,
+        SLOEngine,
+    )
+
+    spec = next(s for s in DEFAULT_SLO_SPECS if s.name == "engine_drift")
+    assert spec.degrade and spec.min_events == 1
+    clock = [1000.0]
+    engine = SLOEngine(DEFAULT_SLO_SPECS, clock=lambda: clock[0])
+    engine.event("engine_drift_ok", True)
+    assert engine.state("engine_drift") == "ok"
+    engine.event("engine_drift_ok", False)
+    assert engine.state("engine_drift") == "fast_burn"
+    assert "engine_drift" in engine.degraded()
+    clock[0] += spec.slow_window_seconds + 10
+    assert engine.state("engine_drift") == "ok"
+
+
+def test_planner_records_expected_drift_reason_for_explicit_fused():
+    """An EXPLICIT fused opt-in beyond the int32 dyadic bound plans
+    with the documented accepted-drift caveat recorded; auto refuses
+    the pairing outright (the eligibility gate)."""
+    from yuma_simulation_tpu.simulation.planner import (
+        EXPECTED_DRIFT_U16_FALLBACK,
+        plan_dispatch,
+    )
+
+    plan = plan_dispatch(
+        "t", (4, 4, 16384), VERSION, YumaConfig(), jnp.float32,
+        epoch_impl="fused_scan", check_memory=False,
+    )
+    assert EXPECTED_DRIFT_U16_FALLBACK in plan.reasons
+    auto = plan_dispatch(
+        "t", (4, 4, 16384), VERSION, YumaConfig(), jnp.float32,
+        epoch_impl="auto", check_memory=False,
+    )
+    assert auto.engine == "xla"
+
+
+# ------------------------------------------------------- compile budget
+
+
+def test_canaried_sweep_warm_repeat_is_compile_free():
+    """The capture is part of the one traced program and the canary
+    re-uses the demoted rung's existing cache entry: a warm canaried
+    sweep adds ZERO jit-cache entries (the existing pins in
+    test_recompilation.py stay untouched; this pins the NEW path)."""
+    from yuma_simulation_tpu.simulation.engine import _simulate_scan
+    from yuma_simulation_tpu.simulation.sweep import _simulate_batch_xla
+    from yuma_simulation_tpu.utils.profiling import RecompilationSentinel
+
+    cases = get_cases()[:4]
+    sup = _supervisor(canary_fraction=1.0)
+    sup.run_batch(cases, VERSION)  # warm-up (cold compiles allowed)
+    with RecompilationSentinel(
+        _simulate_batch_xla,
+        _simulate_scan,
+        budget=0,
+        label="canaried sweep warm repeat",
+    ) as sentinel:
+        out = sup.run_batch(cases, VERSION)
+    assert sentinel.new_entries == 0
+    assert out["report"].canaries_run == 2

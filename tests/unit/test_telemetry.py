@@ -542,6 +542,8 @@ def test_chaos_drill_bundle_reconstructs_timeline(tmp_path, caplog):
         "engine_demotions": report.engine_demotions,
         "mesh_shrinks": report.mesh_shrinks,
         "lanes_quarantined": report.lanes_quarantined,
+        "canaries_run": report.canaries_run,
+        "drift_events": report.drift_events,
     }
 
     # the timeline reconstructs: one sweep root, the stalled attempt's

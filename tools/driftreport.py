@@ -1,0 +1,308 @@
+"""driftreport: render and gate a flight bundle's numerics stream.
+
+The comparison half of the numerics flight recorder
+(:mod:`yuma_simulation_tpu.telemetry.numerics` captures per-epoch
+tensor stats + bit-cast-u32 reduction fingerprints inside the jitted
+engines; this CLI reads the ``numerics.jsonl`` those captures publish
+into every flight bundle and compares primary records against their
+cross-engine canary re-executions). For each (unit, stream, label)
+group it localizes the FIRST DIVERGENT EPOCH and the per-lane ulp
+distance — a single-ulp lane flip moves the fingerprint delta by
+exactly 1, so the render reads in ulps, not abstract hash mismatches.
+
+Usage::
+
+    python -m tools.driftreport BUNDLE_DIR            # render captures
+    python -m tools.driftreport BUNDLE_DIR --check    # CI gate: exit 1
+                                                      # on any UNEXPLAINED
+                                                      # fingerprint
+                                                      # divergence, exit 2
+                                                      # on malformed
+                                                      # records
+    python -m tools.driftreport BUNDLE_DIR --json     # machine-readable
+
+``--check`` semantics: a canary record whose fingerprints diverge from
+its primary is confirmed cross-engine drift — the contract the paper's
+engines promise is BITWISE identity, so any divergence fails unless the
+canary record carries an ``expected`` field naming a documented
+accepted-drift class (one ships today: the u16-quantize fallback
+pairing of an EXPLICIT fused opt-in beyond the int32 dyadic bound —
+``simulation.planner.EXPECTED_DRIFT_U16_FALLBACK``, ADVICE r5; auto
+plans never pair those engines). A bundle
+with no ``numerics.jsonl`` passes with a note (pre-0.14.0 bundles stay
+valid) unless ``--require`` demands the stream. Fleet stores are
+detected automatically: every host bundle under ``hosts/`` is gated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(
+    0, str(pathlib.Path(__file__).resolve().parent.parent)
+)
+
+def load_numerics(directory: str | pathlib.Path) -> list[dict]:
+    """The bundle's ``numerics.jsonl`` records (tolerant reader)."""
+    from yuma_simulation_tpu.utils.checkpoint import read_jsonl_tolerant
+
+    return read_jsonl_tolerant(
+        pathlib.Path(directory) / "numerics.jsonl"
+    )
+
+
+def _group_key(rec: dict) -> tuple:
+    # `lanes` is part of the identity: a fleet unit's local supervisor
+    # may emit several sub-unit records all re-stamped with the same
+    # fleet unit index, distinguishable only by their lane windows —
+    # the same key spelling flight.check_bundle merges by.
+    return (
+        rec.get("unit"),
+        rec.get("label", ""),
+        rec.get("stream"),
+        tuple(rec.get("lanes") or ()),
+    )
+
+
+def check_records(records: list[dict]) -> list[str]:
+    """Structural rot in the records themselves (exit 2 class) — the
+    shared validator `telemetry.numerics.check_numerics_records`, so
+    this gate and `flight.check_bundle`'s cross-check can never
+    diverge."""
+    from yuma_simulation_tpu.telemetry.numerics import (
+        check_numerics_records,
+    )
+
+    return check_numerics_records(records)
+
+
+def diff_bundle(records: list[dict]) -> list[dict]:
+    """Every (unit, label, stream) group's primary-vs-canary verdict:
+    ``{"unit", "label", "stream", "primary_engine", "canary_engine",
+    "divergences": [{"lane", "first_divergent_epoch", "ulp_distance"}],
+    "expected", "unmatched"}``. A canary with no primary in its group is
+    reported ``unmatched`` (a comparison that never happened is not a
+    pass)."""
+    from yuma_simulation_tpu.telemetry.numerics import (
+        diff_records,
+        numerics_identity,
+    )
+
+    # Newest capture per identity wins FIRST — a live server's flushes
+    # append without the close-time merge, so a crashed-before-close
+    # bundle can hold superseded duplicates (e.g. a canary captured
+    # before the breaker re-anchored the primary rung); comparing those
+    # would fail a consistent system.
+    latest: dict[tuple, dict] = {}
+    for rec in records:
+        latest[numerics_identity(rec)] = rec
+    primaries: dict[tuple, dict] = {}
+    canaries: dict[tuple, list] = {}
+    for rec in latest.values():
+        key = _group_key(rec)
+        if rec.get("role") == "canary":
+            canaries.setdefault(key, []).append(rec)
+        else:
+            primaries[key] = rec
+    verdicts: list[dict] = []
+    for key in sorted(
+        canaries, key=lambda k: (str(k[1]), str(k[0]), str(k[2]), k[3])
+    ):
+        unit, label, stream, _lanes = key
+        primary = primaries.get(key)
+        for canary in canaries[key]:
+            verdict = {
+                "unit": unit,
+                "label": label,
+                "stream": stream,
+                "canary_engine": canary.get("engine"),
+                "expected": canary.get("expected"),
+            }
+            if primary is None:
+                verdict["unmatched"] = True
+                verdict["divergences"] = []
+            else:
+                lane0 = (primary.get("lanes") or [0, 0])[0]
+                divergences = diff_records(primary, canary)
+                for d in divergences:
+                    d["lane"] += lane0  # sweep-global lane index
+                verdict["unmatched"] = False
+                verdict["primary_engine"] = primary.get("engine")
+                verdict["divergences"] = divergences
+            verdicts.append(verdict)
+    return verdicts
+
+
+def render(directory: str, records: list[dict], verdicts: list[dict]) -> str:
+    lines = [f"drift report: {directory}"]
+    if not records:
+        lines.append(
+            "no numerics.jsonl recorded (pre-0.14.0 bundle, or "
+            "YUMA_NUMERICS=0 disabled capture)"
+        )
+        return "\n".join(lines)
+    primaries = sum(1 for r in records if r.get("role") != "canary")
+    lines.append(
+        f"  {len(records)} record(s): {primaries} primary, "
+        f"{len(records) - primaries} canary"
+    )
+    engines = sorted(
+        {r.get("engine") for r in records if r.get("engine")}
+    )
+    lines.append(f"  engines captured: {', '.join(engines)}")
+    if not verdicts:
+        lines.append("  no canary comparisons recorded")
+    for v in verdicts:
+        where = f"unit={v['unit']} label={v['label']!r} stream={v['stream']}"
+        if v["unmatched"]:
+            lines.append(f"  [?] {where}: canary with NO primary record")
+            continue
+        pair = f"{v.get('primary_engine')} vs {v['canary_engine']}"
+        if not v["divergences"]:
+            lines.append(f"  [ ] {where}: {pair} bitwise identical")
+            continue
+        flag = "~" if v.get("expected") else "!"
+        lines.append(
+            f"  [{flag}] {where}: {pair} DIVERGED"
+            + (f" (expected: {v['expected']})" if v.get("expected") else "")
+        )
+        for d in v["divergences"]:
+            lines.append(
+                f"        lane {d['lane']}: first divergent epoch "
+                f"{d['first_divergent_epoch']}, ulp distance "
+                f"{d['ulp_distance']:+d}"
+            )
+    return "\n".join(lines)
+
+
+def _targets(directory: str) -> list[tuple[str, pathlib.Path]]:
+    """The bundle directories to gate: the fleet store's per-host
+    bundles (plus the store root, where a driver may publish), or the
+    directory itself."""
+    from yuma_simulation_tpu.fabric.store import FleetStore, is_fleet_store
+
+    if is_fleet_store(directory):
+        store = FleetStore(directory)
+        targets = [
+            (f"host {host_id}", store.host_dir(host_id))
+            for host_id in store.host_ids()
+        ]
+        targets.append(("store", pathlib.Path(directory)))
+        return targets
+    return [("bundle", pathlib.Path(directory))]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="driftreport", description=__doc__.split("\n\n")[0]
+    )
+    parser.add_argument("directory", help="flight bundle or fleet store")
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 on any unexplained fingerprint divergence (or a "
+        "canary with no primary), exit 2 on malformed records",
+    )
+    parser.add_argument(
+        "--require",
+        action="store_true",
+        help="with --check: a missing numerics.jsonl in every target is "
+        "itself a failure",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the verdicts as JSON"
+    )
+    args = parser.parse_args(argv)
+
+    targets = _targets(args.directory)
+    all_records: dict[str, list] = {}
+    all_verdicts: dict[str, list] = {}
+    structural: list[str] = []
+    for label, path in targets:
+        records = load_numerics(path)
+        all_records[label] = records
+        structural.extend(f"{label}: {p}" for p in check_records(records))
+        all_verdicts[label] = diff_bundle(records)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    label: {
+                        "records": len(all_records[label]),
+                        "verdicts": all_verdicts[label],
+                    }
+                    for label, _ in targets
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        first = True
+        for label, path in targets:
+            if not first:
+                print()
+            first = False
+            print(
+                render(
+                    f"{label} ({path})",
+                    all_records[label],
+                    all_verdicts[label],
+                )
+            )
+    if args.check:
+        if structural:
+            print("\ndriftreport --check: MALFORMED records:", file=sys.stderr)
+            for p in structural:
+                print(f"  - {p}", file=sys.stderr)
+            return 2
+        failures: list[str] = []
+        for label, _path in targets:
+            for v in all_verdicts[label]:
+                if v["unmatched"]:
+                    failures.append(
+                        f"{label}: unit={v['unit']} stream={v['stream']} "
+                        "canary has no primary to compare against"
+                    )
+                elif v["divergences"] and not v.get("expected"):
+                    first_d = v["divergences"][0]
+                    failures.append(
+                        f"{label}: unit={v['unit']} stream={v['stream']} "
+                        f"{v.get('primary_engine')} vs {v['canary_engine']} "
+                        f"diverged at epoch "
+                        f"{first_d['first_divergent_epoch']} "
+                        f"(lane {first_d['lane']}, "
+                        f"ulp {first_d['ulp_distance']:+d})"
+                    )
+        recorded = sum(1 for recs in all_records.values() if recs)
+        if args.require and recorded == 0:
+            failures.append("no numerics.jsonl found in any target bundle")
+        if failures:
+            print("\ndriftreport --check FAILED:", file=sys.stderr)
+            for f in failures:
+                print(f"  - {f}", file=sys.stderr)
+            return 1
+        compared = sum(len(v) for v in all_verdicts.values())
+        expected = sum(
+            1
+            for vs in all_verdicts.values()
+            for v in vs
+            if v["divergences"] and v.get("expected")
+        )
+        print(
+            f"\ndriftreport --check: {recorded}/{len(targets)} target(s) "
+            f"recorded numerics; {compared} canary comparison(s), "
+            + (
+                f"{expected} expected-class divergence(s), none unexplained"
+                if expected
+                else "none diverged"
+            )
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

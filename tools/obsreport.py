@@ -161,7 +161,55 @@ def render(bundle, run_id: str | None) -> str:
     if perf:
         lines.append("")
         lines.extend(perf)
+    numerics = render_numerics(bundle)
+    if numerics:
+        lines.append("")
+        lines.extend(numerics)
     return "\n".join(lines)
+
+
+def render_numerics(bundle) -> list[str]:
+    """The numerics flight-recorder section: capture counts per role/
+    engine and a one-line verdict per canary comparison (the detailed
+    ulp/first-divergent-epoch render lives in ``tools/driftreport.py``,
+    which also gates ``--check``)."""
+    if not bundle.numerics:
+        return []
+    try:
+        from tools.driftreport import diff_bundle
+    except ImportError:  # executed as a bare script, not -m tools.*
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from driftreport import diff_bundle
+
+    roles: dict[str, int] = {}
+    for rec in bundle.numerics:
+        key = f"{rec.get('role', 'primary')}:{rec.get('engine', '?')}"
+        roles[key] = roles.get(key, 0) + 1
+    lines = [
+        "numerics (per-epoch tensor stats + fingerprints):",
+        "  records: "
+        + " ".join(f"{k}={v}" for k, v in sorted(roles.items())),
+    ]
+    for v in diff_bundle(bundle.numerics):
+        where = (
+            f"unit={v['unit']} stream={v['stream']}"
+            + (f" ({v['label']})" if v.get("label") else "")
+        )
+        if v["unmatched"]:
+            lines.append(f"  [?] {where}: canary with no primary")
+        elif v["divergences"]:
+            d = v["divergences"][0]
+            lines.append(
+                f"  [!] {where}: DRIFT at epoch "
+                f"{d['first_divergent_epoch']} (lane {d['lane']}, "
+                f"ulp {d['ulp_distance']:+d})"
+            )
+        else:
+            lines.append(
+                f"  [ ] {where}: canary bitwise identical "
+                f"({v.get('primary_engine')} vs {v['canary_engine']})"
+            )
+    return lines
 
 
 def render_plans(bundle, run_id: str) -> list[str]:
@@ -487,7 +535,7 @@ def render_fleet_units(store, merged: list) -> list[str]:
         extras = []
         if rec.get("generation"):
             extras.append(f"gen={rec['generation']}")
-        for key in ("stalls", "demotions", "mesh_shrinks"):
+        for key in ("stalls", "demotions", "mesh_shrinks", "canaries", "drifts"):
             if rec.get(key):
                 extras.append(f"{key}={rec[key]}")
         if rec.get("quarantined"):
@@ -565,6 +613,8 @@ def render_fleet(directory: str) -> str:
                 "engine_demotions",
                 "mesh_shrinks",
                 "lanes_quarantined",
+                "canaries_run",
+                "drift_events",
             )
         ),
         f"hosts: seen={list(report.hosts_seen)} "

@@ -50,8 +50,15 @@ DEFAULT_NOISE_MULT = 3.0
 #: measured/roofline fraction the attained gate enforces.
 REQUIRED_FIELDS = (
     "t", "backend", "smoke", "metric", "value", "unit", "secondary",
-    "cv", "costs", "rooflines", "attained_floor",
+    "cv", "costs", "rooflines", "attained_floor", "numerics",
 )
+
+#: The numerics-capture overhead ceiling (ISSUE 10 acceptance: the
+#: in-scan per-epoch sketch capture must cost < 5% epochs/s on the
+#: bench smoke line). Widened by the timing dispersion of the on/off
+#: pair exactly like the rolling-baseline tolerances — a noisy smoke
+#: window must not false-fail a capture that is actually free.
+NUMERICS_OVERHEAD_MAX = 0.05
 
 #: Every engine rung must appear in the cost report, and each must carry
 #: these analysis fields — as numbers, or as explicit nulls with a
@@ -107,6 +114,23 @@ def check_structure(record: dict) -> list[str]:
     floors = record.get("attained_floor")
     if "attained_floor" in record and not isinstance(floors, dict):
         problems.append("attained_floor must be an object")
+    numerics = record.get("numerics")
+    if "numerics" in record:
+        if not isinstance(numerics, dict):
+            problems.append("numerics must be an object")
+        else:
+            for field in ("epochs_per_sec_on", "overhead_frac"):
+                if not isinstance(numerics.get(field), (int, float)):
+                    problems.append(
+                        f"numerics.{field} is "
+                        + (
+                            "missing"
+                            if numerics.get(field) is None
+                            else f"invalid ({numerics.get(field)!r})"
+                        )
+                        + " — the numerics-capture overhead is a "
+                        "first-class gated metric"
+                    )
     costs = record.get("costs")
     if isinstance(costs, dict):
         # An empty report is schema rot, not a pass: the CI invariant is
@@ -194,6 +218,45 @@ def check_attained(record: dict, floors: Optional[dict] = None) -> list[str]:
                 f"prediction, below the declared floor {floor:.3g}"
             )
     return failures
+
+
+def _numerics_noise(record: dict) -> float:
+    """The capture-on/off pair's timing dispersion (max cv of the two
+    lines) — what widens the overhead ceiling when the windows were
+    noisy."""
+    cv = record.get("cv") or {}
+    return max(
+        float(cv.get("true_weights_xla") or 0.0),
+        float(cv.get("true_weights_xla_numerics") or 0.0),
+    )
+
+
+def check_numerics_overhead(
+    record: dict, ceiling: float = NUMERICS_OVERHEAD_MAX
+) -> list[str]:
+    """The numerics-capture overhead gate: the record's measured
+    ``numerics.overhead_frac`` (capture-on vs capture-off epochs/s over
+    the same workload) must sit under the declared ceiling, widened to
+    ``3 x`` the pair's timing dispersion when the windows were noisier
+    than the ceiling itself (the rolling-baseline rule, applied to one
+    in-record comparison). Vacuous when the record carries no numerics
+    object — the STRUCTURAL gate already fails that."""
+    numerics = record.get("numerics")
+    if not isinstance(numerics, dict):
+        return []
+    overhead = numerics.get("overhead_frac")
+    if not isinstance(overhead, (int, float)):
+        return []
+    noise = _numerics_noise(record)
+    ceiling_eff = max(ceiling, DEFAULT_NOISE_MULT * noise)
+    if overhead > ceiling_eff:
+        return [
+            f"numerics capture costs {overhead:.1%} epochs/s on "
+            f"{numerics.get('workload', '?')}, above the "
+            f"{ceiling_eff:.1%} ceiling (declared {ceiling:.1%}, "
+            f"cv {noise:.4f})"
+        ]
+    return []
 
 
 def compare(
@@ -327,11 +390,13 @@ def main(argv=None) -> int:
     latest = history[-1]
     problems = check_structure(latest)
     attained_failures = check_attained(latest, floor_overrides)
+    numerics_failures = check_numerics_overhead(latest)
     result: dict = {
         "history": args.history,
         "records": len(history),
         "structural_problems": problems,
         "attained_failures": attained_failures,
+        "numerics_failures": numerics_failures,
     }
     if not args.structural:
         result.update(
@@ -365,6 +430,13 @@ def main(argv=None) -> int:
             print(f"perfgate: ATTAINED-FRACTION: {f}", file=sys.stderr)
         if args.check:
             return 1
+    if numerics_failures:
+        # Also active in --structural: the overhead is an in-record
+        # on/off comparison, no cross-run baseline needed.
+        for f in numerics_failures:
+            print(f"perfgate: NUMERICS-OVERHEAD: {f}", file=sys.stderr)
+        if args.check:
+            return 1
     regressions = [
         k
         for k, v in result.get("verdicts", {}).items()
@@ -394,6 +466,19 @@ def _render(result: dict, latest: dict) -> None:
         print(f"  attained-fraction: {len(attained)} rung(s) below floor")
     elif latest.get("attained_floor"):
         print("  attained-fraction: within declared floors")
+    numerics = result.get("numerics_failures", [])
+    overhead = (latest.get("numerics") or {}).get("overhead_frac")
+    if numerics:
+        print(f"  numerics-overhead: ABOVE CEILING ({overhead})")
+    elif isinstance(overhead, (int, float)):
+        ceiling_eff = max(
+            NUMERICS_OVERHEAD_MAX,
+            DEFAULT_NOISE_MULT * _numerics_noise(latest),
+        )
+        print(
+            f"  numerics-overhead: {overhead:.2%} "
+            f"(ceiling {ceiling_eff:.1%})"
+        )
     verdicts = result.get("verdicts")
     if verdicts is None:
         return

@@ -58,6 +58,10 @@ FLEET_CROSS_CHECKED_COUNTS = (
     "engine_demotions",
     "mesh_shrinks",
     "lanes_quarantined",
+    # 0.14.0 — numerics-canary accounting (additive: pre-0.14 reports
+    # lack the keys and are skipped by the `key in published` guard).
+    "canaries_run",
+    "drift_events",
 )
 
 
@@ -91,12 +95,23 @@ class FleetHealthReport:
     lanes_quarantined: int
     #: one roster shrink per lost host, in loss order.
     degradations: tuple = ()
+    #: numerics-canary re-executions across every accepted execution
+    #: (:mod:`..telemetry.numerics`), summed from the unit_ok records.
+    canaries_run: int = 0
+    #: canary comparisons that CONFIRMED cross-engine drift.
+    drift_events: int = 0
+    #: per-unit EXECUTED engine rung, from each unit's LAST unit_ok
+    #: record (the execution whose result stands in the store) —
+    #: `((unit, engine), ...)` sorted by unit. Closes the "pod-scale
+    #: paths never show which engine actually ran" gap: the merged
+    #: ledgers now answer it unit by unit.
+    unit_engines: tuple = ()
 
     @property
     def clean(self) -> bool:
         """True iff nothing degraded fleet-wide: every host finished,
-        nothing was stolen/abandoned, and no unit-level recovery action
-        fired."""
+        nothing was stolen/abandoned, no unit-level recovery action
+        fired, and no canary confirmed drift."""
         return not (
             self.hosts_lost
             or self.units_stolen
@@ -105,6 +120,7 @@ class FleetHealthReport:
             or self.engine_demotions
             or self.mesh_shrinks
             or self.lanes_quarantined
+            or self.drift_events
         )
 
     def to_json(self) -> dict:
@@ -235,6 +251,12 @@ def build_fleet_report(
             len(r.get("quarantined", ())) for r in last_ok.values()
         ),
         degradations=tuple(degradations),
+        canaries_run=sum(int(r.get("canaries", 0)) for r in oks),
+        drift_events=sum(int(r.get("drifts", 0)) for r in oks),
+        unit_engines=tuple(
+            (unit, str(last_ok[unit].get("engine", "?")))
+            for unit in sorted(last_ok)
+        ),
     )
 
 

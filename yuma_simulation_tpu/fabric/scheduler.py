@@ -88,6 +88,13 @@ class FleetConfig:
     #: recovery must not wait on politeness). Empty = no affinity.
     preferred_units: tuple = ()
     poach_after_seconds: float = 0.0
+    #: Cross-engine numerics-canary fraction threaded into each unit's
+    #: local :class:`..resilience.supervisor.SweepSupervisor` (see its
+    #: ``canary_fraction``): selected units re-execute on the demoted
+    #: rung, fingerprints compare epoch-by-epoch, and the per-unit
+    #: canary/drift counts ride the host ledger's ``unit_ok`` records
+    #: into :class:`..fabric.health.FleetHealthReport`. 0 disables.
+    canary_fraction: float = 0.0
 
     def heartbeat_interval(self) -> float:
         if self.heartbeat_seconds is not None:
@@ -153,6 +160,7 @@ class FleetHost:
             ttl_seconds=config.lease_ttl_seconds,
         )
         self.host_dir = self.store.host_dir(config.host_id)
+        self._numerics_records: list = []
 
     def run_units(
         self,
@@ -203,6 +211,10 @@ class FleetHost:
         ledger = FailureLedger(self.host_dir / "ledger.jsonl")
         registry = get_registry()
         published = stolen = abandoned = duplicates = 0
+        #: This host's numerics records (fleet-global coordinates, from
+        #: `compute`'s ``_numerics``), published into the host bundle's
+        #: numerics.jsonl alongside spans/ledger/metrics.
+        self._numerics_records: list = []
         cfg = self.config
         with continue_trace(
             ctx, prefix=span_prefix_for(cfg.host_id)
@@ -309,8 +321,10 @@ class FleetHost:
                 # every record written so far must resolve for
                 # `obsreport --check`.
                 try:
-                    FlightRecorder(self.host_dir).record(
-                        run, registry=registry
+                    recorder = FlightRecorder(self.host_dir)
+                    recorder.record(run, registry=registry)
+                    recorder.record_numerics(
+                        self._numerics_records, run_id=run.run_id
                     )
                 except Exception:
                     logger.warning(
@@ -438,6 +452,7 @@ class FleetHost:
                 )
                 self.leases.release(unit)
                 return "duplicate"
+            self._numerics_records.extend(out.get("_numerics") or ())
             ledger.append(
                 "unit_ok",
                 unit=unit,
@@ -449,6 +464,8 @@ class FleetHost:
                 stalls=int(out.get("_stalls", 0)),
                 demotions=int(out.get("_demotions", 0)),
                 mesh_shrinks=int(out.get("_mesh_shrinks", 0)),
+                canaries=int(out.get("_canaries", 0)),
+                drifts=int(out.get("_drifts", 0)),
                 quarantined=out.get("_quarantined", []),
             )
             self.leases.release(unit)
@@ -456,6 +473,36 @@ class FleetHost:
 
 
 # ---------------------------------------------------------------- entries
+
+
+def _fleet_canary_fraction(fraction: float, idx: int) -> float:
+    """Per-unit canary fraction for fleet unit `idx`: the stride
+    selection has to happen at FLEET scope, because each fleet unit's
+    local supervisor sees exactly one unit (local idx 0) and would
+    otherwise canary every unit for any fraction > 0. Mirrors
+    `SweepSupervisor._canary_selected`'s deterministic stride (the
+    shared `canary_stride` spelling) so a re-run canaries the same
+    fleet units."""
+    from yuma_simulation_tpu.resilience.supervisor import canary_stride
+
+    if fraction <= 0.0:
+        return 0.0
+    return 1.0 if idx % canary_stride(fraction) == 0 else 0.0
+
+
+def _globalize_numerics(records, idx: int, lo: int) -> list:
+    """Re-stamp a unit-local supervisor's numerics records with the
+    FLEET unit index and global lane bounds, so the merged stream
+    speaks one coordinate system (the quarantine-provenance rule,
+    applied to the numerics stream)."""
+    out = []
+    for rec in records or ():
+        rec = dict(rec)
+        rec["unit"] = idx
+        lanes = rec.get("lanes") or [0, 0]
+        rec["lanes"] = [lo + int(lanes[0]), lo + int(lanes[1])]
+        out.append(rec)
+    return out
 
 
 def partition_lanes(n: int, unit_size: int) -> list[tuple[int, int]]:
@@ -515,7 +562,11 @@ def run_fleet_batch(
 
     def compute(idx: int, lo: int, hi: int) -> dict:
         sup = supervisor if supervisor is not None else SweepSupervisor(
-            directory=None, unit_size=fleet.unit_size
+            directory=None,
+            unit_size=fleet.unit_size,
+            canary_fraction=_fleet_canary_fraction(
+                fleet.canary_fraction, idx
+            ),
         )
         out = sup.run_batch(
             scenarios[lo:hi],
@@ -532,6 +583,11 @@ def run_fleet_batch(
             "_stalls": rep.stalls_killed,
             "_demotions": rep.engine_demotions,
             "_mesh_shrinks": rep.mesh_shrinks,
+            "_canaries": rep.canaries_run,
+            "_drifts": rep.drift_events,
+            "_numerics": _globalize_numerics(
+                out.get("numerics_records"), idx, lo
+            ),
             # Globalize the slice-local quarantine provenance: the
             # fleet ledger speaks global lane indices everywhere.
             "_quarantined": [
@@ -637,7 +693,11 @@ def run_fleet_grid(
             configs,
         )
         sup = supervisor if supervisor is not None else SweepSupervisor(
-            directory=None, unit_size=fleet.unit_size
+            directory=None,
+            unit_size=fleet.unit_size,
+            canary_fraction=_fleet_canary_fraction(
+                fleet.canary_fraction, idx
+            ),
         )
         out = sup.run_grid(
             scenario,
@@ -653,6 +713,11 @@ def run_fleet_grid(
             "_stalls": rep.stalls_killed,
             "_demotions": rep.engine_demotions,
             "_mesh_shrinks": rep.mesh_shrinks,
+            "_canaries": rep.canaries_run,
+            "_drifts": rep.drift_events,
+            "_numerics": _globalize_numerics(
+                out.get("numerics_records"), idx, lo
+            ),
             "_quarantined": [
                 [lo + e.case, e.epoch, e.tensor]
                 for e in out["quarantine"].entries

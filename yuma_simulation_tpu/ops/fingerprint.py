@@ -1,0 +1,87 @@
+"""The ONE bit-level tensor fingerprint spelling every engine shares.
+
+The paper's cross-engine contract (fused_scan_mxu == fused_scan == xla,
+bitwise) is enforced by tests but — before the numerics flight recorder
+— observed by nothing in production: a single flipped dividend cell
+would change no shape, no norm anyone checks, and no log line. The
+fingerprint here is the observable that closes that gap, and it is
+deliberately NOT a hash:
+
+- every float is **bit-cast to an unsigned integer** (f32 -> u32; f64
+  folds its u64 bits to u32 by xor-ing the halves), then
+- the integers are **summed mod 2^32**.
+
+Wrapping integer addition is exact, associative and commutative, so the
+reduction is *partition- and chunk-invariant by construction*: a
+miner-sharded psum, a streamed per-chunk capture and a monolithic scan
+all produce the identical fingerprint for identical bits — no
+`miner_sum`-style blocked spelling needed (the property the float
+reductions in :mod:`.normalize` have to buy structurally, integers get
+for free). And because adjacent same-sign f32 values differ by exactly
+1 in their bit patterns, the fingerprint DELTA between two captures of
+the same tensor is the signed sum of per-element ulp distances — a
+single-ulp lane flip moves the fingerprint by exactly 1, which is what
+``tools/driftreport.py`` renders as the ulp distance per lane.
+
+Every capture site (the XLA scan step, the fused-kernel wrapper, the
+sharded Monte-Carlo paths) must call THESE functions; a second spelling
+would fork the observable exactly the way forked reductions fork the
+consensus (see `dyadic_grid_denom`'s "one shared spelling" rule).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def bits_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """`x`'s raw bits as uint32, elementwise. f32 bit-casts directly;
+    f64 (the x64 parity harness) folds the u64 bits to u32 by xor-ing
+    the high and low halves — still a pure function of the bits, so
+    bitwise-equal tensors fingerprint equal and any single-bit flip
+    changes the result. Non-float inputs are cast to f32 first (the
+    stats streams are float-valued by contract)."""
+    dtype = jnp.asarray(x).dtype
+    if dtype == jnp.float32:
+        return lax.bitcast_convert_type(x, jnp.uint32)
+    if dtype == jnp.float64:
+        b = lax.bitcast_convert_type(x, jnp.uint64)
+        return (
+            (b & jnp.uint64(0xFFFFFFFF)) ^ (b >> jnp.uint64(32))
+        ).astype(jnp.uint32)
+    return lax.bitcast_convert_type(
+        jnp.asarray(x, jnp.float32), jnp.uint32
+    )
+
+
+def fingerprint_u32(x: jnp.ndarray, axes=None) -> jnp.ndarray:
+    """Wrapping-u32 sum of `x`'s bits over `axes` (None = all axes).
+    Order-independent by construction — see the module docstring."""
+    return jnp.sum(bits_u32(x), axis=axes, dtype=jnp.uint32)
+
+
+def flip_ulp(x: jnp.ndarray) -> jnp.ndarray:
+    """`x` with every element's bit pattern incremented by one — the
+    adjacent float for positive finite values (one ulp up). The
+    fault-injection primitive behind `resilience.faults.DriftFault`:
+    the smallest representable drift the numerics canary must catch."""
+    dtype = jnp.asarray(x).dtype
+    if dtype == jnp.float64:
+        return lax.bitcast_convert_type(
+            lax.bitcast_convert_type(x, jnp.uint64) + jnp.uint64(1),
+            jnp.float64,
+        )
+    return lax.bitcast_convert_type(
+        lax.bitcast_convert_type(x, jnp.uint32) + jnp.uint32(1),
+        jnp.float32,
+    )
+
+
+def ulp_delta(a: int, b: int) -> int:
+    """Host-side: the signed mod-2^32 distance between two fingerprints
+    — the summed per-element ulp distance when the underlying tensors
+    differ only in same-sign neighbourhoods (the drift-canary case).
+    Returns the minimal-magnitude representative in [-2^31, 2^31)."""
+    d = (int(b) - int(a)) % (1 << 32)
+    return d - (1 << 32) if d >= (1 << 31) else d

@@ -110,9 +110,19 @@ def _sharded_batch_scan(
 def _unpad_outputs(ys: dict, n: int) -> dict:
     """Trim padded lanes and fetch to numpy; a raw per-lane quarantine
     state becomes a host-side :class:`..resilience.guards.QuarantineReport`
-    over the un-padded batch."""
+    over the un-padded batch. The per-epoch numerics sketches
+    (:mod:`..telemetry.numerics`) are a nested `[B, E]`-leaf pytree:
+    trimmed leaf-wise — the shard-invariant merge already happened in
+    the `shard_map` output gather (every sketch reduction is exact and
+    order-independent, so sharded == unsharded bitwise; pinned by
+    tests/unit/test_numerics.py)."""
     qstate = ys.pop("quarantine", None)
+    numerics = ys.pop("numerics", None)
     out = {k: np.asarray(v)[:n] for k, v in ys.items()}
+    if numerics is not None:
+        out["numerics"] = jax.tree.map(
+            lambda v: np.asarray(v)[:n], numerics
+        )
     if qstate is not None:
         from yuma_simulation_tpu.resilience.guards import (
             build_quarantine_report,

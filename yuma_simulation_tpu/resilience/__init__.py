@@ -44,12 +44,14 @@ from yuma_simulation_tpu.resilience.errors import (  # noqa: F401
 )
 from yuma_simulation_tpu.resilience.faults import (  # noqa: F401
     DeviceLossFault,
+    DriftFault,
     FaultPlan,
     HostCrashFault,
     LeaseTearFault,
     NaNFault,
     OverloadFault,
     StallFault,
+    canary_scope,
     inject_faults,
 )
 from yuma_simulation_tpu.resilience.guards import (  # noqa: F401

@@ -41,7 +41,13 @@ deterministically*, so every ladder rung runs in CI under
   torn-lease tolerance and the LeaseExpired abandon path are exercised;
 - `FaultPlan.overload` — a one-shot burst of synthetic requests at the
   serving tier's admission layer, so the 429/Retry-After shed path and
-  the queue-depth/shed metrics are drill-able on CPU CI.
+  the queue-depth/shed metrics are drill-able on CPU CI;
+- `FaultPlan.drift` — flip one lane's dividend by EXACTLY one ulp at a
+  target epoch, inside numerics-canary re-executions ONLY
+  (:func:`canary_scope` / :func:`active_drift_fault`), so the numerics
+  flight recorder's whole drift pipeline — per-epoch fingerprints ->
+  cross-engine canary -> typed ``engine_drift`` ledger event -> drift
+  SLO -> ``driftreport --check`` — is drill-able on CPU CI.
 
 The hooks are consulted at host level by the engines and
 `CheckpointedSweep`; with no plan armed (the production state) each is
@@ -61,6 +67,7 @@ entry points only, which is where every resilience test drives it.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import dataclasses
 import logging
 import os
@@ -161,12 +168,34 @@ class NaNFault:
 
 
 @dataclasses.dataclass(frozen=True)
+class DriftFault:
+    """Flip scenario lane `case`'s dividend (validator 0) by EXACTLY
+    one ulp at global epoch `epoch` — the smallest representable
+    cross-engine drift, injected so CI proves the numerics flight
+    recorder's whole pipeline (per-epoch fingerprints -> cross-engine
+    canary -> typed ``engine_drift`` ledger event -> drift SLO ->
+    ``driftreport --check`` exit != 0) detects real drift end to end.
+
+    Scoped to CANARY re-executions only (:func:`canary_scope` /
+    :func:`active_drift_fault`): a flip applied to both the primary and
+    its canary would cancel in the comparison, so the fault fires only
+    while a canary dispatch is executing — exactly modeling a demoted
+    rung whose reduction spelling drifted from the primary's.
+    `case=None` flips every lane of the canary batch."""
+
+    epoch: int
+    case: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultPlan:
     """A declarative set of faults to inject. Immutable; the mutable
     firing state (dispatch counters, one-shot chunk marks) lives in the
     :class:`_FaultState` the context manager creates."""
 
     nan: Optional[NaNFault] = None
+    #: single-ulp lane flip inside canary re-executions (drift drill).
+    drift: Optional[DriftFault] = None
     fused_oom_dispatches: int = 0
     #: fused dispatches to let through before the failures start —
     #: targets a mid-stream chunk rather than the first dispatch.
@@ -328,6 +357,53 @@ def active_nan_fault() -> Optional[NaNFault]:
     f = state.plan.nan
     log_event(
         logger, "fault_injected", kind="nan",
+        case="all" if f.case is None else f.case, epoch=f.epoch,
+    )
+    return f
+
+
+#: Whether the current (host) execution is a numerics-canary
+#: re-dispatch. A ContextVar, not a flag on the fault state: the serve
+#: tier's canary tick runs on its dispatcher thread concurrently with
+#: request handlers, and only the canary's own dispatch may see the
+#: armed DriftFault.
+_CANARY_EXECUTION = contextvars.ContextVar(
+    "yuma_canary_execution", default=False
+)
+
+
+@contextlib.contextmanager
+def canary_scope():
+    """Mark the enclosed dispatch as a numerics-canary re-execution —
+    the only scope in which :func:`active_drift_fault` fires. Used by
+    the supervisor's canary scheduler and the serve tier's background
+    canary tick; production primaries never enter it."""
+    token = _CANARY_EXECUTION.set(True)
+    try:
+        yield
+    finally:
+        _CANARY_EXECUTION.reset(token)
+
+
+def in_canary_scope() -> bool:
+    return _CANARY_EXECUTION.get()
+
+
+def active_drift_fault() -> Optional[DriftFault]:
+    """Engine hook: the armed plan's drift fault, inside a canary scope
+    only (see :class:`DriftFault`). The batched XLA engine translates
+    it into a per-lane flip-epoch operand (`-1` = clean lane), logging
+    one `event=fault_injected` record when armed."""
+    state = _ACTIVE
+    if state is None or state.plan.drift is None:
+        return None
+    if not _CANARY_EXECUTION.get():
+        return None
+    if _tracing_now():
+        return None
+    f = state.plan.drift
+    log_event(
+        logger, "fault_injected", kind="drift",
         case="all" if f.case is None else f.case, epoch=f.epoch,
     )
     return f

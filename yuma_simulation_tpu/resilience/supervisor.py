@@ -78,6 +78,17 @@ logger = logging.getLogger(__name__)
 DEFAULT_UNIT_BUDGET_SECONDS = 900.0
 
 
+def canary_stride(fraction: float) -> int:
+    """The deterministic unit-index stride a canary fraction selects
+    (unit idx % stride == 0 is canaried; no RNG, so a re-run of the
+    same sweep canaries the same units). ONE spelling shared with the
+    fleet scheduler's fleet-scope selection — a forked rounding rule
+    would canary different units per scope. Quantization note: stride
+    sampling rounds to the nearest 1/N, so e.g. 0.4 selects every 2nd
+    unit (50%) and anything above ~2/3 selects every unit."""
+    return max(1, int(round(1.0 / fraction)))
+
+
 def default_deadline() -> Deadline:
     """The production default unit deadline (15 min + 15 min retry grace)."""
     return Deadline(
@@ -191,11 +202,20 @@ class SweepHealthReport:
     #: engine rungs/paths that produced accepted unit results, sorted.
     engines_used: tuple
     ledger_path: Optional[str] = None
+    #: numerics-canary re-executions run (telemetry.numerics): units
+    #: re-dispatched on the demoted rung and compared fingerprint-by-
+    #: fingerprint against the primary's per-epoch capture.
+    canaries_run: int = 0
+    #: canary comparisons that CONFIRMED drift — one per (unit, stream)
+    #: whose per-epoch fingerprints diverged (typed `engine_drift`
+    #: ledger records carry the first divergent epoch + ulp distance).
+    drift_events: int = 0
 
     @property
     def clean(self) -> bool:
         """True iff nothing degraded: no retries, requeues, stalls,
-        demotions, shrinks or quarantined lanes."""
+        demotions, shrinks, quarantined lanes or confirmed drift
+        (canaries that reproduced the primary's bits are healthy)."""
         return not (
             self.units_retried
             or self.units_requeued
@@ -203,6 +223,7 @@ class SweepHealthReport:
             or self.engine_demotions
             or self.mesh_shrinks
             or self.lanes_quarantined
+            or self.drift_events
         )
 
 
@@ -222,6 +243,10 @@ class _UnitOutcome:
         self.mesh_shrinks = 0
         self.engine = "xla"
         self.quarantine_entries: tuple = ()
+        #: numerics-canary bookkeeping (0.14.0): re-executions run on
+        #: this unit and (unit, stream) comparisons that confirmed drift.
+        self.canaries = 0
+        self.drifts = 0
 
     def record_stall(
         self, *, attempt: int, rung: str = "", budget_s=None
@@ -276,10 +301,29 @@ class SweepSupervisor:
     #: ``costs.jsonl``. Off by default — it compiles programs, which an
     #: unattended production sweep may not want to pay twice.
     capture_costs: bool = False
+    #: Cross-engine numerics-canary fraction (``telemetry.numerics``):
+    #: re-execute this fraction of units on the DEMOTED rung (deterministic
+    #: stride over unit indices, unit 0 always canaried when > 0) inside
+    #: :func:`..faults.canary_scope`, compare per-epoch fingerprints
+    #: lane by lane, and ledger a typed ``engine_drift`` record per
+    #: diverging (unit, stream) — first divergent epoch + ulp distance
+    #: included. 0 disables (the production default is an operator
+    #: choice: a canary re-pays the unit's compute on another rung).
+    canary_fraction: float = 0.0
+    #: Pin the canary's rung; None = one rung below the unit's executed
+    #: engine on the demotion ladder (same rung when already at the
+    #: bottom — a pure determinism canary, still meaningful: a demoted
+    #: RETRY must reproduce the primary's bits).
+    canary_engine: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.unit_size < 1:
             raise ValueError("unit_size must be >= 1")
+        if not (0.0 <= self.canary_fraction <= 1.0):
+            raise ValueError(
+                "canary_fraction must be in [0, 1], got "
+                f"{self.canary_fraction}"
+            )
         if self.quarantine and self.engine != "xla":
             raise ValueError(
                 "quarantine rides the XLA scan carry; a supervised sweep "
@@ -360,6 +404,7 @@ class SweepSupervisor:
         # pure host arithmetic — zero compiles (the recompilation pins
         # cover this path).
         plan = None
+        canary_expected = None
         if scenarios:
             from yuma_simulation_tpu.simulation.planner import (
                 plan_dispatch,
@@ -370,6 +415,26 @@ class SweepSupervisor:
             else:
                 E0, V0, M0 = np.shape(scenarios[0].weights)
             lanes0 = min(self.unit_size, len(scenarios))
+            import math
+
+            from yuma_simulation_tpu.ops.consensus import (
+                dyadic_grid_fits_int32,
+            )
+            from yuma_simulation_tpu.simulation.planner import (
+                EXPECTED_DRIFT_U16_FALLBACK,
+            )
+
+            if not dyadic_grid_fits_int32(
+                int(M0),
+                math.ceil(math.log2(config.consensus_precision)),
+            ):
+                # Beyond the int32 dyadic bound a fused-vs-XLA canary
+                # pairing crosses the DOCUMENTED one-ulp u16-quantize
+                # fallback class (ADVICE r5): stamp it expected instead
+                # of paging on it. Auto plans never run fused here (the
+                # eligibility gates), so this only fires for explicit
+                # fused opt-ins.
+                canary_expected = EXPECTED_DRIFT_U16_FALLBACK
             plan = plan_dispatch(
                 f"supervised_batch:{yuma_version}",
                 (lanes0, E0, V0, M0),
@@ -448,11 +513,32 @@ class SweepSupervisor:
                 outcome=outcome,
             )
 
+        def canary_dispatch(idx: int, lo: int, hi: int, rung: str) -> dict:
+            # The cross-engine canary re-dispatch: the SAME unit, pinned
+            # to the demoted rung (for sharded primaries: the unsharded
+            # XLA engine — a cross-topology canary; the sharded==
+            # unsharded contract is bitwise by construction). Guard
+            # state matches the primary so the traced program differs
+            # ONLY in the rung under comparison.
+            if packed is not None:
+                Wp, Sp, rip, rep, maskp = packed
+                return _batch_on_rung(
+                    Wp[lo:hi], Sp[lo:hi], rip[lo:hi], rep[lo:hi],
+                    config, spec, rung, self.quarantine,
+                    miner_mask=maskp[lo:hi],
+                )
+            W, S, ri, re = stack_scenarios(scenarios[lo:hi], dtype)
+            return _batch_on_rung(
+                W, S, ri, re, config, spec, rung, self.quarantine
+            )
+
         return self._run_units(
             units,
             dispatch_unit,
             num_lanes=len(scenarios),
             tag=tag or f"batch:{yuma_version}",
+            canary_dispatch=canary_dispatch,
+            canary_expected=canary_expected,
             plan=plan,
             config_fingerprint={
                 "driver": "run_batch",
@@ -528,11 +614,25 @@ class SweepSupervisor:
                 rungs=("xla",),
             )
 
+        def canary_dispatch(idx: int, lo: int, hi: int, rung: str) -> dict:
+            # Grid sweeps have a single-rung ladder: the canary is a
+            # pure determinism re-execution on the same XLA engine (a
+            # demoted RETRY must reproduce the primary's bits).
+            del rung
+            unit_cfg = jax.tree.map(
+                lambda leaf: leaf[lo:hi] if jnp.ndim(leaf) > 0 else leaf,
+                configs,
+            )
+            return _grid_on_xla(
+                scenario, yuma_version, unit_cfg, self.quarantine
+            )
+
         return self._run_units(
             units,
             dispatch_unit,
             num_lanes=num_points,
             tag=tag or f"grid:{yuma_version}",
+            canary_dispatch=canary_dispatch,
             plan=plan,
             config_fingerprint={
                 "driver": "run_grid",
@@ -603,6 +703,8 @@ class SweepSupervisor:
         config_fingerprint: dict,
         cost_request: Optional[dict] = None,
         plan=None,
+        canary_dispatch: Optional[Callable] = None,
+        canary_expected: Optional[str] = None,
     ) -> dict:
         from yuma_simulation_tpu.telemetry import (
             FlightRecorder,
@@ -627,6 +729,15 @@ class SweepSupervisor:
         # torn and redone — last-write-wins would silently drop them.
         outcomes: dict[int, list] = {}
         executions: dict[int, int] = {}
+        #: Units whose prior-run snapshot failed verification at resume
+        #: (requeued before execution — distinct from within-run
+        #: re-entries, which `executions` counts).
+        resume_requeued: set[int] = set()
+        #: Serialized per-epoch numerics records (telemetry.numerics),
+        #: primary + canary roles — published to the bundle's
+        #: numerics.jsonl and returned to callers (the fleet scheduler
+        #: re-stamps them with fleet-global unit indices).
+        numerics_records: list = []
 
         def unit_fn(idx: int) -> dict:
             from yuma_simulation_tpu.telemetry.slo import observe_duration
@@ -649,6 +760,17 @@ class SweepSupervisor:
                     try:
                         with span(f"attempt{attempt + 1}"):
                             ys = dispatch_unit(idx, lo, hi, attempt, outcome)
+                            # Numerics capture + cross-engine canary
+                            # BEFORE acceptance, so the unit_ok record
+                            # carries this execution's canary/drift
+                            # counts. Contained: a capture or canary
+                            # failure must never fail the unit it
+                            # observes.
+                            self._capture_unit_numerics(
+                                idx, lo, hi, ys, outcome, ledger,
+                                canary_dispatch, numerics_records, tag,
+                                canary_expected,
+                            )
                             accepted = self._accept_unit(
                                 idx, lo, hi, ys, outcome, ledger
                             )
@@ -721,6 +843,20 @@ class SweepSupervisor:
                             tag=tag,
                             config=config_fingerprint,
                         )
+                        # A chunk torn BETWEEN runs (storage rot, a
+                        # crash mid-publish) requeues at resume: ledger
+                        # it under the same `unit_requeued` contract as
+                        # a within-run tear, so the bundle cross-check
+                        # (flight.ledger_counts) and the numerics-stream
+                        # replace-not-duplicate rule see one story.
+                        for i in sweep.corrupt_chunks():
+                            if 0 <= i < len(units):
+                                resume_requeued.add(i)
+                                ledger.append(
+                                    "unit_requeued",
+                                    unit=i,
+                                    reason="resume_verification_failed",
+                                )
                         dividends = sweep.run(
                             lambda i: unit_fn(i)["dividends"]
                         )
@@ -758,7 +894,7 @@ class SweepSupervisor:
                     )
                     report = self._build_report(
                         units, outcomes, executions, resumed, len(entries),
-                        directory,
+                        directory, resume_requeued,
                     )
                     # Metrics the supervisor owns (the per-action
                     # counters — stalls, demotions, shrinks, retries —
@@ -815,6 +951,22 @@ class SweepSupervisor:
                             exc_info=True,
                         )
                     else:
+                        try:
+                            # The numerics stream rides the same
+                            # crash-safe bundle (merged by unit/role, so
+                            # it survives a failed/resumed sweep exactly
+                            # like costs.jsonl — resumed units keep the
+                            # prior run's records).
+                            recorder.record_numerics(
+                                numerics_records, run_id=run.run_id
+                            )
+                        except Exception:
+                            logger.warning(
+                                "numerics stream publish failed for %s "
+                                "(the flight bundle itself published)",
+                                directory,
+                                exc_info=True,
+                            )
                         if self.capture_costs and cost_request is not None:
                             # Opt-in AOT cost capture into costs.jsonl:
                             # compiles each rung once, so it runs AFTER
@@ -851,7 +1003,244 @@ class SweepSupervisor:
             "dividends": dividends,
             "quarantine": quarantine,
             "report": report,
+            "numerics_records": numerics_records,
         }
+
+    # -- numerics canary ------------------------------------------------
+
+    def _canary_selected(self, idx: int) -> bool:
+        """Deterministic stride selection over unit indices (no RNG —
+        a re-run of the same sweep canaries the same units, so resumed
+        and fresh runs account identically)."""
+        if self.canary_fraction <= 0.0:
+            return False
+        return idx % canary_stride(self.canary_fraction) == 0
+
+    def _canary_rung(self, primary_engine: str) -> str:
+        """The rung the canary re-executes on: pinned, or one below the
+        primary on the demotion ladder (same rung at the bottom — a
+        determinism canary). Sharded/single-device paths canary on the
+        unsharded XLA engine (the sharded == unsharded contract is the
+        observable under test there)."""
+        if self.canary_engine is not None:
+            return self.canary_engine
+        from yuma_simulation_tpu.simulation.planner import (
+            ENGINE_LADDER,
+            ladder_from,
+        )
+
+        if primary_engine not in ENGINE_LADDER:
+            return "xla"
+        ladder = ladder_from(primary_engine)
+        return ladder[1] if len(ladder) > 1 else ladder[0]
+
+    def _capture_unit_numerics(
+        self,
+        idx: int,
+        lo: int,
+        hi: int,
+        ys: dict,
+        outcome: _UnitOutcome,
+        ledger: FailureLedger,
+        canary_dispatch: Optional[Callable],
+        records: list,
+        tag: str,
+        canary_expected: Optional[str] = None,
+    ) -> None:
+        """Fetch the unit's in-scan numerics sketches, serialize the
+        primary record, and (on selected units) run the cross-engine
+        canary. Wholly contained: observability must never fail the
+        sweep it observes."""
+        sketches = ys.get("numerics")
+        if sketches is None:
+            return
+        try:
+            from yuma_simulation_tpu.telemetry.numerics import (
+                sketch_records,
+                to_host,
+            )
+
+            engine = ys.get("_engine_used", self.engine)
+            primary = to_host(sketches)
+            records.extend(
+                sketch_records(
+                    primary, unit=idx, lanes=(lo, hi), engine=engine,
+                    role="primary", label=tag,
+                )
+            )
+        except Exception:
+            logger.warning(
+                "numerics capture failed for unit %d", idx, exc_info=True
+            )
+            return
+        if canary_dispatch is None or not self._canary_selected(idx):
+            return
+        self._run_canary(
+            idx, lo, hi, primary, engine, outcome, ledger,
+            canary_dispatch, records, tag, canary_expected,
+        )
+
+    def _run_canary(
+        self,
+        idx: int,
+        lo: int,
+        hi: int,
+        primary: dict,
+        primary_engine: str,
+        outcome: _UnitOutcome,
+        ledger: FailureLedger,
+        canary_dispatch: Callable,
+        records: list,
+        tag: str,
+        canary_expected: Optional[str] = None,
+    ) -> None:
+        """Re-execute one accepted unit on the demoted rung inside
+        :func:`..faults.canary_scope` and compare per-epoch fingerprints
+        lane by lane. Confirmed drift is a typed ``engine_drift`` ledger
+        record per diverging (unit, stream) — global lane index, first
+        divergent epoch, summed ulp distance — plus a bad
+        ``engine_drift_ok`` SLO event and an ``engine_drift_total``
+        counter tick; a clean canary feeds the same SLO stream good.
+
+        `canary_expected` names the documented accepted-drift class
+        this sweep's shape sits in (today: the u16-quantize fallback
+        pairing of an explicit fused opt-in beyond the int32 dyadic
+        bound — ADVICE r5). A divergence on a fused-vs-XLA pairing
+        under that flag is recorded and rendered but NOT treated as an
+        incident: the canary record carries ``expected``, the ledger
+        record too, the SLO stream stays good, and ``driftreport
+        --check`` passes."""
+        from yuma_simulation_tpu.resilience import faults
+        from yuma_simulation_tpu.telemetry import get_registry, span
+        from yuma_simulation_tpu.telemetry.numerics import (
+            compare_sketches,
+            sketch_records,
+            to_host,
+        )
+        from yuma_simulation_tpu.telemetry.slo import observe_event
+
+        rung = self._canary_rung(primary_engine)
+        registry = get_registry()
+        try:
+            with span(f"canary{idx}", lanes=[lo, hi], rung=rung):
+                with faults.canary_scope():
+                    ys_c = canary_dispatch(idx, lo, hi, rung)
+                sketches_c = (
+                    ys_c.get("numerics") if isinstance(ys_c, dict) else None
+                )
+                if sketches_c is None:
+                    ledger.append(
+                        "canary_failed",
+                        unit=idx,
+                        reason="no numerics capture on canary rung",
+                    )
+                    return
+                fused = ("fused_scan", "fused_scan_mxu")
+                expected = (
+                    canary_expected
+                    if (primary_engine in fused) != (rung in fused)
+                    else None
+                )
+                canary = to_host(sketches_c)
+                canary_records = sketch_records(
+                    canary, unit=idx, lanes=(lo, hi), engine=rung,
+                    role="canary", label=tag,
+                )
+                if expected:
+                    for rec in canary_records:
+                        rec["expected"] = expected
+                records.extend(canary_records)
+                divergences = compare_sketches(primary, canary)
+                outcome.canaries += 1
+                registry.counter(
+                    "numerics_canaries",
+                    help="cross-engine numerics canary re-executions",
+                ).inc()
+                ledger.append(
+                    "unit_canary",
+                    unit=idx,
+                    engine=rung,
+                    primary_engine=primary_engine,
+                    drift_streams=len(divergences),
+                )
+                if not divergences:
+                    observe_event("engine_drift_ok", True)
+                    return
+                if expected:
+                    # The codified accepted-drift class: visible in the
+                    # ledger and the numerics stream, but NOT an
+                    # incident — no drift count, no bad SLO event, no
+                    # degraded report.
+                    registry.counter(
+                        "engine_drift_expected",
+                        help="canary divergences inside a documented "
+                        "accepted-drift class",
+                    ).inc(len(divergences))
+                    observe_event("engine_drift_ok", True)
+                    for stream, lanes in sorted(divergences.items()):
+                        ledger.append(
+                            "engine_drift",
+                            unit=idx,
+                            stream=stream,
+                            primary_engine=primary_engine,
+                            canary_engine=rung,
+                            expected=expected,
+                            lanes=[
+                                [
+                                    lo + d["lane"],
+                                    d["first_divergent_epoch"],
+                                    d["ulp_distance"],
+                                ]
+                                for d in lanes
+                            ],
+                        )
+                    return
+                outcome.drifts += len(divergences)
+                registry.counter(
+                    "engine_drift_total",
+                    help="canary comparisons that confirmed numerics drift",
+                ).inc(len(divergences))
+                observe_event("engine_drift_ok", False)
+                for stream, lanes in sorted(divergences.items()):
+                    first = lanes[0]
+                    ledger.append(
+                        "engine_drift",
+                        unit=idx,
+                        stream=stream,
+                        primary_engine=primary_engine,
+                        canary_engine=rung,
+                        # [global lane, first divergent epoch, ulp
+                        # distance] per diverging lane — what
+                        # driftreport localizes.
+                        lanes=[
+                            [
+                                lo + d["lane"],
+                                d["first_divergent_epoch"],
+                                d["ulp_distance"],
+                            ]
+                            for d in lanes
+                        ],
+                    )
+                    log_event(
+                        logger,
+                        "engine_drift",
+                        level=logging.ERROR,
+                        unit=idx,
+                        stream=stream,
+                        primary=primary_engine,
+                        canary=rung,
+                        lane=lo + first["lane"],
+                        epoch=first["first_divergent_epoch"],
+                        ulp=first["ulp_distance"],
+                    )
+        except Exception:
+            logger.warning(
+                "numerics canary failed for unit %d", idx, exc_info=True
+            )
+            try:
+                ledger.append("canary_failed", unit=idx, reason="exception")
+            except Exception:
+                pass
 
     def _accept_unit(
         self,
@@ -865,6 +1254,7 @@ class SweepSupervisor:
         """Fold one successful unit dispatch into the books; returns the
         ys dict (its "dividends" is what the chunk store snapshots)."""
         ys = dict(ys)
+        ys.pop("numerics", None)  # fetched by _capture_unit_numerics
         outcome.engine = ys.pop("_engine_used", "xla")
         demotions = ys.pop("_demotions", ())
         outcome.demotions = len(demotions)
@@ -891,6 +1281,8 @@ class SweepSupervisor:
             stalls=outcome.stalls,
             demotions=outcome.demotions,
             mesh_shrinks=outcome.mesh_shrinks,
+            canaries=outcome.canaries,
+            drifts=outcome.drifts,
             # Full provenance, not just lane indices: a later RESUMED
             # run reconstructs its QuarantineReport from these records
             # (the resumed chunks still carry the zero-masked lanes).
@@ -904,7 +1296,7 @@ class SweepSupervisor:
 
     def _build_report(
         self, units, outcomes, executions, resumed, lanes_quarantined,
-        directory,
+        directory, resume_requeued=frozenset(),
     ) -> SweepHealthReport:
         runs = [o for per_unit in outcomes.values() for o in per_unit]
         final = [per_unit[-1] for per_unit in outcomes.values()]
@@ -917,7 +1309,13 @@ class SweepSupervisor:
                 for per_unit in outcomes.values()
                 if any(o.attempts > 1 for o in per_unit)
             ),
-            units_requeued=sum(1 for c in executions.values() if c > 1),
+            # Distinct requeued units, whichever way the tear was
+            # detected: within-run re-entry or resume-time verification
+            # failure (matches ledger_counts' distinct-unit rule).
+            units_requeued=len(
+                {i for i, c in executions.items() if c > 1}
+                | set(resume_requeued)
+            ),
             stalls_killed=sum(o.stalls for o in runs),
             engine_demotions=sum(o.demotions for o in runs),
             mesh_shrinks=sum(o.mesh_shrinks for o in runs),
@@ -927,6 +1325,8 @@ class SweepSupervisor:
             ledger_path=(
                 str(directory / "ledger.jsonl") if directory is not None else None
             ),
+            canaries_run=sum(o.canaries for o in runs),
+            drift_events=sum(o.drifts for o in runs),
         )
 
 

@@ -33,6 +33,7 @@ def _build_config(args, **overrides) -> "ServeConfig":  # noqa: F821
             tuple(int(d) for d in shape.split("x"))
             for shape in (args.warmup or [])
         ),
+        canary_interval_seconds=args.canary_interval,
     )
 
 
@@ -149,6 +150,24 @@ def run_smoke(args) -> int:
             h.body.get("ready") is True and "slo" in h.body,
             f"/healthz reports SLO readiness (got {h.body.get('slo')})",
         )
+
+        # The numerics canary (0.14.0): one forced tick through a warm
+        # bucket must compare the primary rung against its demoted
+        # fallback drift-clean, and /healthz must surface the tick.
+        state = server.service.run_canary_once()
+        expect(
+            state is not None
+            and state.get("ticks", 0) >= 1
+            and state.get("drift", 0) == 0,
+            f"numerics canary tick drift-clean (got {state})",
+        )
+        h = client.healthz()
+        expect(
+            h.body.get("canary", {}).get("ticks", 0) >= 1
+            and h.body.get("status") == "ok",
+            f"/healthz surfaces the canary tick, still ok "
+            f"(got {h.body.get('canary')})",
+        )
     finally:
         server.close()
 
@@ -179,6 +198,14 @@ def main(argv=None) -> int:
     parser.add_argument("--deadline", type=float, default=120.0)
     parser.add_argument("--breaker-threshold", type=int, default=3)
     parser.add_argument("--breaker-cooldown", type=float, default=30.0)
+    parser.add_argument(
+        "--canary-interval",
+        type=float,
+        default=0.0,
+        help="background numerics-canary cadence in seconds (0 "
+        "disables): re-execute a warm shape bucket on the demoted "
+        "rung and compare per-epoch fingerprints",
+    )
     parser.add_argument(
         "--warmup",
         action="append",

@@ -105,6 +105,16 @@ class ServeConfig:
     tenant_priority: Optional[dict] = None
     #: Retry-After for SLO-driven sheds (seconds).
     slo_shed_retry_after: float = 5.0
+    #: Background numerics-canary cadence: every this-many seconds of
+    #: dispatcher idle time, re-execute one warm shape bucket on the
+    #: plan's primary rung AND its demoted rung and compare per-epoch
+    #: fingerprints (:mod:`..telemetry.numerics`). Confirmed drift is a
+    #: typed ``engine_drift`` ledger event, a bad ``engine_drift_ok``
+    #: SLO event (fast-burns -> `/healthz` degraded), and a breaker
+    #: failure on the primary rung — plans re-anchor below a rung whose
+    #: bits diverge from its own fallback. 0 disables (the default:
+    #: a canary re-pays a bucket's compute).
+    canary_interval_seconds: float = 0.0
     #: Test-only: construct the service without its dispatcher thread
     #: (so queue-bound behavior can be observed deterministically).
     start_dispatcher: bool = True
@@ -248,6 +258,30 @@ class SimulationService:
             "serve_request_seconds",
             help="request wall time, admission to reply",
         )
+        # The background numerics canary (ticked from the dispatcher's
+        # idle loop): warm shape buckets round-robined, per-tick state
+        # surfaced on /healthz, serialized sketch records stashed for
+        # the bundle's numerics.jsonl.
+        self._canary_lock = threading.Lock()
+        self._canary_buckets: dict[str, tuple] = {}
+        self._canary_order: list[str] = []
+        self._canary_idx = 0
+        self._canary_last = time.monotonic()
+        self._canary_state: dict = {
+            "ticks": 0, "drift": 0, "last_bucket": None,
+        }
+        self._numerics_lock = threading.Lock()
+        self._numerics_records: list = []
+        self._canary_ticks_metric = self.registry.counter(
+            "serve_canary_ticks",
+            help="background numerics-canary bucket re-executions",
+        )
+        self._canary_drift_metric = self.registry.counter(
+            "serve_canary_drift",
+            help="canary comparisons that confirmed numerics drift",
+        )
+        for shape in self.config.warmup_shapes:
+            self._remember_canary_bucket(shape, "Yuma 1 (paper)")
         self._counter = itertools.count(1)
         self._stopping = False
         self._closed = False
@@ -330,6 +364,11 @@ class SimulationService:
                         run_id=self.run.run_id,
                     )
                     rec.record_slo(self.slo, run_id=self.run.run_id)
+                    with self._numerics_lock:
+                        nrecs = self._numerics_records
+                        self._numerics_records = []
+                    # Append-only here too: close() merge-dedupes.
+                    rec.append_numerics(nrecs, run_id=self.run.run_id)
             except Exception:
                 logger.warning(
                     "ingress span flush failed for %s",
@@ -608,6 +647,220 @@ class SimulationService:
             except QueueOverflow:
                 continue  # counted by the queue; keep pushing the burst
 
+    # -- the background numerics canary ---------------------------------
+
+    def _remember_canary_bucket(self, shape, version: str) -> None:
+        """Register a warm `(E, V, M)` shape as a canary target (warmup
+        shapes at startup, every successfully dispatched simulate shape
+        thereafter)."""
+        try:
+            E, V, M = (int(d) for d in shape)
+        except (TypeError, ValueError):
+            return
+        key = f"{E}x{V}x{M}"
+        with self._canary_lock:
+            if key in self._canary_buckets:
+                # Most-recently-dispatched rotates to the back, so the
+                # eviction below sheds the coldest bucket, not a hot one.
+                self._canary_order.remove(key)
+            self._canary_buckets[key] = ((E, V, M), version)
+            self._canary_order.append(key)
+            # LRU bound: a hostile (or merely varied) client shedding a
+            # fresh shape per request must not grow the rotation — or
+            # the per-tick cold compiles that come with it — without
+            # limit. 32 warm buckets is far past any real serving mix.
+            while len(self._canary_order) > 32:
+                evicted = self._canary_order.pop(0)
+                del self._canary_buckets[evicted]
+
+    def _stash_numerics(self, records) -> None:
+        """Hold serialized sketch records for the bundle publish (close
+        + the periodic ingress flush); bounded — the on-disk merge keys
+        by (unit, stream, role, label), so only the newest capture per
+        identity survives anyway."""
+        if not records:
+            return
+        with self._numerics_lock:
+            self._numerics_records.extend(records)
+            del self._numerics_records[:-4096]
+
+    def _maybe_canary(self) -> None:
+        """Dispatcher-idle hook: tick the canary when the interval has
+        elapsed. Never raises — the canary observes the service, it must
+        not take it down."""
+        if self.config.canary_interval_seconds <= 0 or self._stopping:
+            return
+        now = time.monotonic()
+        with self._canary_lock:
+            due = (
+                bool(self._canary_order)
+                and now - self._canary_last
+                >= self.config.canary_interval_seconds
+            )
+            if due:
+                self._canary_last = now
+        if not due:
+            return
+        try:
+            self.run_canary_once()
+        except Exception:
+            logger.warning("serve numerics canary tick failed", exc_info=True)
+
+    def run_canary_once(self) -> Optional[dict]:
+        """Force one canary tick through the next warm bucket (the smoke
+        drill's deterministic entry point; production ticks ride the
+        dispatcher's idle loop on ``canary_interval_seconds``). Returns
+        the canary state snapshot, or None when nothing could run (no
+        warm buckets, numerics capture disabled)."""
+        from yuma_simulation_tpu.telemetry.numerics import numerics_enabled
+
+        if not numerics_enabled():
+            return None
+        with self._canary_lock:
+            if not self._canary_order:
+                return None
+            key = self._canary_order[self._canary_idx % len(self._canary_order)]
+            self._canary_idx += 1
+            shape, version = self._canary_buckets[key]
+        return self._canary_tick(key, shape, version)
+
+    def _canary_tick(self, key: str, shape: tuple, version: str) -> dict:
+        """One cross-engine canary comparison on a warm bucket: the
+        plan's primary rung vs its demoted rung over the same
+        deterministic workload, compared fingerprint-by-fingerprint per
+        epoch. See ``ServeConfig.canary_interval_seconds`` for what a
+        confirmed drift drives."""
+        import jax
+
+        from yuma_simulation_tpu.models.config import YumaConfig
+        from yuma_simulation_tpu.models.variants import variant_for_version
+        from yuma_simulation_tpu.resilience import faults
+        from yuma_simulation_tpu.scenarios.base import Scenario
+        from yuma_simulation_tpu.simulation.planner import plan_dispatch
+        from yuma_simulation_tpu.simulation.sweep import (
+            simulate_batch,
+            stack_scenarios,
+        )
+        from yuma_simulation_tpu.telemetry.numerics import (
+            compare_sketches,
+            sketch_records,
+            to_host,
+        )
+        from yuma_simulation_tpu.telemetry.runctx import span
+
+        E, V, M = shape
+        spec = variant_for_version(version)
+        config = YumaConfig()
+        validators = [f"v{i}" for i in range(V)]
+        scenario = Scenario(
+            name=f"canary:{key}",
+            validators=validators,
+            base_validator=validators[0],
+            weights=np.zeros((E, V, M), np.float32),
+            stakes=np.ones((E, V), np.float32),
+            num_epochs=E,
+        )
+        W, S, ri, re = stack_scenarios([scenario])
+        plan = plan_dispatch(
+            f"serve_canary:{key}", (1, E, V, M), spec, config, W.dtype,
+            check_memory=False,
+        )
+        ladder = self.breaker.filter_ladder(plan.ladder)
+        primary_rung = ladder[0]
+        canary_rung = ladder[1] if len(ladder) > 1 else ladder[-1]
+        label = f"canary:{key}"
+        with self.run.activate():
+            # root=True: the tick runs on the dispatcher thread between
+            # requests; it must not parent under whatever span a traced
+            # request last left behind.
+            with span(
+                label, root=True, primary=primary_rung, canary=canary_rung
+            ):
+                try:
+                    ys_a = jax.block_until_ready(
+                        simulate_batch(
+                            W, S, ri, re, config, spec,
+                            epoch_impl=primary_rung,
+                        )
+                    )
+                    with faults.canary_scope():
+                        ys_b = jax.block_until_ready(
+                            simulate_batch(
+                                W, S, ri, re, config, spec,
+                                epoch_impl=canary_rung,
+                            )
+                        )
+                except BaseException:
+                    # A tick that DIED is not drift evidence; release a
+                    # half-open probe latch the filter may have taken.
+                    self.breaker.abort_probe(primary_rung)
+                    raise
+                primary = to_host(ys_a["numerics"])
+                canary = to_host(ys_b["numerics"])
+                self._stash_numerics(
+                    sketch_records(
+                        primary, unit=0, lanes=(0, 1), engine=primary_rung,
+                        role="primary", label=label,
+                    )
+                    + sketch_records(
+                        canary, unit=0, lanes=(0, 1), engine=canary_rung,
+                        role="canary", label=label,
+                    )
+                )
+                divergences = compare_sketches(primary, canary)
+                self._canary_ticks_metric.inc()
+                with self._canary_lock:
+                    self._canary_state["ticks"] += 1
+                    self._canary_state["last_bucket"] = key
+                self.slo.event("engine_drift_ok", not divergences)
+                if not divergences:
+                    self.breaker.record_success(primary_rung)
+                    self._append_ledger(
+                        "canary_ok",
+                        bucket=key,
+                        primary_engine=primary_rung,
+                        canary_engine=canary_rung,
+                    )
+                else:
+                    self._canary_drift_metric.inc(len(divergences))
+                    with self._canary_lock:
+                        self._canary_state["drift"] += len(divergences)
+                    # Confirmed drift counts as a primary-rung failure:
+                    # after `threshold` confirming ticks the rung trips
+                    # open fleet-wide and plans re-anchor below it.
+                    self.breaker.record_failure(primary_rung)
+                    for stream, lanes in sorted(divergences.items()):
+                        first = lanes[0]
+                        self._append_ledger(
+                            "engine_drift",
+                            bucket=key,
+                            stream=stream,
+                            primary_engine=primary_rung,
+                            canary_engine=canary_rung,
+                            lanes=[
+                                [
+                                    d["lane"],
+                                    d["first_divergent_epoch"],
+                                    d["ulp_distance"],
+                                ]
+                                for d in lanes
+                            ],
+                        )
+                        log_event(
+                            logger,
+                            "engine_drift",
+                            level=logging.ERROR,
+                            bucket=key,
+                            stream=stream,
+                            primary=primary_rung,
+                            canary=canary_rung,
+                            lane=first["lane"],
+                            epoch=first["first_divergent_epoch"],
+                            ulp=first["ulp_distance"],
+                        )
+        with self._canary_lock:
+            return dict(self._canary_state)
+
     # -- dispatcher ------------------------------------------------------
 
     def _dispatch_loop(self) -> None:
@@ -617,6 +870,7 @@ class SimulationService:
                 if item is None:
                     if self._stopping:
                         return
+                    self._maybe_canary()
                     continue
                 item.t_taken = time.time()
                 if self._stopping:
@@ -787,6 +1041,10 @@ class SimulationService:
             raise
         report = out["report"]
         self._feed_breaker(start, report)
+        self._stash_numerics(out.get("numerics_records"))
+        self._remember_canary_bucket(
+            np.shape(first.scenario.weights), first.version
+        )
         if real > 1:
             self._coalesced_lanes.inc(real)
         dividends = np.asarray(out["dividends"])
@@ -823,6 +1081,17 @@ class SimulationService:
         )
         out = sup.run_grid(
             t.scenario, t.version, configs, tag=f"serve:sweep:{t.request_id}"
+        )
+        # Re-label the numerics captures by shape bucket, not request id:
+        # the on-disk merge keys by label, so per-request labels would
+        # grow numerics.jsonl without bound on a long-lived server
+        # (newest capture per bucket is all the drift render needs —
+        # spans keep the per-request identity).
+        self._stash_numerics(
+            [
+                {**rec, "label": f"serve:sweep:{t.plan.bucket.key}"}
+                for rec in out.get("numerics_records") or ()
+            ]
         )
         report = out["report"]
         dividends = np.asarray(out["dividends"])  # [P, E, V]
@@ -935,7 +1204,16 @@ class SimulationService:
                 "fast_burn": fast,
                 "degraded": degraded,
             },
+            "canary": self._canary_snapshot(),
         }
+
+    def _canary_snapshot(self) -> dict:
+        with self._canary_lock:
+            return dict(
+                self._canary_state,
+                buckets=len(self._canary_order),
+                enabled=self.config.canary_interval_seconds > 0,
+            )
 
     def metrics_text(self) -> str:
         return self.registry.prometheus_text()
@@ -983,14 +1261,19 @@ class SimulationService:
 
             with self._ingress_lock:
                 ingress, self._ingress_runs = self._ingress_runs, []
+            with self._numerics_lock:
+                nrecs = self._numerics_records
+                self._numerics_records = []
             try:
                 with self._publish_lock:
-                    FlightRecorder(self.config.bundle_dir).record(
+                    recorder = FlightRecorder(self.config.bundle_dir)
+                    recorder.record(
                         self.run,
                         registry=self.registry,
                         extra_runs=ingress,
                         slo_engine=self.slo,
                     )
+                    recorder.record_numerics(nrecs, run_id=self.run.run_id)
             except Exception:
                 logger.warning(
                     "serve flight-bundle publish failed for %s",

@@ -44,6 +44,26 @@ class ScanCarry:
 
 @tree_util.register_dataclass
 @dataclass
+class NumericsSketch:
+    """The per-epoch numerics observable of one tensor stream (the
+    flight recorder's `numerics.jsonl` payload, computed INSIDE the
+    jitted scan bodies — :mod:`..telemetry.numerics`). Each field is one
+    value per epoch (scalars in the scan step; `[E]` after the scan
+    stacks them; `[B, E]` under a vmapped batch). All reductions are
+    exact and order-independent (integer counts, wrapping-u32 bit sums,
+    min/max), so the sketch is bitwise invariant to chunked streaming
+    and miner-axis sharding — merging chunked captures is plain
+    concatenation along the epoch axis."""
+
+    finite_frac: Any  # exact finite count / size, as the stream dtype
+    lo: Any  # min
+    hi: Any  # max
+    absmax: Any  # max |x|
+    fingerprint: Any  # wrapping-u32 sum of the raw bits (ops.fingerprint)
+
+
+@tree_util.register_dataclass
+@dataclass
 class TotalsCarry:
     """Carry of the accumulate-in-carry throughput scans
     (:func:`.engine.simulate_constant`, the per-epoch Monte-Carlo shard

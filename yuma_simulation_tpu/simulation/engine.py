@@ -67,6 +67,10 @@ class SimulationResult:
     #: the run completed on the first-choice engine or no retry policy
     #: was armed) — tuple of resilience.retry.DemotionRecord.
     demotions: Optional[tuple] = None
+    #: Per-epoch numerics sketches captured inside the engine dispatch
+    #: (`{stream: ..carry.NumericsSketch of [E] numpy arrays}`, see
+    #: telemetry.numerics) — None when YUMA_NUMERICS=0 disabled capture.
+    numerics: Optional[dict] = None
 
 
 def _miner_shardings(mesh: Mesh, num_miners: int):
@@ -208,6 +212,7 @@ def _apply_reset(B, C_prev, epoch, reset_index, reset_epoch, reset_mode, M):
         "mesh",
         "return_carry",
         "guard_nonfinite",
+        "capture_numerics",
     ),
 )
 def _simulate_scan(
@@ -228,6 +233,8 @@ def _simulate_scan(
     return_carry: bool = False,
     guard_nonfinite: bool = False,
     nan_fault_epoch: Optional[jnp.ndarray] = None,  # i32 scalar, -1 = off
+    capture_numerics: bool = False,
+    drift_fault_epoch: Optional[jnp.ndarray] = None,  # i32 scalar, -1 = off
 ):
     """`guard_nonfinite` folds the resilience layer's numerical
     quarantine (:mod:`..resilience.guards`) into the scan carry: each
@@ -326,6 +333,23 @@ def _simulate_scan(
                 dividends,
             )
 
+        if drift_fault_epoch is not None:
+            # The numerics-canary drill operand (resilience.faults
+            # DriftFault): flip validator 0's dividend by EXACTLY one
+            # ulp at the target epoch — the smallest representable
+            # cross-engine drift, which the per-epoch fingerprint must
+            # localize (delta of exactly 1 at that epoch). Value-neutral
+            # (`where(False, ..)`) everywhere else; armed only inside
+            # canary re-executions by the fault hooks.
+            from yuma_simulation_tpu.ops.fingerprint import flip_ulp
+
+            lane0 = jnp.arange(dividends.shape[-1]) == 0
+            dividends = jnp.where(
+                (epoch == drift_fault_epoch) & lane0,
+                flip_ulp(dividends),
+                dividends,
+            )
+
         if guard_nonfinite:
             # Priority-ordered health check (codes index
             # guards.QUARANTINE_TENSORS); the mask zeroes this lane's
@@ -361,6 +385,21 @@ def _simulate_scan(
             )
         if save_consensus:
             ys["consensus"] = C_next
+        if capture_numerics:
+            # The numerics flight recorder's per-epoch sketch
+            # (telemetry.numerics), computed HERE in the scan step so
+            # the capture rides the one traced program — no extra
+            # dispatches, no host syncs, and the exact/order-independent
+            # reductions make it bitwise invariant to chunked streaming
+            # and miner-axis sharding. Captured post-quarantine: the
+            # sketch observes what the engine EMITS.
+            from yuma_simulation_tpu.telemetry.numerics import (
+                capture_streams,
+            )
+
+            ys["numerics"] = capture_streams(
+                {"dividends": dividends, "consensus": C_next}
+            )
         return (
             ScanCarry(
                 bonds=B_next,
@@ -412,6 +451,7 @@ def _simulate_scan(
         "save_consensus",
         "mxu",
         "return_carry",
+        "capture_numerics",
     ),
 )
 def _simulate_case_fused(
@@ -428,6 +468,7 @@ def _simulate_case_fused(
     carry: Optional[dict] = None,
     epoch_offset=0,
     return_carry: bool = False,
+    capture_numerics: bool = False,
 ):
     """The fused-Pallas twin of :func:`_simulate_scan`: the whole epoch
     loop — per-epoch weights/stakes streamed from HBM, reset injection,
@@ -472,6 +513,23 @@ def _simulate_case_fused(
     for key in ("bonds", "incentives", "consensus"):
         if key in res:
             ys[key] = res[key]
+    if capture_numerics:
+        # The SAME per-epoch sketch spelling as the XLA scan step
+        # (telemetry.numerics), computed on the kernel's stacked
+        # outputs inside this jit — every reduction is exact and
+        # order-independent, so a fused and an XLA run of bitwise-equal
+        # tensors produce bitwise-equal sketches (the cross-engine
+        # canary's comparison basis). Per-epoch consensus exists only
+        # when the kernel was asked to save it; records compare on the
+        # intersection of captured streams.
+        from yuma_simulation_tpu.telemetry.numerics import capture_streams
+
+        streams = {"dividends": ys["dividends"]}
+        if "consensus" in ys:
+            streams["consensus"] = ys["consensus"]
+        ys["numerics"] = capture_streams(
+            streams, epoch_axis=1 if weights.ndim == 4 else 0
+        )
     if not return_carry:
         return ys
     carry_out = {
@@ -504,6 +562,7 @@ _simulate_scan_streamed = partial(
         "mesh",
         "return_carry",
         "guard_nonfinite",
+        "capture_numerics",
     ),
     donate_argnames=("carry",),
 )(getattr(_simulate_scan, "__wrapped__"))
@@ -517,6 +576,7 @@ _simulate_case_fused_streamed = partial(
         "save_consensus",
         "mxu",
         "return_carry",
+        "capture_numerics",
     ),
     donate_argnames=("carry",),
 )(getattr(_simulate_case_fused, "__wrapped__"))
@@ -732,6 +792,10 @@ def simulate(
         with dispatch_annotation(f"simulate:{rung}"):
             return _dispatch_engine(rung)
 
+    from yuma_simulation_tpu.telemetry.numerics import numerics_enabled
+
+    capture = numerics_enabled()
+
     def _dispatch_engine(rung: str):
         if rung in ("fused_scan", "fused_scan_mxu"):
             faults.maybe_fail_fused_dispatch()
@@ -746,6 +810,7 @@ def simulate(
                 save_incentives=save_incentives,
                 save_consensus=save_consensus,
                 mxu=rung == "fused_scan_mxu",
+                capture_numerics=capture,
             )
         else:
             # Demoted off a fused rung: the plan pre-resolved the
@@ -779,6 +844,7 @@ def simulate(
                     if nf is None or nf.case is not None
                     else jnp.asarray(nf.epoch, jnp.int32)
                 ),
+                capture_numerics=capture,
             )
         if retry_policy is not None or deadline is not None:
             # Surface async dispatch failures (device OOM) inside the
@@ -819,6 +885,7 @@ def simulate(
         incentives=ys.get("incentives"),
         consensus=ys.get("consensus"),
         demotions=demotions,
+        numerics=ys.get("numerics"),
     )
 
 
@@ -1179,8 +1246,14 @@ def _simulate_streamed_attempt(
     re_ = jnp.asarray(
         -1 if reset_bonds_epoch is None else reset_bonds_epoch, jnp.int32
     )
+    from yuma_simulation_tpu.telemetry.numerics import numerics_enabled
+
+    capture = numerics_enabled()
     state: dict = {}  # "plan": DispatchPlan, set on the first chunk
     host: dict[str, list] = {"dividends": []}
+    #: Per-chunk numerics sketches; the chunk-invariant merge is plain
+    #: concatenation along the epoch axis (telemetry.numerics).
+    sketches: list = []
     if save_bonds:
         host["bonds"] = []
     if save_incentives:
@@ -1253,6 +1326,7 @@ def _simulate_streamed_attempt(
                 carry=carry,
                 epoch_offset=offset,
                 return_carry=True,
+                capture_numerics=capture,
             )
         return _simulate_scan_streamed(
             Wc,
@@ -1268,6 +1342,7 @@ def _simulate_streamed_attempt(
             carry=carry,
             epoch_offset=offset,
             return_carry=True,
+            capture_numerics=capture,
         )
 
     def _flush(ys):
@@ -1279,6 +1354,10 @@ def _simulate_streamed_attempt(
         # overlaps the NEXT chunk's compute, not this one's.
         for k, acc in host.items():
             acc.append(np.asarray(ys[k]))
+        if "numerics" in ys:
+            from yuma_simulation_tpu.telemetry.numerics import to_host
+
+            sketches.append(to_host(ys["numerics"]))
 
     it = slabs()
     cur = next(it, None)
@@ -1314,11 +1393,17 @@ def _simulate_streamed_attempt(
         cur = nxt
     _flush(pending)
     cat = {k: np.concatenate(v) for k, v in host.items()}
+    numerics = None
+    if sketches:
+        from yuma_simulation_tpu.telemetry.numerics import concat_sketches
+
+        numerics = concat_sketches(sketches)
     return SimulationResult(
         dividends=cat["dividends"],
         bonds=cat.get("bonds"),
         incentives=cat.get("incentives"),
         consensus=cat.get("consensus"),
+        numerics=numerics,
     )
 
 

@@ -59,6 +59,20 @@ logger = logging.getLogger(__name__)
 #: re-exports it: rung eligibility and rung ordering are one decision.)
 ENGINE_LADDER = ("fused_scan_mxu", "fused_scan", "xla")
 
+#: The ONE documented accepted-drift class (ADVICE r5): an EXPLICIT
+#: fused opt-in beyond the int32 dyadic-quantization bound pairs the
+#: fused kernel's plain-sum u16-quantize fallback against the XLA
+#: rung's blocked miner_sum fallback — a one-ulp drift surface the auto
+#: planner refuses (eligibility gates) but an explicit request may
+#: cross on demotion. Canary records crossing it are stamped
+#: ``expected`` with this reason, so ``driftreport --check`` renders
+#: it instead of failing the pipeline.
+EXPECTED_DRIFT_U16_FALLBACK = (
+    "u16-quantize fallback pairing: explicit fused opt-in beyond the "
+    "int32 dyadic bound may differ from the XLA rung by one ulp "
+    "(ADVICE r5; auto never pairs these)"
+)
+
 #: Tile geometry the donor-packing bucket targets: the VPU/MXU operate
 #: on (8, 128) f32 tiles, so a padded batch below these bounds wastes
 #: the very lanes packing exists to fill.
@@ -388,6 +402,24 @@ def _plan_engine(
                 "the fused case scan computes consensus by bisection; "
                 "consensus_impl='sorted' requires epoch_impl='xla'"
             )
+        import math
+
+        from yuma_simulation_tpu.ops.consensus import dyadic_grid_fits_int32
+
+        if not dyadic_grid_fits_int32(
+            shape[-1], math.ceil(math.log2(config.consensus_precision))
+        ):
+            # An EXPLICIT fused opt-in beyond the int32 dyadic bound:
+            # auto never lands here (the eligibility gates refuse, so
+            # the planner cannot pair the two quantize fallbacks
+            # unasked — ADVICE r5), but an explicit request is honored
+            # with the caveat RECORDED: the fused in-kernel fallback
+            # (plain sum) and the XLA blocked miner_sum fallback may
+            # differ by one ulp, so a demotion or numerics canary
+            # crossing this pairing is a DOCUMENTED accepted-drift
+            # class (the supervisor stamps such canary records
+            # `expected`, and driftreport renders instead of failing).
+            reasons.append(EXPECTED_DRIFT_U16_FALLBACK)
         return epoch_impl, consensus_impl
     if epoch_impl != "xla":
         raise ValueError(

@@ -135,6 +135,7 @@ def stack_scenarios(scenarios: Sequence[Scenario], dtype=jnp.float32):
         "save_incentives",
         "consensus_impl",
         "guard_nonfinite",
+        "capture_numerics",
     ),
 )
 def _simulate_batch_xla(
@@ -150,12 +151,14 @@ def _simulate_batch_xla(
     miner_mask=None,
     guard_nonfinite: bool = False,
     nan_fault_epochs=None,  # [B] i32, -1 = healthy lane (fault injection)
+    capture_numerics: bool = False,
+    drift_fault_epochs=None,  # [B] i32, -1 = healthy lane (drift canary)
 ):
     """The XLA rung of :func:`simulate_batch`: one `vmap` of the scan
     engine over the scenario axis (and batched config leaves), with the
     resilience knobs threaded per lane."""
     batched_cfg = config_is_batched(config)
-    fn = lambda W, S, ri, re, mm, nf, cfg: _simulate_scan(  # noqa: E731
+    fn = lambda W, S, ri, re, mm, nf, df, cfg: _simulate_scan(  # noqa: E731
         W,
         S,
         ri,
@@ -169,15 +172,18 @@ def _simulate_batch_xla(
         miner_mask=mm,
         guard_nonfinite=guard_nonfinite,
         nan_fault_epoch=nf,
+        capture_numerics=capture_numerics,
+        drift_fault_epoch=df,
     )
     cfg_ax = config_vmap_axes(config) if batched_cfg else None
     mm_ax = None if miner_mask is None else 0
     nf_ax = None if nan_fault_epochs is None else 0
+    df_ax = None if drift_fault_epochs is None else 0
     return jax.vmap(
-        fn, in_axes=(0, 0, 0, 0, mm_ax, nf_ax, cfg_ax)
+        fn, in_axes=(0, 0, 0, 0, mm_ax, nf_ax, df_ax, cfg_ax)
     )(
         weights, stakes, reset_index, reset_epoch, miner_mask,
-        nan_fault_epochs, config,
+        nan_fault_epochs, drift_fault_epochs, config,
     )
 
 
@@ -280,6 +286,23 @@ def simulate_batch(
         with dispatch_annotation(f"simulate_batch:{rung}"):
             return _dispatch_engine(rung)
 
+    from yuma_simulation_tpu.telemetry.numerics import numerics_enabled
+
+    capture = numerics_enabled()
+
+    def _lane_epochs(fault):
+        """`[B]` poison-epoch operand from a per-case fault (-1 =
+        healthy lane), shared by the NaN and drift injections."""
+        if fault is None:
+            return None
+        B = weights.shape[0]
+        lanes = np.full(B, -1, np.int32)
+        if fault.case is None:
+            lanes[:] = fault.epoch
+        elif 0 <= fault.case < B:
+            lanes[fault.case] = fault.epoch
+        return jnp.asarray(lanes)
+
     def _dispatch_engine(rung: str):
         if rung in ("fused_scan", "fused_scan_mxu"):
             faults.maybe_fail_fused_dispatch()
@@ -298,22 +321,13 @@ def simulate_batch(
                 save_incentives=save_incentives,
                 save_consensus=False,
                 mxu=rung == "fused_scan_mxu",
+                capture_numerics=capture,
             )
         else:
             # The plan pre-resolved the XLA-rung consensus — both for a
             # direct XLA dispatch and for a demotion off a fused rung
             # (whose checks admit only auto/bisect requests).
             cons = plan.fallback_consensus
-            nf = faults.active_nan_fault()
-            nf_epochs = None
-            if nf is not None:
-                B = weights.shape[0]
-                lanes = np.full(B, -1, np.int32)
-                if nf.case is None:
-                    lanes[:] = nf.epoch
-                elif 0 <= nf.case < B:
-                    lanes[nf.case] = nf.epoch
-                nf_epochs = jnp.asarray(lanes)
             out = _simulate_batch_xla(
                 weights,
                 stakes,
@@ -326,7 +340,14 @@ def simulate_batch(
                 consensus_impl=cons,
                 miner_mask=miner_mask,
                 guard_nonfinite=quarantine,
-                nan_fault_epochs=nf_epochs,
+                nan_fault_epochs=_lane_epochs(faults.active_nan_fault()),
+                capture_numerics=capture,
+                # The drift canary's single-ulp lane flip: armed only
+                # inside canary re-executions (faults.canary_scope), so
+                # primary dispatches trace the exact production program.
+                drift_fault_epochs=_lane_epochs(
+                    faults.active_drift_fault()
+                ),
             )
         if retry_policy is not None or deadline is not None:
             out = jax.block_until_ready(out)
@@ -380,6 +401,8 @@ def sweep_hyperparams(
         -1 if scenario.reset_bonds_epoch is None else scenario.reset_bonds_epoch,
         jnp.int32,
     )
+    from yuma_simulation_tpu.telemetry.numerics import numerics_enabled
+
     fn = lambda cfg: _simulate_scan(  # noqa: E731
         W,
         S,
@@ -391,6 +414,7 @@ def sweep_hyperparams(
         save_incentives=False,
         save_consensus=False,
         guard_nonfinite=quarantine,
+        capture_numerics=numerics_enabled(),
     )
     return jax.vmap(fn)(configs)
 

@@ -16,6 +16,11 @@ Bundle layout (inside the supervisor's checkpoint `directory`):
 - ``costs.jsonl``   — AOT cost records (:class:`..cost.CostRecord`
   lines, run-stamped) when anything captured them — the supervisor's
   opt-in, bench, or an operator's explicit capture;
+- ``numerics.jsonl`` — per-epoch tensor-stat records
+  (:mod:`..numerics`): one line per (unit, stream, role) with per-lane
+  finite fraction / min / max / absmax and the bit-cast-u32 reduction
+  fingerprint, primary and canary roles side by side — what
+  ``tools/driftreport.py --check`` compares;
 - ``report.json``   — the LAST run's :class:`SweepHealthReport` (plus
   its ``run_id``), for the ledger<->report cross-check.
 
@@ -49,6 +54,7 @@ METRICS_NAME = "metrics.jsonl"
 COSTS_NAME = "costs.jsonl"
 REPORT_NAME = "report.json"
 SLO_NAME = "slo.json"
+NUMERICS_NAME = "numerics.jsonl"
 
 #: The SweepHealthReport action counts the ledger must reproduce exactly
 #: (report field -> derivation, see :func:`ledger_counts`).
@@ -58,6 +64,10 @@ CROSS_CHECKED_COUNTS = (
     "engine_demotions",
     "mesh_shrinks",
     "lanes_quarantined",
+    # 0.14.0 — numerics-canary accounting (additive: pre-0.14 reports
+    # lack the keys and are skipped by the `key in fields` guard).
+    "canaries_run",
+    "drift_events",
 )
 
 
@@ -172,6 +182,32 @@ class FlightRecorder:
             fh.flush()
             os.fsync(fh.fileno())
 
+    def append_numerics(
+        self, records, *, run_id: Optional[str] = None
+    ) -> None:
+        """Append numerics records to ``numerics.jsonl`` WITHOUT the
+        whole-file merge :meth:`record_numerics` does — the
+        :meth:`append_spans` contract applied to the numerics stream
+        (O(batch) on a handler thread, caller serializes publishes,
+        the next full :meth:`record_numerics` merge dedupes by
+        identity and heals a torn tail)."""
+        lines = []
+        for rec in records:
+            line = dict(rec)
+            if run_id is not None:
+                line["run_id"] = run_id
+            lines.append(line)
+        if not lines:
+            return
+        payload = "".join(
+            json.dumps(r, sort_keys=True) + "\n" for r in lines
+        )
+        self.directory.mkdir(parents=True, exist_ok=True)
+        with open(self.directory / NUMERICS_NAME, "ab") as fh:
+            fh.write(payload.encode())
+            fh.flush()
+            os.fsync(fh.fileno())
+
     def record_slo(self, engine=None, *, run_id: Optional[str] = None) -> None:
         """Publish the SLO engine's state (specs, per-SLO burn state,
         sketches, alert history) as ``slo.json`` — what
@@ -192,6 +228,44 @@ class FlightRecorder:
         publish_atomic(
             self.directory / SLO_NAME,
             json.dumps(snap, sort_keys=True).encode(),
+        )
+
+    def record_numerics(
+        self, records, *, run_id: Optional[str] = None
+    ) -> None:
+        """Append per-epoch numerics records (the serialized sketches
+        of :func:`..numerics.sketch_records`) to ``numerics.jsonl``,
+        each stamped with `run_id`. Merged by the engine-free
+        :func:`..numerics.numerics_identity`, newest wins — so the
+        stream SURVIVES a failed/resumed sweep exactly like
+        ``costs.jsonl``: a resumed run's bundle keeps the prior run's
+        records for units it never re-executed, and a re-executed
+        unit's capture replaces its prior line instead of duplicating
+        it — even when the retry landed on a DIFFERENT rung (a stale
+        other-engine primary left behind would mispair against later
+        canaries)."""
+        from yuma_simulation_tpu.telemetry.numerics import (
+            numerics_identity,
+        )
+        from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+
+        lines = []
+        for rec in records:
+            line = dict(rec)
+            if run_id is not None:
+                line["run_id"] = run_id
+            lines.append(line)
+        if not lines and not (self.directory / NUMERICS_NAME).exists():
+            return
+        path = self.directory / NUMERICS_NAME
+        merged: dict[tuple, dict] = {}
+        for rec in _read_jsonl(path) + lines:
+            merged[numerics_identity(rec)] = rec
+        publish_atomic(
+            path,
+            "".join(
+                json.dumps(r, sort_keys=True) + "\n" for r in merged.values()
+            ).encode(),
         )
 
     def record_costs(self, records, *, run_id: Optional[str] = None) -> None:
@@ -241,6 +315,7 @@ class Bundle:
     report: Optional[dict] = None
     costs: list = dataclasses.field(default_factory=list)
     slo: Optional[dict] = None
+    numerics: list = dataclasses.field(default_factory=list)
 
     def run_ids(self) -> list[str]:
         """Distinct run ids, first-seen order (spans then ledger)."""
@@ -277,6 +352,7 @@ def load_bundle(directory: Union[str, pathlib.Path]) -> Bundle:
         report=_json_file(REPORT_NAME),
         costs=_read_jsonl(directory / COSTS_NAME),
         slo=_json_file(SLO_NAME),
+        numerics=_read_jsonl(directory / NUMERICS_NAME),
     )
 
 
@@ -310,6 +386,8 @@ def ledger_counts(ledger: list, run_id: str) -> dict:
         "lanes_quarantined": sum(
             len(r.get("quarantined", ())) for r in last_ok.values()
         ),
+        "canaries_run": sum(int(r.get("canaries", 0)) for r in oks),
+        "drift_events": sum(int(r.get("drifts", 0)) for r in oks),
     }
 
 
@@ -326,9 +404,17 @@ def check_bundle(bundle: Bundle) -> list[str]:
       ledger-derived counts exactly (:data:`CROSS_CHECKED_COUNTS`);
     - every ``costs.jsonl`` record must name its engine, and a null
       analysis field must carry a ``reason`` (the explicit-null
-      contract of :class:`..cost.CostRecord`).
+      contract of :class:`..cost.CostRecord`);
+    - every ``numerics.jsonl`` record must name its stream/engine/role
+      and carry a per-lane fingerprint whose epoch length matches its
+      declared ``epochs`` (the driftreport comparison basis — a record
+      that cannot be compared is rot, not data).
     """
-    problems: list[str] = []
+    from yuma_simulation_tpu.telemetry.numerics import (
+        check_numerics_records,
+    )
+
+    problems: list[str] = list(check_numerics_records(bundle.numerics))
     for i, rec in enumerate(bundle.costs):
         if not rec.get("engine"):
             problems.append(f"costs[{i}] names no engine")
@@ -393,6 +479,7 @@ def merge_bundles(bundles, directory=None) -> Bundle:
     ledger: list = []
     metrics: list = []
     costs: list = []
+    numerics: list = []
     report = None
     slo = None
     for b in bundles:
@@ -401,6 +488,7 @@ def merge_bundles(bundles, directory=None) -> Bundle:
         ledger.extend(b.ledger)
         metrics.extend(b.metrics)
         costs.extend(b.costs)
+        numerics.extend(b.numerics)
         if report is None:
             report = b.report
         if slo is None:
@@ -416,6 +504,7 @@ def merge_bundles(bundles, directory=None) -> Bundle:
         report=report,
         costs=costs,
         slo=slo,
+        numerics=numerics,
     )
 
 

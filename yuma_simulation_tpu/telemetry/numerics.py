@@ -1,0 +1,320 @@
+"""Numerics flight recorder: per-epoch tensor-stat telemetry.
+
+The paper's core contract — every engine rung produces bitwise-identical
+consensus weights, incentives and dividends — was enforced only in
+tests: no production run recorded what the tensors looked like, which
+rung produced them, or whether a re-execution reproduced the primary's
+bits. This module is the always-on capture half of that observability
+(the canary scheduler in :mod:`..resilience.supervisor` and the
+``tools/driftreport.py`` gate are the comparison half):
+
+- :func:`sketch_over_epochs` / :func:`epoch_sketch` compute a
+  :class:`..simulation.carry.NumericsSketch` per epoch per lane —
+  finite fraction, min/max/absmax, and the bit-cast-u32 reduction
+  fingerprint (:mod:`...ops.fingerprint`) — **inside the existing
+  jitted scan bodies**: a handful of scalar reductions per epoch, no
+  host syncs, no extra dispatches, zero warm-repeat compiles (the
+  capture is part of the one traced program).
+- Every reduction is exact and order-independent (integer counts,
+  wrapping-u32 bit sums, min/max), so sketches are bitwise invariant
+  across monolithic, chunk-streamed and miner-sharded execution of the
+  same case — merging chunked captures is concatenation along the
+  epoch axis (:func:`concat_sketches`), and a sharded psum of the
+  fingerprint equals the unsharded reduce by construction.
+- :func:`sketch_records` serializes host-fetched sketches into the
+  ``numerics.jsonl`` records the flight bundle carries
+  (:meth:`..flight.FlightRecorder.record_numerics`), and
+  :func:`first_divergence` / :func:`diff_records` localize the first
+  divergent epoch and per-lane ulp distance between two captures —
+  what the cross-engine canary and ``driftreport --check`` act on.
+
+One switch disables the whole stream: ``YUMA_NUMERICS=0`` (env). The
+engines take the resolved flag as a static jit argument, so flipping it
+selects a different (cached) program rather than retracing warm paths.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+#: Stream names captured per engine dispatch, in capture order. The
+#: fused kernel emits per-epoch consensus only when asked to save it,
+#: so records compare on the intersection of streams present.
+NUMERICS_STREAMS = ("dividends", "consensus")
+
+
+def numerics_enabled() -> bool:
+    """The one config/env switch: ``YUMA_NUMERICS=0`` (or ``false``/
+    ``off``) disables per-epoch numerics capture everywhere. Default
+    on — the capture is a handful of exact scalar reductions per epoch,
+    and a production system that can silently flip a dividend cell
+    without telemetry has no numerics observability at all."""
+    return os.environ.get("YUMA_NUMERICS", "1").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+    )
+
+
+# ------------------------------------------------------------------ capture
+# jit-safe: called inside the engines' traced bodies only.
+
+
+def epoch_sketch(x):
+    """The per-epoch sketch of one tensor (all axes reduced) — the
+    spelling the XLA scan step uses. Exact/order-independent reductions
+    only (see the module docstring), shared with
+    :func:`sketch_over_epochs` so stacked and in-scan captures of the
+    same bits are bitwise identical."""
+    return sketch_over_epochs(x[None], epoch_axis=0, _squeeze=True)
+
+
+def sketch_over_epochs(x, epoch_axis: int, _squeeze: bool = False):
+    """Per-epoch :class:`..simulation.carry.NumericsSketch` of a
+    stacked stream: every axis AFTER `epoch_axis` is reduced per epoch,
+    leading axes (batch lanes) are kept. `[E, V] -> [E]` sketches,
+    `[B, E, V] -> [B, E]` sketches."""
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.ops.fingerprint import fingerprint_u32
+    from yuma_simulation_tpu.simulation.carry import NumericsSketch
+
+    x = jnp.asarray(x)
+    axes = tuple(range(epoch_axis + 1, x.ndim))
+    size = 1
+    for d in x.shape[epoch_axis + 1 :]:
+        size *= int(d)
+    size = max(1, size)
+    finite = jnp.sum(
+        jnp.isfinite(x).astype(jnp.int32), axis=axes, dtype=jnp.int32
+    )
+    # min/max over a stream with NaNs would poison the stats exactly
+    # where they matter; the masked forms keep them informative while
+    # finite_frac carries the failure signal. absmax of an all-NaN
+    # epoch reads 0 by the same masking.
+    zero = jnp.zeros((), x.dtype)
+    # Dtype-pinned infinities (jaxlint JX005): a weak Python-float inf
+    # must not promote the stats under the x64 parity harness.
+    inf = jnp.asarray(float("inf"), dtype=x.dtype)
+    ok = jnp.isfinite(x)
+    sketch = NumericsSketch(
+        finite_frac=(finite.astype(x.dtype) / size),
+        lo=jnp.min(jnp.where(ok, x, inf), axis=axes),
+        hi=jnp.max(jnp.where(ok, x, -inf), axis=axes),
+        absmax=jnp.max(jnp.where(ok, jnp.abs(x), zero), axis=axes),
+        fingerprint=fingerprint_u32(x, axes=axes),
+    )
+    if _squeeze:
+        import jax
+
+        sketch = jax.tree.map(lambda leaf: leaf[0], sketch)
+    return sketch
+
+
+def capture_streams(
+    streams: dict, epoch_axis: Optional[int] = None
+) -> dict:
+    """Sketch every non-None stream. `epoch_axis=None` means the inputs
+    are single-epoch tensors (the in-scan spelling); an int means
+    stacked streams (`[.., E, ..]`, the fused-wrapper spelling)."""
+    out = {}
+    for name, x in streams.items():
+        if x is None:
+            continue
+        out[name] = (
+            epoch_sketch(x)
+            if epoch_axis is None
+            else sketch_over_epochs(x, epoch_axis)
+        )
+    return out
+
+
+# --------------------------------------------------------------- host side
+
+
+def to_host(sketches: dict) -> dict:
+    """Fetch a captured sketch pytree to numpy (leaf-wise)."""
+    import jax
+
+    return jax.tree.map(np.asarray, sketches)
+
+
+def concat_sketches(chunks: list) -> dict:
+    """Merge per-chunk sketch captures of one stream set along the
+    epoch axis (the LAST axis of every leaf) — the chunk-invariant
+    merge: per-epoch values concatenate, nothing is re-reduced."""
+    import jax
+
+    if not chunks:
+        return {}
+    return jax.tree.map(
+        lambda *leaves: np.concatenate(
+            [np.atleast_1d(np.asarray(leaf)) for leaf in leaves], axis=-1
+        ),
+        *chunks,
+    )
+
+
+def _lane_lists(arr: np.ndarray) -> list:
+    """`[E]` or `[L, E]` -> per-lane python lists (always 2-D)."""
+    a = np.atleast_2d(np.asarray(arr))
+    return [lane.tolist() for lane in a]
+
+
+def sketch_records(
+    sketches: dict,
+    *,
+    unit: int,
+    lanes,
+    engine: str,
+    role: str = "primary",
+    label: str = "",
+) -> list:
+    """Serialize one dispatch's host-fetched sketches into
+    ``numerics.jsonl`` records: one record per stream, per-lane arrays
+    nested (`fingerprint[lane][epoch]`, uint32 as ints). `role` is
+    "primary" or "canary"; `lanes` the `[lo, hi)` global-lane window."""
+    records = []
+    for stream, sk in sorted(sketches.items()):
+        fp = np.atleast_2d(np.asarray(sk.fingerprint)).astype(np.uint32)
+        records.append(
+            {
+                "unit": int(unit),
+                "lanes": [int(lanes[0]), int(lanes[1])],
+                "stream": stream,
+                "engine": engine,
+                "role": role,
+                "label": label,
+                "epochs": int(fp.shape[-1]),
+                "fingerprint": [lane.tolist() for lane in fp],
+                "finite_frac": _lane_lists(sk.finite_frac),
+                "min": _lane_lists(sk.lo),
+                "max": _lane_lists(sk.hi),
+                "absmax": _lane_lists(sk.absmax),
+            }
+        )
+    return records
+
+
+def first_divergence(fp_a, fp_b) -> Optional[tuple]:
+    """First epoch where two per-epoch fingerprint sequences differ,
+    with the ulp distance there — `(epoch, ulp)` or None when bitwise
+    identical. Length mismatches diverge at the shorter length."""
+    from yuma_simulation_tpu.ops.fingerprint import ulp_delta
+
+    a = np.asarray(fp_a, np.uint32).ravel()
+    b = np.asarray(fp_b, np.uint32).ravel()
+    n = min(a.size, b.size)
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    if neq.size:
+        e = int(neq[0])
+        return e, ulp_delta(int(a[e]), int(b[e]))
+    if a.size != b.size:
+        return n, 0
+    return None
+
+
+def compare_sketches(primary: dict, canary: dict) -> dict:
+    """Per-stream divergences between two host-fetched sketch sets of
+    the SAME workload (a primary dispatch and its cross-engine canary):
+    ``{stream: [{"lane", "first_divergent_epoch", "ulp_distance"}, ...]}``
+    over the INTERSECTION of captured streams (the fused kernel emits a
+    per-epoch consensus stream only when asked to save it). Empty dict =
+    bitwise identical everywhere the two captures overlap."""
+    out: dict = {}
+    for stream in sorted(set(primary) & set(canary)):
+        fa = np.atleast_2d(np.asarray(primary[stream].fingerprint))
+        fb = np.atleast_2d(np.asarray(canary[stream].fingerprint))
+        divergences = []
+        for lane in range(max(fa.shape[0], fb.shape[0])):
+            a = fa[lane] if lane < fa.shape[0] else np.empty(0, np.uint32)
+            b = fb[lane] if lane < fb.shape[0] else np.empty(0, np.uint32)
+            div = first_divergence(a, b)
+            if div is not None:
+                divergences.append(
+                    {
+                        "lane": lane,
+                        "first_divergent_epoch": div[0],
+                        "ulp_distance": div[1],
+                    }
+                )
+        if divergences:
+            out[stream] = divergences
+    return out
+
+
+def numerics_identity(rec: dict) -> tuple:
+    """The ONE record-identity spelling for the ``numerics.jsonl``
+    stream: ``(unit, lanes, stream, role, label)`` — deliberately
+    engine-FREE, so a unit re-executed on a demoted rung REPLACES its
+    prior capture (newest wins) instead of leaving a stale
+    other-engine primary behind for a later canary to mispair
+    against. Used by both the flight-recorder merge and the
+    driftreport comparison (two spellings would fork the dedupe from
+    the gate)."""
+    return (
+        rec.get("unit"),
+        tuple(rec.get("lanes") or ()),
+        rec.get("stream"),
+        rec.get("role"),
+        rec.get("label", ""),
+    )
+
+
+def check_numerics_records(records) -> list[str]:
+    """Structural rot in serialized ``numerics.jsonl`` records — the
+    ONE shared validator behind both :func:`..flight.check_bundle`'s
+    numerics block and ``tools/driftreport.py --check``'s exit-2
+    class (two spellings of the comparison basis would fork the gate
+    from the cross-check exactly the way forked reductions fork the
+    consensus). A record that cannot be compared is not a pass."""
+    problems: list[str] = []
+    for i, rec in enumerate(records):
+        for field in ("stream", "engine", "role"):
+            if not rec.get(field):
+                problems.append(f"numerics[{i}] names no {field}")
+        if rec.get("role") not in ("primary", "canary", None):
+            problems.append(
+                f"numerics[{i}] has unknown role {rec.get('role')!r}"
+            )
+        fp = rec.get("fingerprint")
+        if not isinstance(fp, list) or not fp:
+            problems.append(f"numerics[{i}] carries no fingerprint lanes")
+            continue
+        epochs = rec.get("epochs")
+        for lane in fp:
+            if not isinstance(lane, list) or (
+                isinstance(epochs, int) and len(lane) != epochs
+            ):
+                problems.append(
+                    f"numerics[{i}] fingerprint lane length mismatches "
+                    f"declared epochs={epochs!r}"
+                )
+                break
+    return problems
+
+
+def diff_records(primary: dict, canary: dict) -> list:
+    """Per-lane divergences between two ``numerics.jsonl`` records of
+    the same (unit, stream): a list of
+    ``{"lane", "first_divergent_epoch", "ulp_distance"}`` dicts (empty
+    = bitwise identical). Lanes index within the record's window; add
+    ``lanes[0]`` for the sweep-global lane."""
+    out = []
+    fa, fb = primary.get("fingerprint", []), canary.get("fingerprint", [])
+    for lane in range(max(len(fa), len(fb))):
+        a = fa[lane] if lane < len(fa) else []
+        b = fb[lane] if lane < len(fb) else []
+        div = first_divergence(a, b)
+        if div is not None:
+            out.append(
+                {
+                    "lane": lane,
+                    "first_divergent_epoch": div[0],
+                    "ulp_distance": div[1],
+                }
+            )
+    return out

@@ -573,6 +573,23 @@ DEFAULT_SLO_SPECS = (
         min_events=10,
         degrade=False,
     ),
+    # The numerics-drift objective (0.14.0): every cross-engine canary
+    # re-execution must reproduce the primary's bits. min_events=1 by
+    # design — a SINGLE confirmed drift is an incident, not noise (the
+    # event stream only carries deliberate canary comparisons, never
+    # request traffic), so one bad canary fast-burns, flips `/healthz`
+    # to degraded, and fails `sloreport --check` until recovery.
+    SLOSpec(
+        "engine_drift",
+        objective=0.999,
+        description="cross-engine numerics canaries reproducing the "
+        "primary's bits (telemetry.numerics)",
+        event="engine_drift_ok",
+        fast_window_seconds=60.0,
+        slow_window_seconds=600.0,
+        min_events=1,
+        degrade=True,
+    ),
 )
 
 _ENGINE: Optional[SLOEngine] = None
@@ -617,3 +634,14 @@ def observe_duration(metric: str, seconds: float) -> None:
         get_slo_engine().observe(metric, seconds)
     except Exception:
         logger.warning("SLO observation failed for %s", metric, exc_info=True)
+
+
+def observe_event(metric: str, ok: bool) -> None:
+    """Feed one good/bad event into the process engine — the numerics
+    canary's ``engine_drift_ok`` stream (a drift-confirming comparison
+    is the bad event). Same never-raises contract as
+    :func:`observe_duration`."""
+    try:
+        get_slo_engine().event(metric, ok)
+    except Exception:
+        logger.warning("SLO event failed for %s", metric, exc_info=True)
