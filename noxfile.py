@@ -35,12 +35,48 @@ def format_(session: nox.Session) -> None:
 @nox.session
 def lint(session: nox.Session) -> None:
     """Static guarantees (README "Static guarantees"): the project's own
-    TPU-discipline analyzer (tools/jaxlint — stdlib-ast, --strict also
-    fails on rotted suppressions), ruff, and mypy over the TPU package."""
+    whole-program TPU-discipline analyzer (tools/jaxlint — stdlib-ast,
+    interprocedural since 0.15.0, --strict also fails on rotted
+    suppressions) over all three roots, ruff, and mypy over the TPU
+    package. The `analysis` session runs the full gate set (shapecheck,
+    registry) with JSON artifacts."""
     session.install("ruff==0.8.4", "mypy==1.13.0", "-e", ".")
-    session.run("python", "-m", "tools.jaxlint", "yuma_simulation_tpu", "--strict")
+    session.run(
+        "python", "-m", "tools.jaxlint",
+        "yuma_simulation_tpu", "tools", "tests", "--strict",
+    )
     session.run("ruff", "check", *LINT_TARGETS)
     session.run("mypy", "yuma_simulation_tpu")
+
+
+@nox.session
+def analysis(session: nox.Session) -> None:
+    """Whole-program analysis lane (mirrors the CI `analysis` job):
+    jaxlint --strict over yuma_simulation_tpu + tools + tests (tracing
+    reach through the call graph, JX1xx concurrency discipline, JX2xx
+    telemetry contracts), the zero-compile shapecheck gate over the
+    planner bucket grid, and the telemetry-registry runtime validation.
+    JSON findings land in the session tmp dir, same schema CI uploads."""
+    session.install("-e", ".[test]")
+    import os
+
+    tmp = session.create_tmp()
+    session.run(
+        "python", "-m", "tools.jaxlint",
+        "yuma_simulation_tpu", "tools", "tests", "--strict",
+        "--artifact", os.path.join(tmp, "jaxlint_findings.json"),
+    )
+    session.run(
+        "python", "-m", "tools.shapecheck", "--check",
+        "--artifact", os.path.join(tmp, "shapecheck_report.json"),
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    session.run(
+        "python", "-c",
+        "from yuma_simulation_tpu.telemetry.registry import "
+        "validate_registry; import sys; p = validate_registry(); "
+        "print('\\n'.join(p)); sys.exit(1 if p else 0)",
+    )
 
 
 @nox.session
@@ -65,6 +101,7 @@ TEST_CHUNKS = [
         "tests/unit/test_consensus_fuzz.py",
         "tests/unit/test_csv_byte_parity.py",
         "tests/unit/test_f32_mode_parity.py",
+        "tests/unit/test_shapecheck.py",
     ],
     [
         "tests/unit/test_fused_case_scan.py",

@@ -221,12 +221,218 @@ def stage(chunk, dispatch):
     return dispatch(staged)
 """,
     ),
+    "JX010": (
+        # wall-clock in a helper REACHABLE from a jit scope: invisible
+        # to the per-function pass, found through the call graph
+        """
+import jax
+import time
+import uuid
+
+def stamp(x):
+    return x * time.time(), uuid.uuid4()
+
+@jax.jit
+def f(x):
+    y, tag = stamp(x + 1)
+    return y
+""",
+        # host-side timing around the dispatch, and an is-tracing
+        # self-guarded recorder, are the supported patterns
+        """
+import jax
+import time
+
+def _tracing_now():
+    return False
+
+def record(x):
+    if _tracing_now():
+        return
+    print(time.time())
+
+@jax.jit
+def f(x):
+    record(x)
+    return x + 1
+
+def bench(x):
+    t0 = time.perf_counter()
+    f(x)
+    return time.perf_counter() - t0
+""",
+    ),
+    "JX101": (
+        # field written under the lock in one method, read bare in
+        # another: a torn read under the serve+fleet thread mix
+        """
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._items = []
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+            self._count += 1
+
+    def snapshot(self):
+        return list(self._items), self._count
+""",
+        # every access locked, __init__ exempt, *_locked helper
+        # convention honored
+        """
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._items = []
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+            self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self):
+        return list(self._items), self._count
+""",
+    ),
+    "JX102": (
+        # direct write-mode open on a durable artifact path: a crash
+        # mid-write tears the bundle
+        """
+import json
+
+def publish(bundle_dir, payload):
+    with open(bundle_dir / "ledger.jsonl", "w") as fh:
+        json.dump(payload, fh)
+""",
+        # the atomic/append primitives are the sanctioned route; reads
+        # and scratch files stay invisible
+        """
+import json
+from yuma_simulation_tpu.utils.checkpoint import append_durable, publish_atomic
+
+def publish(bundle_dir, payload):
+    publish_atomic(bundle_dir / "ledger.jsonl", json.dumps(payload))
+    append_durable(bundle_dir / "spans.jsonl", b"{}")
+
+def load(bundle_dir):
+    with open(bundle_dir / "ledger.jsonl") as fh:
+        return fh.read()
+
+def scratch(tmp):
+    with open(tmp / "notes.txt", "w") as fh:
+        fh.write("x")
+""",
+    ),
+    "JX103": (
+        # bare Thread target reading the ambient telemetry context:
+        # contextvars do not flow into a new thread
+        """
+import contextvars
+import threading
+
+RUN = contextvars.ContextVar("RUN", default=None)
+
+def worker():
+    return RUN.get()
+
+def spawn():
+    t = threading.Thread(target=worker)
+    t.start()
+    return t
+""",
+        # the watchdog pattern: copy the spawner's context explicitly
+        """
+import contextvars
+import threading
+
+RUN = contextvars.ContextVar("RUN", default=None)
+
+def worker():
+    return RUN.get()
+
+def spawn():
+    ctx = contextvars.copy_context()
+    t = threading.Thread(target=lambda: ctx.run(worker))
+    t.start()
+    return t
+""",
+    ),
+    "JX201": (
+        # typo'd event name: not declared in telemetry/registry.py
+        """
+import logging
+
+logger = logging.getLogger(__name__)
+
+def emit(log_event):
+    log_event(logger, "engine_retyr", attempt=1)
+""",
+        # declared names (and trace-resolvable literal choices) pass
+        """
+import logging
+
+logger = logging.getLogger(__name__)
+
+def emit(log_event, ok):
+    log_event(logger, "engine_retry", attempt=1)
+    log_event(logger, "slo_alert" if not ok else "slo_recovered")
+""",
+    ),
+    "JX202": (
+        # metric series nobody declared: drifts away from dashboards
+        """
+def count(registry):
+    registry.counter("engine_retires").inc()
+""",
+        """
+def count(registry):
+    registry.counter("engine_retries").inc()
+    registry.gauge("serve_queue_depth").set(0)
+""",
+    ),
+    "JX203": (
+        # registry entry with no consumer and no justification: the
+        # name LOOKS monitored and is not
+        """
+EVENTS = {
+    "mystery_event": EventSpec("what even reads this"),
+}
+""",
+        """
+EVENTS = {
+    "mystery_event": EventSpec(
+        "incident forensics",
+        operator_reason="greppable breadcrumb between attempt spans",
+    ),
+}
+""",
+    ),
 }
 
 #: rules whose scope is path-filtered
 _RULE_PATHS = {
     "JX007": "yuma_simulation_tpu/v1/api.py",
     "JX008": "yuma_simulation_tpu/simulation/engine.py",
+    # JX102/JX201/JX202 only police package code (tools/tests write
+    # scratch files and fixture events by design)
+    "JX101": "yuma_simulation_tpu/serve/store.py",
+    "JX102": "yuma_simulation_tpu/telemetry/sink.py",
+    "JX103": "yuma_simulation_tpu/resilience/spawn.py",
+    "JX201": "yuma_simulation_tpu/fabric/emit.py",
+    "JX202": "yuma_simulation_tpu/fabric/count.py",
+    "JX203": "yuma_simulation_tpu/telemetry/registry.py",
 }
 
 
@@ -293,13 +499,189 @@ def test_rule_registry_covers_corpus():
 
 
 def test_live_codebase_is_clean_strict(capsys):
-    """The acceptance gate: `python -m tools.jaxlint yuma_simulation_tpu/
-    --strict` exits 0 on the repo (no violations, no rotting
-    suppressions)."""
-    pkg = os.path.join(REPO, "yuma_simulation_tpu")
-    rc = main([pkg, "--strict"])
+    """The acceptance gate: `python -m tools.jaxlint yuma_simulation_tpu
+    tools tests --strict` exits 0 on the repo — all three roots, no
+    violations, no rotting suppressions."""
+    roots = [
+        os.path.join(REPO, "yuma_simulation_tpu"),
+        os.path.join(REPO, "tools"),
+        os.path.join(REPO, "tests"),
+    ]
+    rc = main([*roots, "--strict"])
     out = capsys.readouterr().out
     assert rc == 0, f"jaxlint --strict found violations:\n{out}"
+
+
+# --------------------------------------------------------------------------
+# whole-program layer: interprocedural reach, cross-module facts
+
+
+def test_interprocedural_host_cast_through_helper():
+    """float(tracer) one call away from the jit boundary — invisible to
+    the PR 2 per-function pass, found through the call graph, with the
+    seed chain in the message."""
+    src = """
+import jax
+
+def summarize(v):
+    return float(v.sum())
+
+@jax.jit
+def f(x):
+    return summarize(x * 2)
+"""
+    rep = analyze_source(src, "fixture.py")
+    jx002 = [f for f in rep.findings if f.code == "JX002"]
+    assert jx002, rep.findings
+    assert "traced via" in jx002[0].message
+
+
+def test_directly_nested_closure_is_checked():
+    """A closure defined straight inside the jit body (the lax.scan
+    step idiom) is part of the traced program at EVERY nesting depth —
+    the even-depth-only walk was a real blind spot."""
+    src = """
+import jax
+
+@jax.jit
+def f(x):
+    def g(v):
+        return float(v.sum())
+    def outer(v):
+        def inner(w):
+            return float(w.sum())
+        return inner(v)
+    return g(x) + outer(x)
+"""
+    rep = analyze_source(src, "fixture.py")
+    jx002 = [f for f in rep.findings if f.code == "JX002"]
+    assert len(jx002) == 2, rep.findings
+
+
+def test_reached_helper_closure_params_not_blanket_tainted():
+    """In a helper only REACHABLE from a jit scope, closure params are
+    host dispatch plumbing (rung strings, fault records) — branching
+    on them is not JX003; closure-captured traced values still are."""
+    src = """
+import jax
+
+def dispatch(W):
+    def by_rung(rung):
+        if rung == "fused":
+            return W * 2
+        if W.sum() > 0:
+            return W
+        return -W
+    return by_rung("fused")
+
+@jax.jit
+def f(x):
+    return dispatch(x)
+"""
+    rep = analyze_source(src, "fixture.py")
+    jx003 = [f for f in rep.findings if f.code == "JX003"]
+    # exactly one: the W.sum() branch (captured traced value), not the
+    # rung-string branch
+    assert len(jx003) == 1 and jx003[0].line == 8, rep.findings
+
+
+def test_interprocedural_taint_is_per_parameter():
+    """Only params that actually RECEIVE traced values taint the
+    callee: a helper called with host constants stays clean."""
+    src = """
+import jax
+
+def cast(v):
+    return float(v)
+
+@jax.jit
+def f(x):
+    n = cast(3.5)
+    return x * n
+"""
+    rep = analyze_source(src, "fixture.py")
+    assert [f.code for f in rep.findings] == [], rep.findings
+
+
+def test_interprocedural_cross_module():
+    """Facts flow across FILES: the helper lives in another module of
+    the same analyzed program."""
+    from tools.jaxlint.analyzer import analyze_units
+    from tools.jaxlint.program import parse_unit
+
+    helper = """
+import time
+
+def stamp(x):
+    return x * time.time()
+"""
+    entry = """
+import jax
+from yuma_simulation_tpu.work.helper import stamp
+
+@jax.jit
+def f(x):
+    return stamp(x)
+"""
+    units = [
+        parse_unit(helper, "yuma_simulation_tpu/work/helper.py"),
+        parse_unit(entry, "yuma_simulation_tpu/work/entry.py"),
+    ]
+    reports = analyze_units(units)
+    codes = [f.code for r in reports for f in r.findings]
+    assert "JX010" in codes, codes
+
+
+def test_jit_boundary_stops_interprocedural_reach():
+    """A jit-decorated callee is its own seed, not a continuation of
+    the caller's trace scope (jit-of-jit)."""
+    src = """
+import jax
+import time
+
+@jax.jit
+def inner(x):
+    return x + 1
+
+@jax.jit
+def outer(x):
+    return inner(x)
+
+def unreachable(x):
+    return time.time() * x
+"""
+    rep = analyze_source(src, "fixture.py")
+    assert [f.code for f in rep.findings] == [], rep.findings
+
+
+def test_package_run_without_registry_is_jx203():
+    """Analyzing the package as a program with NO registry module is
+    itself a contracts violation — the pre-PR-11 state."""
+    from tools.jaxlint.analyzer import analyze_units
+    from tools.jaxlint.program import parse_unit
+
+    units = [
+        parse_unit("x = 1\n", "yuma_simulation_tpu/a.py"),
+        parse_unit("y = 2\n", "yuma_simulation_tpu/b.py"),
+    ]
+    reports = analyze_units(units)
+    codes = [f.code for r in reports for f in r.findings]
+    assert codes == ["JX203"], codes
+
+
+# --------------------------------------------------------------------------
+# telemetry registry (the JX2xx contract's declaration side)
+
+
+def test_registry_validates_and_covers_names():
+    from yuma_simulation_tpu.telemetry import registry
+
+    assert registry.validate_registry() == []
+    assert "engine_retry" in registry.declared_events()
+    assert "engine_retries" in registry.declared_metrics()
+    # kinds are pinned so a counter cannot silently become a gauge
+    assert registry.METRICS["serve_queue_depth"].kind == "gauge"
+    assert registry.METRICS["serve_request_seconds"].kind == "histogram"
 
 
 def test_cli_json_output_and_exit_code(tmp_path, capsys):

@@ -1,0 +1,157 @@
+"""shapecheck: live-contract gate + zero-compile pin + detection proofs.
+
+The live gate mirrors test_jaxlint.py's: `python -m tools.shapecheck
+--check` must exit 0 over every planner bucket. The RecompilationSentinel
+test is the acceptance pin that the whole run adds ZERO jit-cache
+entries — abstract shape tracing must never pay an XLA compile. The
+detection tests prove the gate actually rejects: a drifted output
+contract, a donation-invalid carry, and an identity-hashed static arg.
+"""
+
+import dataclasses
+import json
+
+import jax.numpy as jnp
+
+from tools import shapecheck
+from yuma_simulation_tpu.utils.profiling import RecompilationSentinel
+
+
+def test_live_contracts_clean():
+    results = shapecheck.run_shapecheck()
+    bad = [r for r in results if not r.ok]
+    assert not bad, "\n".join(
+        f"{r.contract} [{r.bucket}]: {r.detail}" for r in bad
+    )
+    # the grid genuinely exercises multiple buckets and contracts
+    assert len(shapecheck.build_grid()) >= 4
+    assert len(results) > 50
+
+
+def test_zero_compiles_pinned():
+    """The acceptance pin: the whole shapecheck run — every rung, every
+    bucket, every spec — under a zero-budget sentinel."""
+    with RecompilationSentinel(
+        *shapecheck.ENTRY_POINTS, budget=0, label="shapecheck-pin"
+    ):
+        shapecheck.run_shapecheck()
+
+
+def test_contract_drift_detected(monkeypatch):
+    """A refactor that changes an output's shape must turn the gate
+    red: drift the declared dividends contract and watch every engine
+    check fail."""
+    real = shapecheck._engine_expect
+
+    def drifted(b):
+        want = real(b)
+        want["dividends"] = shapecheck._sds(
+            (max(1, b.epochs), b.padded_V, 2), jnp.float32
+        )
+        return want
+
+    monkeypatch.setattr(shapecheck, "_engine_expect", drifted)
+    results = shapecheck.run_shapecheck()
+    bad = [r for r in results if not r.ok and r.contract == "engine-xla"]
+    assert bad and "dividends" in bad[0].detail
+
+
+def test_missing_output_stream_detected():
+    """_tree_mismatches reports both directions: a dropped stream and
+    an undeclared one."""
+    got = {"dividends": shapecheck._sds((5, 8), jnp.float32)}
+    want = {
+        "dividends": shapecheck._sds((5, 8), jnp.float32),
+        "bonds": shapecheck._sds((5, 8, 128), jnp.float32),
+    }
+    msg = shapecheck._tree_mismatches(got, want, "ys")
+    assert "missing" in msg and "bonds" in msg
+    msg2 = shapecheck._tree_mismatches(want, got, "ys")
+    assert "undeclared" in msg2
+
+
+def test_dtype_drift_detected():
+    got = {"fingerprint": shapecheck._sds((5,), jnp.int32)}
+    want = {"fingerprint": shapecheck._sds((5,), jnp.uint32)}
+    msg = shapecheck._tree_mismatches(got, want, "ys")
+    assert "int32" in msg and "uint32" in msg
+
+
+def test_donation_invalid_carry_detected(monkeypatch):
+    """Donation soundness: feed a carry whose bonds dtype cannot
+    round-trip and require the streamed contract to go red (either as
+    a struct mismatch or a trace-time rejection)."""
+    real = shapecheck._carry_struct
+
+    def torn(b, spec):
+        c = real(b, spec)
+        c["bonds"] = shapecheck._sds(c["bonds"].shape, jnp.float16)
+        return c
+
+    monkeypatch.setattr(shapecheck, "_carry_struct", torn)
+    results = shapecheck.run_shapecheck()
+    bad = [
+        r
+        for r in results
+        if not r.ok and r.contract in ("streamed-xla", "streamed-fused", "engine")
+    ]
+    assert bad, "f16 carry round-tripped cleanly — donation check is dead"
+
+
+def test_static_arg_stability():
+    """Hash-stable statics pass; identity-hashed and unhashable ones
+    are named failures (the compile-per-call class the
+    RecompilationSentinel otherwise only catches at runtime)."""
+
+    @dataclasses.dataclass(frozen=True)
+    class GoodSpec:
+        name: str = "ok"
+
+    assert shapecheck._static_problems(GoodSpec(), "spec") == ""
+    assert shapecheck._static_problems("bisect", "impl") == ""
+
+    class IdentityHashed:
+        pass
+
+    msg = shapecheck._static_problems(IdentityHashed(), "spec")
+    assert "identity" in msg
+    msg2 = shapecheck._static_problems([1, 2], "spec")
+    assert "unhashable" in msg2
+
+
+def test_planner_rung_coverage_guard(monkeypatch):
+    """A new planner rung without a shapecheck contract turns the
+    planner-coupling check red instead of silently going unchecked."""
+    monkeypatch.setattr(shapecheck, "COVERED_RUNGS", ("nothing",))
+    results = shapecheck.run_shapecheck()
+    bad = [r for r in results if not r.ok and r.contract == "planner"]
+    assert bad and "uncovered rung" in bad[0].detail
+
+
+def test_cli_artifact_and_exit_code(tmp_path, capsys):
+    artifact = tmp_path / "shapecheck.json"
+    rc = shapecheck.main(["--check", "--artifact", str(artifact)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    payload = json.loads(artifact.read_text())
+    assert payload["failures"] == 0
+    assert payload["compiles_added"] == 0
+    assert payload["total"] == len(payload["checks"])
+    assert "_simulate_scan" in payload["entry_points"]
+
+
+def test_grid_covers_tile_padding():
+    """The grid must include at least one bucket whose padding actually
+    engaged (padded != raw), or the donor-pack path is untested."""
+    assert any(
+        b.padded_V != b.V or b.padded_M != b.M
+        for b in shapecheck.build_grid()
+    )
+
+
+def test_expected_shapes_follow_bucket():
+    b = shapecheck.bucket_shape(9, 129, epochs=5, batch=2)
+    want = shapecheck._engine_expect(b)
+    assert tuple(want["dividends"].shape) == (5, 16)
+    assert tuple(want["bonds"].shape) == (5, 16, 256)
+    assert tuple(want["consensus"].shape) == (5, 256)

@@ -1,12 +1,21 @@
-"""jaxlint: AST-based TPU-discipline analyzer for yuma_simulation_tpu.
+"""jaxlint: whole-program TPU-discipline analyzer for yuma_simulation_tpu.
 
-Eight project-specific rules (JX001-JX008) over stdlib ``ast`` — no new
-dependencies. See :mod:`tools.jaxlint.analyzer` for the rule registry and
-the taint model, :mod:`tools.jaxlint.cli` for the CLI
-(``python -m tools.jaxlint yuma_simulation_tpu/ --strict``).
+Four rule families over stdlib ``ast`` — no new dependencies:
+``tracing`` (JX001-JX010: jit-scope discipline, now interprocedural —
+violations in helpers *reachable from* a jitted scope are found through
+the call graph), ``hygiene`` (JX005/JX007/JX008), ``concurrency``
+(JX101-JX103: guarded fields, atomic publishes, contextvars across
+threads), ``contracts`` (JX201-JX203: telemetry event/metric names
+checked against ``yuma_simulation_tpu/telemetry/registry.py``).
+
+See :mod:`tools.jaxlint.rules` for the registry,
+:mod:`tools.jaxlint.program` for the whole-program model, and
+:mod:`tools.jaxlint.cli` for the CLI
+(``python -m tools.jaxlint yuma_simulation_tpu tools tests --strict``).
 """
 
 from tools.jaxlint.analyzer import (  # noqa: F401
+    RULE_FAMILY,
     RULES,
     FileReport,
     Finding,

@@ -1,830 +1,64 @@
-"""jaxlint core: AST analysis of the project's JAX/TPU discipline.
+"""jaxlint driver: whole-program analysis of the JAX/TPU discipline.
 
-This is a *project-specific* analyzer (stdlib ``ast`` only, no third-party
-deps): the rules encode conventions that keep this codebase's ~20
-``jax.jit`` entry points cheap to compile, parity-exact, and safe to run
-on a remote TPU — conventions no generic linter checks. Each rule has a
-stable code so violations can be suppressed per line with
+This is a *project-specific* analyzer (stdlib ``ast`` only, no
+third-party deps): the rules encode conventions that keep this
+codebase's ~20 ``jax.jit`` entry points cheap to compile, parity-exact,
+and safe to run threaded next to the serve/fleet tiers — conventions no
+generic linter checks. PR 11 grew the per-function pass of PR 2 into a
+whole-program suite:
 
-    some_call()  # jaxlint: disable=JX003
+- :mod:`tools.jaxlint.model` — findings, suppressions, the taint engine;
+- :mod:`tools.jaxlint.program` — module/function index, import
+  resolution, call graph, and the traced-reachability fixpoint;
+- :mod:`tools.jaxlint.rules` — one module per rule family: ``tracing``
+  (JX001-JX010), ``hygiene`` (JX005/7/8), ``concurrency`` (JX1xx),
+  ``contracts`` (JX2xx).
 
-(comma-separate several codes; a bare ``# jaxlint: disable`` suppresses
-every rule on that line). Suppressions that never fire are reported so
-they cannot rot silently (``--strict`` fails on them).
+Violations are suppressed per line with ``# jaxlint: disable=JXnnn``
+(see :mod:`tools.jaxlint.model`); unused suppressions fail ``--strict``.
 
-Taint model
------------
-Rules JX002/JX003/JX004/JX006 analyze "jit scopes": functions decorated
-``@jax.jit`` / ``@partial(jax.jit, ...)`` plus every function *defined
-inside* one (closures traced as part of the same program). Parameters
-not listed in ``static_argnames`` are traced values; taint flows through
-assignments, attribute/subscript access, and arithmetic. Two refinements
-keep the model honest for this codebase:
-
-- attribute reads that are static even on tracers (``.shape``, ``.dtype``,
-  ``.ndim``, ...) and the config pytree's registered *static* fields
-  (``liquid_alpha``, ``consensus_precision``, the quantile overrides —
-  models/config.py) do not propagate taint;
-- ``x is None`` / ``x is not None`` tests are pytree-structure checks,
-  resolved at trace time, and never taint a branch.
-
-For the *control-flow* rule (JX003) a function-call boundary stops taint
-unless the callee is rooted at ``jnp``/``jax``/``lax`` (those return
-tracers; anything else is a host predicate — e.g. the engine-eligibility
-gates — whose result is a Python bool computed from static structure).
-The *host-cast* rule (JX002) keeps taint flowing through every call, so
-``float(jnp.sum(x))`` is still flagged.
+This module keeps the stable public API every caller of PR 2 used:
+:data:`RULES`, :func:`analyze_source`, :func:`analyze_paths`,
+:class:`Finding`, :class:`FileReport`.
 """
 
 from __future__ import annotations
 
-import ast
-import dataclasses
-import re
 from pathlib import Path
 from typing import Iterable, Optional
 
-#: Stable rule registry: code -> (name, summary). The summaries are what
-#: ``--list-rules`` and the JSON output carry.
-RULES: dict[str, tuple[str, str]] = {
-    "JX001": (
-        "jit-static-completeness",
-        "str/bool-typed parameter of a jitted function is not listed in "
-        "static_argnames (it would be traced, or retrace per call)",
-    ),
-    "JX002": (
-        "tracer-host-cast",
-        "host cast (float()/int()/bool()/.item()/.tolist()/np.*) applied "
-        "to a value reachable from a jitted function's traced params",
-    ),
-    "JX003": (
-        "tracer-branch",
-        "Python if/while branches on a traced value inside a jitted body "
-        "(trace-time concretization; use lax.cond/jnp.where)",
-    ),
-    "JX004": (
-        "fault-hook-in-trace",
-        "fault-injection hook called inside a jit-traced body; hooks are "
-        "host-level and self-guard with the is-tracing check — a traced "
-        "call site would bake the arming state into the jit cache",
-    ),
-    "JX005": (
-        "dtypeless-literal",
-        "jnp.asarray/jnp.array of a numeric literal without an explicit "
-        "dtype (bit-parity discipline: x64 mode silently promotes)",
-    ),
-    "JX006": (
-        "impure-in-trace",
-        "impure host call (time.*/random.*/np.random.*/datetime.now) "
-        "inside a jitted body; the value freezes into the trace",
-    ),
-    "JX007": (
-        "private-import-in-v1",
-        "public v1 API module imports a private (underscore-prefixed) "
-        "module or name",
-    ),
-    "JX008": (
-        "raw-scan-carry",
-        "lax.scan carry built as a raw tuple/dict literal in engine.py; "
-        "engine carries must be registered pytree dataclasses "
-        "(simulation/carry.py)",
-    ),
-    "JX009": (
-        "device-put-in-trace",
-        "jax.device_put inside a scan/jit-traced region: under trace it "
-        "is a layout hint at best and a silent no-op at worst — the "
-        "transfer the caller meant to overlap with compute never "
-        "happens there; stage the buffer from the host-level dispatch "
-        "driver (the bug class the double-buffered streaming rewrite "
-        "removed)",
-    ),
-}
-
-#: Parse failures are reported under this pseudo-code (not suppressible).
-PARSE_ERROR_CODE = "JX999"
-
-#: Attribute reads that yield host/static values even on traced arrays.
-TRACE_STATIC_ATTRS = {
-    "shape", "ndim", "dtype", "size", "itemsize", "aval", "sharding",
-    # Registered *static* (aux-data) fields of the config pytrees —
-    # models/config.py marks exactly these with metadata=dict(static=True).
-    "liquid_alpha", "consensus_precision",
-    "override_consensus_high", "override_consensus_low",
-}
-
-#: Host-level fault-injection hooks (resilience/faults.py). Inside a
-#: traced body their is-tracing self-guard silently no-ops (or worse:
-#: bakes the armed plan into a cached executable) — JX004.
-FAULT_HOOKS = {
-    "maybe_fail_fused_dispatch",
-    "active_nan_fault",
-    "mangle_chunk_file",
-}
-
-#: Call roots that return traced values (taint passes through for the
-#: control-flow rule); everything else is treated as a host predicate.
-TRACER_CALL_ROOTS = {"jnp", "jax", "lax", "float", "int", "bool"}
-
-_SUPPRESS_RE = re.compile(
-    r"#\s*jaxlint:\s*disable(?:=(?P<codes>[A-Z0-9,\s]+?))?\s*(?:#|$)"
+from tools.jaxlint.model import (  # noqa: F401  (public API re-exports)
+    PARSE_ERROR_CODE,
+    FileReport,
+    Finding,
+    apply_suppressions,
 )
+from tools.jaxlint.program import FileUnit, Program, parse_unit
+from tools.jaxlint.rules import FAMILIES, RULE_FAMILY, RULES  # noqa: F401
 
 
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    """One rule violation at a concrete source location."""
+def analyze_units(
+    units: list[FileUnit], select: Optional[set[str]] = None
+) -> list[FileReport]:
+    """Run every selected rule family over ``units`` as ONE program
+    (interprocedural facts flow across all of them), then fold each
+    file's suppression comments into its report."""
+    select = select if select is not None else set(RULES)
+    program = Program(units)
 
-    path: str
-    line: int
-    col: int
-    code: str
-    message: str
+    def add(unit: FileUnit, node, code: str, message: str) -> None:
+        if code in select:
+            unit.add(node, code, message)
 
-    def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
-
-
-@dataclasses.dataclass
-class FileReport:
-    """Per-file analysis result (post-suppression)."""
-
-    path: str
-    findings: list[Finding]
-    suppressed: int
-    #: suppression comments that matched no finding: (line, codes-or-None)
-    unused_suppressions: list[tuple[int, Optional[frozenset[str]]]]
-
-
-# --------------------------------------------------------------------------
-# small AST helpers
-
-
-def dotted(node: ast.expr) -> Optional[str]:
-    """``a.b.c`` for a Name/Attribute chain, else None."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _const_str_set(node: ast.expr) -> Optional[set[str]]:
-    """static_argnames value -> set of names, when literally parseable."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return {node.value}
-    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
-        out: set[str] = set()
-        for el in node.elts:
-            if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
-                return None
-            out.add(el.value)
-        return out
-    return None
-
-
-def _is_literal_like(node: ast.expr) -> bool:
-    """Numeric-literal-ish first args of asarray: ``-1``, ``2.0``,
-    ``float("nan")``, ``1 / 3``, ``[0, 1]``."""
-    if isinstance(node, ast.Constant):
-        return isinstance(node.value, (int, float, complex, bool))
-    if isinstance(node, ast.UnaryOp):
-        return _is_literal_like(node.operand)
-    if isinstance(node, ast.BinOp):
-        return _is_literal_like(node.left) and _is_literal_like(node.right)
-    if isinstance(node, (ast.Tuple, ast.List)):
-        return all(_is_literal_like(el) for el in node.elts)
-    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
-        if node.func.id in ("float", "int", "bool") and not node.keywords:
-            return all(isinstance(a, ast.Constant) for a in node.args)
-    return False
-
-
-def _annotation_mentions(ann: Optional[ast.expr], names: set[str]) -> bool:
-    """Whether an annotation expression contains one of ``names`` as a
-    bare Name (handles ``bool``, ``bool | None``, ``Optional[str]``)."""
-    if ann is None:
-        return False
-    return any(
-        isinstance(n, ast.Name) and n.id in names for n in ast.walk(ann)
-    )
-
-
-def jit_decoration(
-    fn: ast.FunctionDef | ast.AsyncFunctionDef,
-) -> Optional[tuple[set[str], bool]]:
-    """``(static_argnames, parseable)`` when ``fn`` is jit-wrapped, else
-    None. ``parseable`` is False when a static_argnames expression was
-    present but not a literal (analysis then skips JX001 for safety)."""
-    for dec in fn.decorator_list:
-        target: Optional[ast.expr] = None
-        call: Optional[ast.Call] = None
-        if isinstance(dec, ast.Call):
-            fname = dotted(dec.func) or ""
-            if fname == "jit" or fname.endswith(".jit"):
-                target, call = dec.func, dec  # @jax.jit(static_argnames=...)
-            elif fname == "partial" or fname.endswith(".partial"):
-                if dec.args:
-                    inner = dotted(dec.args[0]) or ""
-                    if inner == "jit" or inner.endswith(".jit"):
-                        target, call = dec.args[0], dec
-        else:
-            fname = dotted(dec) or ""
-            if fname == "jit" or fname.endswith(".jit"):
-                target = dec
-        if target is None:
-            continue
-        static: set[str] = set()
-        parseable = True
-        if call is not None:
-            for kw in call.keywords:
-                if kw.arg == "static_argnames":
-                    got = _const_str_set(kw.value)
-                    if got is None:
-                        parseable = False
-                    else:
-                        static |= got
-                elif kw.arg == "static_argnums":
-                    # positions -> names, when literally parseable
-                    params = _all_params(fn)
-                    nums: list[int] = []
-                    ok = True
-                    vals = (
-                        kw.value.elts
-                        if isinstance(kw.value, (ast.Tuple, ast.List))
-                        else [kw.value]
-                    )
-                    for el in vals:
-                        if isinstance(el, ast.Constant) and isinstance(
-                            el.value, int
-                        ):
-                            nums.append(el.value)
-                        else:
-                            ok = False
-                    if ok:
-                        for i in nums:
-                            if 0 <= i < len(params):
-                                static.add(params[i].arg)
-                    else:
-                        parseable = False
-        return static, parseable
-    return None
-
-
-def _all_params(fn) -> list[ast.arg]:
-    a = fn.args
-    return [*a.posonlyargs, *a.args, *a.kwonlyargs]
-
-
-# --------------------------------------------------------------------------
-# taint engine
-
-
-class _Taint:
-    """Two-level taint over local names of one jit scope.
-
-    ``general`` propagates through every expression form (JX002's view:
-    any value *reachable from* a traced param). ``direct`` additionally
-    stops at host-call boundaries (JX003's view: values that are
-    syntactically tracers, not results of host predicates)."""
-
-    def __init__(self, general: set[str], direct: set[str]):
-        self.general = general
-        self.direct = direct
-
-    # -- expression evaluation ------------------------------------------
-
-    def tainted(self, e: ast.expr, *, direct: bool) -> bool:
-        names = self.direct if direct else self.general
-        return self._eval(e, names, direct)
-
-    def _eval(self, e: ast.expr, names: set[str], direct: bool) -> bool:
-        if isinstance(e, ast.Name):
-            return e.id in names
-        if isinstance(e, ast.Constant) or isinstance(e, ast.Lambda):
-            return False
-        if isinstance(e, ast.Attribute):
-            if e.attr in TRACE_STATIC_ATTRS:
-                return False
-            return self._eval(e.value, names, direct)
-        if isinstance(e, ast.Compare):
-            # `x is None` / `x is not None`: pytree-structure checks,
-            # static at trace time regardless of x.
-            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
-                return False
-            return self._eval(e.left, names, direct) or any(
-                self._eval(c, names, direct) for c in e.comparators
-            )
-        if isinstance(e, ast.Call):
-            root = (dotted(e.func) or "").split(".", 1)[0]
-            if direct and root not in TRACER_CALL_ROOTS:
-                # A method call on a traced object (x.sum(), W.mean())
-                # returns a tracer; a free-function call is a host
-                # predicate boundary (engine eligibility gates etc.).
-                if isinstance(e.func, ast.Attribute):
-                    return self._eval(e.func.value, names, direct)
-                return False  # host-predicate boundary
-            args_tainted = any(
-                self._eval(a, names, direct)
-                for a in e.args
-                if not isinstance(a, ast.Starred)
-            ) or any(
-                self._eval(k.value, names, direct) for k in e.keywords
-            ) or any(
-                self._eval(a.value, names, direct)
-                for a in e.args
-                if isinstance(a, ast.Starred)
-            )
-            return args_tainted or self._eval(e.func, names, direct)
-        children = [
-            c for c in ast.iter_child_nodes(e) if isinstance(c, ast.expr)
-        ]
-        return any(self._eval(c, names, direct) for c in children)
-
-    # -- statement-order propagation ------------------------------------
-
-    def absorb_assignment(self, targets: Iterable[ast.expr], value: ast.expr):
-        gen = self._eval(value, self.general, False)
-        dire = self._eval(value, self.direct, True)
-        if not (gen or dire):
-            return
-        for t in targets:
-            for name in _target_names(t):
-                if gen:
-                    self.general.add(name)
-                if dire:
-                    self.direct.add(name)
-
-
-def _target_names(t: ast.expr) -> list[str]:
-    if isinstance(t, ast.Name):
-        return [t.id]
-    if isinstance(t, (ast.Tuple, ast.List)):
-        return [n for el in t.elts for n in _target_names(el)]
-    if isinstance(t, ast.Starred):
-        return _target_names(t.value)
-    return []  # attribute/subscript stores don't bind new names
-
-
-def _collect_taint(stmts: list[ast.stmt], taint: _Taint) -> None:
-    """One ordered pass folding assignments (and nested-function params)
-    into the taint sets. Callers run it twice for a cheap fixpoint."""
-    for st in stmts:
-        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for p in _all_params(st):
-                taint.general.add(p.arg)
-                taint.direct.add(p.arg)
-            _collect_taint(st.body, taint)
-        elif isinstance(st, ast.Assign):
-            taint.absorb_assignment(st.targets, st.value)
-        elif isinstance(st, ast.AnnAssign) and st.value is not None:
-            taint.absorb_assignment([st.target], st.value)
-        elif isinstance(st, ast.AugAssign):
-            taint.absorb_assignment([st.target], st.value)
-        elif isinstance(st, ast.NamedExpr):  # pragma: no cover (stmt ctx)
-            taint.absorb_assignment([st.target], st.value)
-        elif isinstance(st, ast.For):
-            taint.absorb_assignment([st.target], st.iter)
-            _collect_taint(st.body, taint)
-            _collect_taint(st.orelse, taint)
-        elif isinstance(st, (ast.While, ast.If)):
-            _collect_taint(st.body, taint)
-            _collect_taint(st.orelse, taint)
-        elif isinstance(st, ast.With):
-            for item in st.items:
-                if item.optional_vars is not None:
-                    taint.absorb_assignment(
-                        [item.optional_vars], item.context_expr
-                    )
-            _collect_taint(st.body, taint)
-        elif isinstance(st, ast.Try):
-            _collect_taint(st.body, taint)
-            for h in st.handlers:
-                _collect_taint(h.body, taint)
-            _collect_taint(st.orelse, taint)
-            _collect_taint(st.finalbody, taint)
-        # walrus targets inside plain expressions
-        for sub in ast.walk(st):
-            if isinstance(sub, ast.NamedExpr):
-                taint.absorb_assignment([sub.target], sub.value)
-
-
-# --------------------------------------------------------------------------
-# per-file analysis
-
-
-class FileAnalyzer:
-    def __init__(self, path: str, tree: ast.Module, select: set[str]):
-        self.path = path
-        self.tree = tree
-        self.select = select
-        self.findings: list[Finding] = []
-        posix = Path(path).as_posix()
-        self.is_engine = posix.endswith("simulation/engine.py")
-        self.is_v1 = "/v1/" in posix or posix.startswith("v1/")
-
-    def add(self, node: ast.AST, code: str, message: str) -> None:
-        if code in self.select:
-            self.findings.append(
-                Finding(
-                    self.path,
-                    getattr(node, "lineno", 0),
-                    getattr(node, "col_offset", 0),
-                    code,
-                    message,
-                )
-            )
-
-    def run(self) -> list[Finding]:
-        self._module_rules()
-        for node in ast.walk(self.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                jit = jit_decoration(node)
-                if jit is not None:
-                    static, parseable = jit
-                    if parseable:
-                        self._check_jx001(node, static)
-                    self._check_jit_body(node, static)
-        return self.findings
-
-    # -- module-level rules ---------------------------------------------
-
-    def _module_rules(self) -> None:
-        for node in ast.walk(self.tree):
-            if isinstance(node, ast.Call):
-                self._check_jx005(node)
-            if self.is_v1 and isinstance(node, (ast.Import, ast.ImportFrom)):
-                self._check_jx007(node)
-        if self.is_engine:
-            self._check_jx008()
-
-    def _check_jx005(self, call: ast.Call) -> None:
-        fname = dotted(call.func) or ""
-        if fname.split(".")[-1] not in ("asarray", "array"):
-            return
-        root = fname.split(".", 1)[0]
-        if root not in ("jnp", "jax", "numpy", "np"):
-            return
-        if not call.args or not _is_literal_like(call.args[0]):
-            return
-        has_dtype = len(call.args) >= 2 or any(
-            kw.arg == "dtype" for kw in call.keywords
+    for family in FAMILIES:
+        if any(RULE_FAMILY[c] == family.FAMILY for c in select):
+            family.check(program, add)
+    return [
+        apply_suppressions(
+            unit.path, unit.source, unit.findings, select, set(RULES)
         )
-        if not has_dtype:
-            self.add(
-                call,
-                "JX005",
-                f"{fname}({ast.unparse(call.args[0])}) literal without an "
-                "explicit dtype: under the x64 parity harness this "
-                "silently promotes to f64 and breaks the bit-parity "
-                "contract — pass dtype= explicitly",
-            )
-
-    def _check_jx007(self, node: ast.Import | ast.ImportFrom) -> None:
-        if isinstance(node, ast.ImportFrom):
-            mod = node.module or ""
-            comps = [c for c in mod.split(".") if c]
-            if any(
-                c.startswith("_") and c != "__future__" for c in comps
-            ):
-                self.add(
-                    node,
-                    "JX007",
-                    f"v1 public API imports private module '{mod}': the "
-                    "frozen ApiVer surface must depend only on public "
-                    "modules",
-                )
-            for alias in node.names:
-                if alias.name.startswith("_") and alias.name != "*":
-                    self.add(
-                        node,
-                        "JX007",
-                        f"v1 public API imports private name "
-                        f"'{alias.name}' from '{mod}'",
-                    )
-        else:
-            for alias in node.names:
-                comps = alias.name.split(".")
-                if any(
-                    c.startswith("_") and c != "__future__" for c in comps
-                ):
-                    self.add(
-                        node,
-                        "JX007",
-                        f"v1 public API imports private module "
-                        f"'{alias.name}'",
-                    )
-
-    @staticmethod
-    def _scope_nodes(scope) -> list[ast.AST]:
-        """Nodes belonging to ``scope``'s own body, stopping at nested
-        function definitions (each is analyzed as its own scope — this
-        keeps scan reports single and literal-name resolution local)."""
-        body = scope.body if hasattr(scope, "body") else []
-        out: list[ast.AST] = []
-        stack = list(body)
-        while stack:
-            node = stack.pop()
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            out.append(node)
-            stack.extend(ast.iter_child_nodes(node))
-        return out
-
-    def _check_jx008(self) -> None:
-        """lax.scan inits in engine.py must not be raw tuple/dict pytrees."""
-        scopes: list[ast.AST] = [self.tree]
-        scopes.extend(
-            n
-            for n in ast.walk(self.tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-        )
-        for fn in scopes:
-            nodes = self._scope_nodes(fn)
-            # name -> literal-RHS assignments, for resolving `carry0`
-            literal_names: set[str] = set()
-            for sub in nodes:
-                rhs: Optional[ast.expr] = None
-                names: list[str] = []
-                if isinstance(sub, ast.Assign):
-                    rhs = sub.value
-                    names = [n for t in sub.targets for n in _target_names(t)]
-                elif isinstance(sub, ast.AugAssign) and isinstance(
-                    sub.target, ast.Name
-                ):
-                    rhs = sub.value
-                    names = [sub.target.id]
-                if rhs is not None and names and self._is_container_literal(rhs):
-                    literal_names.update(names)
-            for call in nodes:
-                if not isinstance(call, ast.Call):
-                    continue
-                fname = dotted(call.func) or ""
-                if fname.split(".")[-1] != "scan":
-                    continue
-                if not (fname.startswith("lax.") or "jax.lax" in fname):
-                    continue
-                init = None
-                if len(call.args) >= 2:
-                    init = call.args[1]
-                else:
-                    for kw in call.keywords:
-                        if kw.arg == "init":
-                            init = kw.value
-                if init is None:
-                    continue
-                bad = self._is_container_literal(init) or (
-                    isinstance(init, ast.Name) and init.id in literal_names
-                )
-                if bad:
-                    self.add(
-                        call,
-                        "JX008",
-                        "lax.scan carry is a raw tuple/dict literal; "
-                        "engine carries must be the registered pytree "
-                        "dataclasses of simulation/carry.py (stable "
-                        "field names, no positional-unpack drift)",
-                    )
-
-    @staticmethod
-    def _is_container_literal(e: ast.expr) -> bool:
-        if isinstance(e, (ast.Tuple, ast.List, ast.Dict)):
-            return True
-        if isinstance(e, ast.IfExp):
-            return FileAnalyzer._is_container_literal(
-                e.body
-            ) or FileAnalyzer._is_container_literal(e.orelse)
-        if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Add):
-            return FileAnalyzer._is_container_literal(
-                e.left
-            ) or FileAnalyzer._is_container_literal(e.right)
-        return False
-
-    # -- jit-scope rules -------------------------------------------------
-
-    def _check_jx001(self, fn, static: set[str]) -> None:
-        for p in _all_params(fn):
-            if p.arg in static:
-                continue
-            str_like = _annotation_mentions(p.annotation, {"str"})
-            bool_like = _annotation_mentions(p.annotation, {"bool"})
-            default = self._default_for(fn, p)
-            str_default = isinstance(default, ast.Constant) and isinstance(
-                default.value, str
-            )
-            if str_like or bool_like or str_default:
-                kind = "str" if (str_like or str_default) else "bool"
-                self.add(
-                    p,
-                    "JX001",
-                    f"jitted function '{fn.name}' takes {kind}-typed param "
-                    f"'{p.arg}' that is not in static_argnames: it either "
-                    "fails to trace or silently keys a recompile per value",
-                )
-
-    @staticmethod
-    def _default_for(fn, param: ast.arg) -> Optional[ast.expr]:
-        a = fn.args
-        pos = [*a.posonlyargs, *a.args]
-        if param in pos:
-            idx = pos.index(param)
-            off = len(pos) - len(a.defaults)
-            if idx >= off:
-                return a.defaults[idx - off]
-            return None
-        if param in a.kwonlyargs:
-            return a.kw_defaults[a.kwonlyargs.index(param)]
-        return None
-
-    def _check_jit_body(self, fn, static: set[str]) -> None:
-        params = {p.arg for p in _all_params(fn)}
-        traced = params - static
-        taint = _Taint(set(traced), set(traced))
-        # two ordered passes ~= fixpoint for straight-line + one loop level
-        _collect_taint(fn.body, taint)
-        _collect_taint(fn.body, taint)
-        self._walk_jit(fn.body, taint)
-
-    def _walk_jit(self, stmts: list[ast.stmt], taint: _Taint) -> None:
-        for st in stmts:
-            if isinstance(st, (ast.If, ast.While)):
-                test = st.test
-                if taint.tainted(test, direct=True):
-                    kw = "if" if isinstance(st, ast.If) else "while"
-                    self.add(
-                        test,
-                        "JX003",
-                        f"Python `{kw}` branches on a traced value inside "
-                        "a jitted body — this concretizes at trace time; "
-                        "use jnp.where / lax.cond / lax.while_loop",
-                    )
-            for call in self._calls_of(st):
-                self._check_call_in_trace(call, taint)
-            # recurse into nested suites (incl. nested function bodies —
-            # they trace as part of this program)
-            for child in ast.iter_child_nodes(st):
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    self._walk_jit(child.body, taint)
-            if isinstance(st, (ast.If, ast.While, ast.For)):
-                self._walk_jit(st.body, taint)
-                self._walk_jit(st.orelse, taint)
-            elif isinstance(st, ast.With):
-                self._walk_jit(st.body, taint)
-            elif isinstance(st, ast.Try):
-                self._walk_jit(st.body, taint)
-                for h in st.handlers:
-                    self._walk_jit(h.body, taint)
-                self._walk_jit(st.orelse, taint)
-                self._walk_jit(st.finalbody, taint)
-
-    @staticmethod
-    def _calls_of(st: ast.stmt) -> list[ast.Call]:
-        """Call nodes belonging to this statement, not descending into
-        nested function bodies (walked separately) or nested suites."""
-        exprs: list[ast.expr] = []
-        for field_, value in ast.iter_fields(st):
-            if field_ in ("body", "orelse", "finalbody", "handlers"):
-                continue
-            if isinstance(value, ast.expr):
-                exprs.append(value)
-            elif isinstance(value, list):
-                exprs.extend(v for v in value if isinstance(v, ast.expr))
-        calls: list[ast.Call] = []
-        for e in exprs:
-            for sub in ast.walk(e):
-                if isinstance(sub, ast.Call):
-                    calls.append(sub)
-                elif isinstance(sub, ast.Lambda):
-                    for inner in ast.walk(sub.body):
-                        if isinstance(inner, ast.Call):
-                            calls.append(inner)
-        # dedupe while keeping order (lambda bodies walked twice above)
-        seen: set[int] = set()
-        out = []
-        for c in calls:
-            if id(c) not in seen:
-                seen.add(id(c))
-                out.append(c)
-        return out
-
-    def _check_call_in_trace(self, call: ast.Call, taint: _Taint) -> None:
-        fname = dotted(call.func) or ""
-        leaf = fname.split(".")[-1]
-        root = fname.split(".", 1)[0]
-
-        # JX002: host casts on traced values
-        if isinstance(call.func, ast.Name) and call.func.id in (
-            "float",
-            "int",
-            "bool",
-        ):
-            if any(taint.tainted(a, direct=False) for a in call.args):
-                self.add(
-                    call,
-                    "JX002",
-                    f"{call.func.id}() applied to a traced value inside a "
-                    "jitted body: concretizes the tracer (or silently "
-                    "freezes a weak-typed constant into the trace)",
-                )
-        elif isinstance(call.func, ast.Attribute) and call.func.attr in (
-            "item",
-            "tolist",
-        ):
-            if taint.tainted(call.func.value, direct=False):
-                self.add(
-                    call,
-                    "JX002",
-                    f".{call.func.attr}() on a traced value inside a "
-                    "jitted body: forces a host transfer at trace time",
-                )
-        elif root in ("np", "numpy") and not fname.startswith(
-            ("np.random", "numpy.random")
-        ):
-            if any(
-                taint.tainted(a, direct=False)
-                for a in call.args
-                if not isinstance(a, ast.Starred)
-            ):
-                self.add(
-                    call,
-                    "JX002",
-                    f"{fname}() applied to a traced value inside a jitted "
-                    "body: numpy concretizes tracers to host arrays — use "
-                    "the jnp equivalent",
-                )
-
-        # JX009: host->device staging belongs to the host-level driver.
-        # Any device_put spelling (jax.device_put, a bare alias import)
-        # inside a jit scope is flagged: traced, it cannot start the
-        # async transfer the call site exists for.
-        if leaf == "device_put":
-            self.add(
-                call,
-                "JX009",
-                f"{fname}() inside a jit-traced region: under trace "
-                "device_put is at best a layout constraint and never "
-                "the async host->HBM transfer the call site implies — "
-                "stage buffers from the host-level dispatch driver "
-                "(engine.simulate_streamed's double-buffer is the "
-                "pattern)",
-            )
-
-        # JX004: fault hooks must stay host-level
-        if leaf in FAULT_HOOKS:
-            self.add(
-                call,
-                "JX004",
-                f"fault-injection hook '{leaf}' called inside a jitted "
-                "body: the hook's is-tracing guard makes it a silent no-op "
-                "under trace (and an armed plan would otherwise bake into "
-                "the jit cache) — call it from the host-level dispatch "
-                "wrapper instead",
-            )
-
-        # JX006: impure host calls
-        impure = (
-            (root == "time" and leaf in (
-                "time", "perf_counter", "monotonic", "process_time",
-                "time_ns", "perf_counter_ns",
-            ))
-            or (root == "random" and fname.startswith("random."))
-            or fname.startswith(("np.random", "numpy.random"))
-            or (root == "datetime" and leaf in ("now", "today", "utcnow"))
-        )
-        if impure:
-            self.add(
-                call,
-                "JX006",
-                f"impure host call {fname}() inside a jitted body: the "
-                "value freezes at trace time and silently re-used across "
-                "calls — compute it on the host and pass it in (or use "
-                "jax.random with explicit keys)",
-            )
-
-
-# --------------------------------------------------------------------------
-# suppression handling + entry points
-
-
-def _parse_suppressions(
-    source: str,
-) -> dict[int, Optional[frozenset[str]]]:
-    """line -> codes (None = all rules) for ``# jaxlint: disable=...``."""
-    out: dict[int, Optional[frozenset[str]]] = {}
-    for i, text in enumerate(source.splitlines(), start=1):
-        m = _SUPPRESS_RE.search(text)
-        if not m:
-            continue
-        codes = m.group("codes")
-        if codes is None:
-            out[i] = None
-        else:
-            out[i] = frozenset(
-                c.strip() for c in codes.split(",") if c.strip()
-            )
-    return out
+        for unit in units
+    ]
 
 
 def analyze_source(
@@ -832,53 +66,13 @@ def analyze_source(
     path: str = "<string>",
     select: Optional[set[str]] = None,
 ) -> FileReport:
-    """Analyze one file's source text. ``select`` limits the rule set."""
-    select = select if select is not None else set(RULES)
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return FileReport(
-            path,
-            [
-                Finding(
-                    path,
-                    exc.lineno or 0,
-                    exc.offset or 0,
-                    PARSE_ERROR_CODE,
-                    f"could not parse file: {exc.msg}",
-                )
-            ],
-            0,
-            [],
-        )
-    findings = FileAnalyzer(path, tree, select).run()
-    suppressions = _parse_suppressions(source)
-    kept: list[Finding] = []
-    used_lines: set[int] = set()
-    suppressed = 0
-    for f in findings:
-        codes = suppressions.get(f.line, ...)
-        if codes is ... or (codes is not None and f.code not in codes):
-            kept.append(f)
-        else:
-            suppressed += 1
-            used_lines.add(f.line)
-    # A suppression is only provably unused when every rule it names
-    # actually ran: under --select/--ignore a suppression for a
-    # de-selected rule may be load-bearing in the full run, so it is
-    # neither used nor unused here.
-    def _judgeable(codes: Optional[frozenset[str]]) -> bool:
-        if codes is None:
-            return select >= set(RULES)
-        return codes <= select
+    """Analyze one file's source text. ``select`` limits the rule set.
 
-    unused = [
-        (line, codes)
-        for line, codes in sorted(suppressions.items())
-        if line not in used_lines and _judgeable(codes)
-    ]
-    kept.sort(key=lambda f: (f.line, f.col, f.code))
-    return FileReport(path, kept, suppressed, unused)
+    Single-file programs still get the interprocedural pass (helper
+    calls resolve within the file); cross-module facts need
+    :func:`analyze_paths`.
+    """
+    return analyze_units([parse_unit(source, path)], select)[0]
 
 
 def iter_python_files(paths: Iterable[str]) -> list[Path]:
@@ -895,8 +89,8 @@ def iter_python_files(paths: Iterable[str]) -> list[Path]:
 def analyze_paths(
     paths: Iterable[str], select: Optional[set[str]] = None
 ) -> list[FileReport]:
-    reports = []
+    units = []
     for file in iter_python_files(paths):
         source = file.read_text(encoding="utf-8")
-        reports.append(analyze_source(source, str(file), select))
-    return reports
+        units.append(parse_unit(source, str(file)))
+    return analyze_units(units, select)

@@ -4,7 +4,9 @@ Exit codes: 0 clean, 1 findings (with ``--strict`` also unused
 suppressions), 2 usage errors. Output formats: ``human`` (one
 ``path:line:col: CODE message`` per finding) and ``json`` (a single
 object with findings, suppression stats, and the rule registry — stable
-for CI consumption).
+for CI consumption). ``--artifact PATH`` additionally writes the JSON
+payload to a file whatever the display format — the CI analysis lane
+uploads it so a red lane ships its own findings list.
 """
 
 from __future__ import annotations
@@ -15,7 +17,7 @@ import os
 import sys
 from typing import Optional, Sequence
 
-from tools.jaxlint.analyzer import RULES, analyze_paths
+from tools.jaxlint.analyzer import RULE_FAMILY, RULES, analyze_paths
 
 
 def _rule_set(spec: Optional[str], base: set[str]) -> set[str]:
@@ -30,13 +32,41 @@ def _rule_set(spec: Optional[str], base: set[str]) -> set[str]:
     return requested
 
 
+def _json_payload(reports, findings, suppressed, unused) -> dict:
+    return {
+        "findings": [
+            {
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "code": f.code,
+                "rule": RULES.get(f.code, ("parse-error",))[0],
+                "family": RULE_FAMILY.get(f.code, "driver"),
+                "message": f.message,
+            }
+            for f in findings
+        ],
+        "files_analyzed": len(reports),
+        "suppressed": suppressed,
+        "unused_suppressions": [
+            {
+                "path": p,
+                "line": line,
+                "codes": sorted(codes) if codes else None,
+            }
+            for p, line, codes in unused
+        ],
+    }
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="jaxlint",
         description=(
-            "AST-based TPU-discipline analyzer for yuma_simulation_tpu "
-            "(tracer leaks, recompilation triggers, engine-contract "
-            "violations)"
+            "whole-program TPU-discipline analyzer for "
+            "yuma_simulation_tpu (tracer leaks through helper calls, "
+            "recompilation triggers, lock/publish/contextvar "
+            "discipline, telemetry-name contracts)"
         ),
     )
     parser.add_argument(
@@ -61,13 +91,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "`# jaxlint: disable` lines from rotting)",
     )
     parser.add_argument(
+        "--artifact", metavar="PATH",
+        help="also write the JSON findings payload to PATH (CI artifact)",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule registry"
     )
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for code, (name, summary) in sorted(RULES.items()):
-            print(f"{code} [{name}]\n    {summary}")
+            family = RULE_FAMILY.get(code, "driver")
+            print(f"{code} [{name}] ({family})\n    {summary}")
         return 0
 
     select = _rule_set(args.select, set(RULES))
@@ -94,36 +129,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for r in reports
         for line, codes in r.unused_suppressions
     ]
+    payload = _json_payload(reports, findings, suppressed, unused)
+    if args.artifact:
+        with open(args.artifact, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
 
     if args.format == "json":
-        print(
-            json.dumps(
-                {
-                    "findings": [
-                        {
-                            "path": f.path,
-                            "line": f.line,
-                            "col": f.col,
-                            "code": f.code,
-                            "rule": RULES.get(f.code, ("parse-error",))[0],
-                            "message": f.message,
-                        }
-                        for f in findings
-                    ],
-                    "files_analyzed": len(reports),
-                    "suppressed": suppressed,
-                    "unused_suppressions": [
-                        {
-                            "path": p,
-                            "line": line,
-                            "codes": sorted(codes) if codes else None,
-                        }
-                        for p, line, codes in unused
-                    ],
-                },
-                indent=2,
-            )
-        )
+        print(json.dumps(payload, indent=2))
     else:
         for f in findings:
             print(f.render())

@@ -1,0 +1,502 @@
+"""jaxlint data model: findings, suppressions, AST helpers, taint engine.
+
+Shared by every rule family (:mod:`tools.jaxlint.rules`) and the
+whole-program layer (:mod:`tools.jaxlint.program`). Nothing here imports
+jax or the package under analysis — stdlib ``ast`` only, so the linter
+runs before (and without) an install.
+
+Suppression contract
+--------------------
+Each rule has a stable code so violations can be suppressed per line with
+
+    some_call()  # jaxlint: disable=JXnnn
+
+(comma-separate several codes; a bare ``# jaxlint: disable`` suppresses
+every rule on that line). Suppressions that never fire are reported so
+they cannot rot silently (``--strict`` fails on them).
+
+Taint model
+-----------
+Rules JX002/JX003/JX004/JX006/JX009/JX010 analyze "trace scopes":
+functions decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``, every
+function *defined inside* one (closures traced as part of the same
+program), and — since the interprocedural pass — every function
+*reachable by call* from one (:mod:`tools.jaxlint.program`). Parameters
+not listed in ``static_argnames`` are traced values; taint flows through
+assignments, attribute/subscript access, and arithmetic. Two refinements
+keep the model honest for this codebase:
+
+- attribute reads that are static even on tracers (``.shape``, ``.dtype``,
+  ``.ndim``, ...) and the config pytree's registered *static* fields
+  (``liquid_alpha``, ``consensus_precision``, the quantile overrides —
+  models/config.py) do not propagate taint;
+- ``x is None`` / ``x is not None`` tests are pytree-structure checks,
+  resolved at trace time, and never taint a branch.
+
+For the *control-flow* rule (JX003) a function-call boundary stops taint
+unless the callee is rooted at ``jnp``/``jax``/``lax`` (those return
+tracers; anything else is a host predicate — e.g. the engine-eligibility
+gates — whose result is a Python bool computed from static structure).
+The *host-cast* rule (JX002) keeps taint flowing through every call, so
+``float(jnp.sum(x))`` is still flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterable, Optional
+
+#: Parse failures are reported under this pseudo-code (not suppressible).
+PARSE_ERROR_CODE = "JX999"
+
+#: Attribute reads that yield host/static values even on traced arrays.
+TRACE_STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "itemsize", "aval", "sharding",
+    # Registered *static* (aux-data) fields of the config pytrees —
+    # models/config.py marks exactly these with metadata=dict(static=True).
+    "liquid_alpha", "consensus_precision",
+    "override_consensus_high", "override_consensus_low",
+}
+
+#: Call roots that return traced values (taint passes through for the
+#: control-flow rule); everything else is treated as a host predicate.
+TRACER_CALL_ROOTS = {"jnp", "jax", "lax", "float", "int", "bool"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable(?:=(?P<codes>[A-Z0-9,\s]+?))?\s*(?:#|$)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a concrete source location."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+@dataclasses.dataclass
+class FileReport:
+    """Per-file analysis result (post-suppression)."""
+
+    path: str
+    findings: list[Finding]
+    suppressed: int
+    #: suppression comments that matched no finding: (line, codes-or-None)
+    unused_suppressions: list[tuple[int, Optional[frozenset[str]]]]
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+
+
+def dotted(node: ast.expr) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str_set(node: ast.expr) -> Optional[set[str]]:
+    """static_argnames value -> set of names, when literally parseable."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: set[str] = set()
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
+                return None
+            out.add(el.value)
+        return out
+    return None
+
+
+def is_literal_like(node: ast.expr) -> bool:
+    """Numeric-literal-ish first args of asarray: ``-1``, ``2.0``,
+    ``float("nan")``, ``1 / 3``, ``[0, 1]``."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, complex, bool))
+    if isinstance(node, ast.UnaryOp):
+        return is_literal_like(node.operand)
+    if isinstance(node, ast.BinOp):
+        return is_literal_like(node.left) and is_literal_like(node.right)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(is_literal_like(el) for el in node.elts)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("float", "int", "bool") and not node.keywords:
+            return all(isinstance(a, ast.Constant) for a in node.args)
+    return False
+
+
+def annotation_mentions(ann: Optional[ast.expr], names: set[str]) -> bool:
+    """Whether an annotation expression contains one of ``names`` as a
+    bare Name (handles ``bool``, ``bool | None``, ``Optional[str]``)."""
+    if ann is None:
+        return False
+    return any(
+        isinstance(n, ast.Name) and n.id in names for n in ast.walk(ann)
+    )
+
+
+def all_params(fn) -> list[ast.arg]:
+    a = fn.args
+    return [*a.posonlyargs, *a.args, *a.kwonlyargs]
+
+
+def jit_decoration(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> Optional[tuple[set[str], bool]]:
+    """``(static_argnames, parseable)`` when ``fn`` is jit-wrapped, else
+    None. ``parseable`` is False when a static_argnames expression was
+    present but not a literal (analysis then skips JX001 for safety)."""
+    for dec in fn.decorator_list:
+        target: Optional[ast.expr] = None
+        call: Optional[ast.Call] = None
+        if isinstance(dec, ast.Call):
+            fname = dotted(dec.func) or ""
+            if fname == "jit" or fname.endswith(".jit"):
+                target, call = dec.func, dec  # @jax.jit(static_argnames=...)
+            elif fname == "partial" or fname.endswith(".partial"):
+                if dec.args:
+                    inner = dotted(dec.args[0]) or ""
+                    if inner == "jit" or inner.endswith(".jit"):
+                        target, call = dec.args[0], dec
+        else:
+            fname = dotted(dec) or ""
+            if fname == "jit" or fname.endswith(".jit"):
+                target = dec
+        if target is None:
+            continue
+        static: set[str] = set()
+        parseable = True
+        if call is not None:
+            for kw in call.keywords:
+                if kw.arg == "static_argnames":
+                    got = const_str_set(kw.value)
+                    if got is None:
+                        parseable = False
+                    else:
+                        static |= got
+                elif kw.arg == "static_argnums":
+                    # positions -> names, when literally parseable
+                    params = all_params(fn)
+                    nums: list[int] = []
+                    ok = True
+                    vals = (
+                        kw.value.elts
+                        if isinstance(kw.value, (ast.Tuple, ast.List))
+                        else [kw.value]
+                    )
+                    for el in vals:
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, int
+                        ):
+                            nums.append(el.value)
+                        else:
+                            ok = False
+                    if ok:
+                        for i in nums:
+                            if 0 <= i < len(params):
+                                static.add(params[i].arg)
+                    else:
+                        parseable = False
+        return static, parseable
+    return None
+
+
+#: Names whose truthiness identifies a "am I under trace right now?"
+#: self-guard (telemetry.runctx._tracing_now and friends). A function
+#: that opens with `if <guard>(): return` is host-only by construction:
+#: the interprocedural pass treats it as a trace boundary.
+TRACING_GUARD_NAMES = re.compile(r"(_tracing_now|is_tracing|tracing_now)$")
+
+
+def has_tracing_self_guard(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    """True when `fn` begins (docstring aside) with an early return
+    gated on an is-tracing predicate — the `DispatchPlan.record`
+    pattern that makes a host helper safe to *call* from a traced
+    scope because its body no-ops under trace."""
+    body = list(fn.body)
+    while body and isinstance(body[0], ast.Expr) and isinstance(
+        body[0].value, ast.Constant
+    ):
+        body = body[1:]  # skip docstring
+    for st in body[:3]:  # the guard must come before any real work
+        if not isinstance(st, ast.If):
+            continue
+        test = st.test
+        if isinstance(test, ast.Call):
+            name = dotted(test.func) or ""
+            if TRACING_GUARD_NAMES.search(name):
+                if all(
+                    isinstance(s, (ast.Return, ast.Pass)) for s in st.body
+                ):
+                    return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# taint engine
+
+
+class Taint:
+    """Two-level taint over local names of one trace scope.
+
+    ``general`` propagates through every expression form (JX002's view:
+    any value *reachable from* a traced param). ``direct`` additionally
+    stops at host-call boundaries (JX003's view: values that are
+    syntactically tracers, not results of host predicates)."""
+
+    def __init__(self, general: set[str], direct: set[str]):
+        self.general = general
+        self.direct = direct
+
+    # -- expression evaluation ------------------------------------------
+
+    def tainted(self, e: ast.expr, *, direct: bool) -> bool:
+        names = self.direct if direct else self.general
+        return self._eval(e, names, direct)
+
+    def _eval(self, e: ast.expr, names: set[str], direct: bool) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in names
+        if isinstance(e, ast.Constant) or isinstance(e, ast.Lambda):
+            return False
+        if isinstance(e, ast.Attribute):
+            if e.attr in TRACE_STATIC_ATTRS:
+                return False
+            return self._eval(e.value, names, direct)
+        if isinstance(e, ast.Compare):
+            # `x is None` / `x is not None`: pytree-structure checks,
+            # static at trace time regardless of x.
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False
+            return self._eval(e.left, names, direct) or any(
+                self._eval(c, names, direct) for c in e.comparators
+            )
+        if isinstance(e, ast.Call):
+            root = (dotted(e.func) or "").split(".", 1)[0]
+            if direct and root not in TRACER_CALL_ROOTS:
+                # A method call on a traced object (x.sum(), W.mean())
+                # returns a tracer; a free-function call is a host
+                # predicate boundary (engine eligibility gates etc.).
+                if isinstance(e.func, ast.Attribute):
+                    return self._eval(e.func.value, names, direct)
+                return False  # host-predicate boundary
+            args_tainted = any(
+                self._eval(a, names, direct)
+                for a in e.args
+                if not isinstance(a, ast.Starred)
+            ) or any(
+                self._eval(k.value, names, direct) for k in e.keywords
+            ) or any(
+                self._eval(a.value, names, direct)
+                for a in e.args
+                if isinstance(a, ast.Starred)
+            )
+            return args_tainted or self._eval(e.func, names, direct)
+        children = [
+            c for c in ast.iter_child_nodes(e) if isinstance(c, ast.expr)
+        ]
+        return any(self._eval(c, names, direct) for c in children)
+
+    # -- statement-order propagation ------------------------------------
+
+    def absorb_assignment(self, targets: Iterable[ast.expr], value: ast.expr):
+        gen = self._eval(value, self.general, False)
+        dire = self._eval(value, self.direct, True)
+        if not (gen or dire):
+            return
+        for t in targets:
+            for name in target_names(t):
+                if gen:
+                    self.general.add(name)
+                if dire:
+                    self.direct.add(name)
+
+
+def target_names(t: ast.expr) -> list[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        return [n for el in t.elts for n in target_names(el)]
+    if isinstance(t, ast.Starred):
+        return target_names(t.value)
+    return []  # attribute/subscript stores don't bind new names
+
+
+def collect_taint(
+    stmts: list[ast.stmt], taint: Taint, *, taint_nested_params: bool = True
+) -> None:
+    """One ordered pass folding assignments (and nested-function params)
+    into the taint sets. Callers run it twice for a cheap fixpoint.
+
+    ``taint_nested_params`` blanket-taints the params of nested function
+    definitions — right for LITERAL jit bodies, where closures are scan
+    steps / vmapped bodies whose params are tracers by construction.
+    Interprocedurally *reached* helpers pass False: their own taint is
+    inferred per parameter at each call site, and their closures are
+    host dispatch plumbing (rung strings, fault records) that the
+    blanket rule would falsely taint; closure-captured traced locals
+    still taint normally through the shared name set."""
+    for st in stmts:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if taint_nested_params:
+                for p in all_params(st):
+                    taint.general.add(p.arg)
+                    taint.direct.add(p.arg)
+            collect_taint(
+                st.body, taint, taint_nested_params=taint_nested_params
+            )
+        elif isinstance(st, ast.Assign):
+            taint.absorb_assignment(st.targets, st.value)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            taint.absorb_assignment([st.target], st.value)
+        elif isinstance(st, ast.AugAssign):
+            taint.absorb_assignment([st.target], st.value)
+        elif isinstance(st, ast.NamedExpr):  # pragma: no cover (stmt ctx)
+            taint.absorb_assignment([st.target], st.value)
+        elif isinstance(st, ast.For):
+            taint.absorb_assignment([st.target], st.iter)
+            collect_taint(st.body, taint, taint_nested_params=taint_nested_params)
+            collect_taint(st.orelse, taint, taint_nested_params=taint_nested_params)
+        elif isinstance(st, (ast.While, ast.If)):
+            collect_taint(st.body, taint, taint_nested_params=taint_nested_params)
+            collect_taint(st.orelse, taint, taint_nested_params=taint_nested_params)
+        elif isinstance(st, ast.With):
+            for item in st.items:
+                if item.optional_vars is not None:
+                    taint.absorb_assignment(
+                        [item.optional_vars], item.context_expr
+                    )
+            collect_taint(st.body, taint, taint_nested_params=taint_nested_params)
+        elif isinstance(st, ast.Try):
+            collect_taint(st.body, taint, taint_nested_params=taint_nested_params)
+            for h in st.handlers:
+                collect_taint(h.body, taint, taint_nested_params=taint_nested_params)
+            collect_taint(st.orelse, taint, taint_nested_params=taint_nested_params)
+            collect_taint(st.finalbody, taint, taint_nested_params=taint_nested_params)
+        # walrus targets inside plain expressions
+        for sub in ast.walk(st):
+            if isinstance(sub, ast.NamedExpr):
+                taint.absorb_assignment([sub.target], sub.value)
+
+
+def scope_nodes(scope) -> list[ast.AST]:
+    """Nodes belonging to ``scope``'s own body, stopping at nested
+    function definitions (each is analyzed as its own scope — this
+    keeps scan reports single and literal-name resolution local)."""
+    body = scope.body if hasattr(scope, "body") else []
+    out: list[ast.AST] = []
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def calls_of(st: ast.stmt) -> list[ast.Call]:
+    """Call nodes belonging to this statement, not descending into
+    nested function bodies (walked separately) or nested suites."""
+    exprs: list[ast.expr] = []
+    for field_, value in ast.iter_fields(st):
+        if field_ in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.expr):
+            exprs.append(value)
+        elif isinstance(value, list):
+            exprs.extend(v for v in value if isinstance(v, ast.expr))
+    calls: list[ast.Call] = []
+    for e in exprs:
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Call):
+                calls.append(sub)
+            elif isinstance(sub, ast.Lambda):
+                for inner in ast.walk(sub.body):
+                    if isinstance(inner, ast.Call):
+                        calls.append(inner)
+    # dedupe while keeping order (lambda bodies walked twice above)
+    seen: set[int] = set()
+    out = []
+    for c in calls:
+        if id(c) not in seen:
+            seen.add(id(c))
+            out.append(c)
+    return out
+
+
+# --------------------------------------------------------------------------
+# suppression handling
+
+
+def parse_suppressions(
+    source: str,
+) -> dict[int, Optional[frozenset[str]]]:
+    """line -> codes (None = all rules) for ``# jaxlint: disable=...``."""
+    out: dict[int, Optional[frozenset[str]]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        codes = m.group("codes")
+        if codes is None:
+            out[i] = None
+        else:
+            out[i] = frozenset(
+                c.strip() for c in codes.split(",") if c.strip()
+            )
+    return out
+
+
+def apply_suppressions(
+    path: str,
+    source: str,
+    findings: list[Finding],
+    select: set[str],
+    all_rules: set[str],
+) -> FileReport:
+    """Filter raw findings through the file's suppression comments."""
+    suppressions = parse_suppressions(source)
+    kept: list[Finding] = []
+    used_lines: set[int] = set()
+    suppressed = 0
+    for f in findings:
+        codes = suppressions.get(f.line, ...)
+        if codes is ... or (codes is not None and f.code not in codes):
+            kept.append(f)
+        else:
+            suppressed += 1
+            used_lines.add(f.line)
+
+    # A suppression is only provably unused when every rule it names
+    # actually ran: under --select/--ignore a suppression for a
+    # de-selected rule may be load-bearing in the full run, so it is
+    # neither used nor unused here.
+    def _judgeable(codes: Optional[frozenset[str]]) -> bool:
+        if codes is None:
+            return select >= all_rules
+        return codes <= select
+
+    unused = [
+        (line, codes)
+        for line, codes in sorted(suppressions.items())
+        if line not in used_lines and _judgeable(codes)
+    ]
+    kept.sort(key=lambda f: (f.line, f.col, f.code))
+    return FileReport(path, kept, suppressed, unused)

@@ -1,0 +1,360 @@
+"""jaxlint whole-program layer: module index, call graph, traced reach.
+
+The per-function analyzer (PR 2) could only see a violation *literally
+inside* a jit-decorated body: a tracer escaping through `float()` in a
+helper one call away, or `time.time()` in a function a jitted scope
+calls, was invisible. This module builds the facts the interprocedural
+rules need:
+
+- a **module index**: every analyzed file mapped to a dotted module
+  name (derived from its path anchor — ``yuma_simulation_tpu``,
+  ``tools``, ``tests``, ``scripts`` — or the bare stem for loose files);
+- a **function index**: module-level functions and class methods by
+  qualified name, with their jit decoration parsed;
+- per-file **import resolution** (absolute and package-relative), so
+  ``from ..telemetry.cost import estimate`` resolves to the indexed
+  function;
+- a **traced-reachability fixpoint**: seeded at every jit scope, a
+  worklist propagates (a) reachability — the callee's body executes at
+  trace time — and (b) *per-parameter taint* — which callee params
+  receive values reachable from the caller's traced params — through
+  every resolvable call. Callees that are themselves jit scopes are
+  boundaries (jit-of-jit is analyzed at its own seed), and so are
+  helpers opening with an is-tracing early return
+  (:func:`tools.jaxlint.model.has_tracing_self_guard` — the
+  ``DispatchPlan.record`` pattern).
+
+Resolution is deliberately conservative: bare names in the same module,
+imported symbols, ``module.attr`` chains through imports, and
+``self.method`` / ``cls.method`` within a class. A call that does not
+resolve is a host boundary exactly as before — the pass adds detection,
+never speculation.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Optional
+
+from tools.jaxlint.model import (
+    PARSE_ERROR_CODE,
+    Finding,
+    Taint,
+    all_params,
+    collect_taint,
+    dotted,
+    has_tracing_self_guard,
+    jit_decoration,
+)
+
+#: Path components that anchor a dotted module name. Order matters only
+#: for documentation; the LAST anchor occurrence in the path wins so a
+#: checkout under e.g. /home/tools/repo still maps tests/ correctly.
+MODULE_ANCHORS = ("yuma_simulation_tpu", "yuma_simulation", "tools", "tests", "scripts")
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name for a file path, anchored at the repo's
+    top-level packages; loose files map to their stem (fixtures)."""
+    parts = Path(path).parts
+    anchor = None
+    for i, part in enumerate(parts):
+        if part in MODULE_ANCHORS:
+            anchor = i
+    if anchor is None:
+        return Path(path).stem
+    mods = list(parts[anchor:])
+    mods[-1] = Path(mods[-1]).stem
+    if mods[-1] == "__init__":
+        mods = mods[:-1]
+    return ".".join(mods)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One indexed function or method."""
+
+    qualname: str  # module.func or module.Class.method
+    module: str
+    cls: Optional[str]
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    unit: "FileUnit"
+    jit_static: Optional[set[str]]  # None when not jit-decorated
+    jit_parseable: bool
+    self_guarded: bool
+
+    @property
+    def is_jit(self) -> bool:
+        return self.jit_static is not None
+
+
+@dataclasses.dataclass
+class FileUnit:
+    """One parsed source file plus its accumulated raw findings."""
+
+    path: str
+    source: str
+    tree: Optional[ast.Module]
+    module: str
+    findings: list[Finding] = dataclasses.field(default_factory=list)
+    #: local name -> ("module", dotted) | ("symbol", dotted)
+    imports: dict[str, tuple[str, str]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def add(self, node: ast.AST, code: str, message: str) -> None:
+        self.findings.append(
+            Finding(
+                self.path,
+                getattr(node, "lineno", 0),
+                getattr(node, "col_offset", 0),
+                code,
+                message,
+            )
+        )
+
+
+def parse_unit(source: str, path: str) -> FileUnit:
+    module = module_name_for(path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        unit = FileUnit(path, source, None, module)
+        unit.findings.append(
+            Finding(
+                path,
+                exc.lineno or 0,
+                exc.offset or 0,
+                PARSE_ERROR_CODE,
+                f"could not parse file: {exc.msg}",
+            )
+        )
+        return unit
+    return FileUnit(path, source, tree, module)
+
+
+def _resolve_relative(module: str, level: int, target: Optional[str]) -> str:
+    """``from ..x import f`` inside ``pkg.sub.mod`` -> ``pkg.x``."""
+    parts = module.split(".")
+    # level 1 = current package (strip the module leaf), 2 = parent, ...
+    base = parts[: max(0, len(parts) - level)]
+    if target:
+        base = base + target.split(".")
+    return ".".join(base)
+
+
+def _index_imports(unit: FileUnit) -> None:
+    assert unit.tree is not None
+    for node in ast.walk(unit.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".", 1)[0]
+                target = alias.name if alias.asname else alias.name.split(".", 1)[0]
+                unit.imports[local] = ("module", target)
+                if alias.asname is None and "." in alias.name:
+                    # `import a.b.c` binds `a`, but the full dotted
+                    # spelling `a.b.c.f` must also resolve.
+                    unit.imports.setdefault(alias.name, ("module", alias.name))
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level:
+                mod = _resolve_relative(unit.module, node.level, node.module)
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                unit.imports[local] = ("symbol", f"{mod}.{alias.name}")
+
+
+@dataclasses.dataclass
+class TraceFacts:
+    """What the fixpoint learned about one function."""
+
+    #: human-readable call chain from a jit seed ("mod.f -> mod.helper")
+    chain: str
+    #: params holding values reachable from the caller's traced params
+    tainted_general: set[str]
+    #: params that are syntactically tracers at every taint step
+    tainted_direct: set[str]
+
+
+class Program:
+    """The whole-program view: every unit, every function, and the
+    traced-reachability facts the interprocedural rules consume."""
+
+    def __init__(self, units: list[FileUnit]):
+        self.units = units
+        self.functions: dict[str, FuncInfo] = {}
+        #: facts for NON-jit functions reachable from a jit scope
+        self.reached: dict[str, TraceFacts] = {}
+        self._build_index()
+        self._fixpoint()
+
+    # -- indexing --------------------------------------------------------
+
+    def _build_index(self) -> None:
+        for unit in self.units:
+            if unit.tree is None:
+                continue
+            _index_imports(unit)
+            for node in unit.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._index_fn(unit, node, cls=None)
+                elif isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            self._index_fn(unit, sub, cls=node.name)
+
+    def _index_fn(self, unit: FileUnit, node, cls: Optional[str]) -> None:
+        qual = (
+            f"{unit.module}.{cls}.{node.name}"
+            if cls
+            else f"{unit.module}.{node.name}"
+        )
+        jit = jit_decoration(node)
+        self.functions[qual] = FuncInfo(
+            qualname=qual,
+            module=unit.module,
+            cls=cls,
+            node=node,
+            unit=unit,
+            jit_static=None if jit is None else jit[0],
+            jit_parseable=jit[1] if jit is not None else True,
+            self_guarded=has_tracing_self_guard(node),
+        )
+
+    # -- call resolution -------------------------------------------------
+
+    def resolve_call(
+        self, unit: FileUnit, call: ast.Call, cls: Optional[str]
+    ) -> Optional[FuncInfo]:
+        """The indexed callee of ``call``, or None (host boundary)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            hit = self.functions.get(f"{unit.module}.{name}")
+            if hit is not None and hit.cls is None:
+                return hit
+            imp = unit.imports.get(name)
+            if imp is not None and imp[0] == "symbol":
+                return self.functions.get(imp[1])
+            return None
+        d = dotted(func)
+        if d is None:
+            return None
+        root, _, rest = d.partition(".")
+        if root in ("self", "cls") and cls is not None and rest and "." not in rest:
+            return self.functions.get(f"{unit.module}.{cls}.{rest}")
+        imp = unit.imports.get(root)
+        if imp is not None and rest:
+            kind, target = imp
+            if kind == "module":
+                return self.functions.get(f"{target}.{rest}")
+            if kind == "symbol":
+                # `from pkg import mod` then `mod.f(...)`
+                return self.functions.get(f"{target}.{rest}")
+        # full dotted spelling of an `import a.b.c`
+        prefix, _, leaf = d.rpartition(".")
+        if prefix in {
+            t for k, (kind, t) in unit.imports.items() if kind == "module"
+        }:
+            return self.functions.get(f"{prefix}.{leaf}")
+        return None
+
+    # -- traced-reachability fixpoint ------------------------------------
+
+    def _fixpoint(self) -> None:
+        # Seeds: every jit scope, with its own traced params.
+        work: list[str] = [
+            q for q, f in self.functions.items() if f.is_jit
+        ]
+        seen_state: dict[str, tuple[int, int]] = {}
+        guard = 0
+        while work and guard < 10_000:
+            guard += 1
+            qual = work.pop()
+            info = self.functions.get(qual)
+            if info is None or info.unit.tree is None:
+                continue
+            if info.is_jit:
+                traced = {
+                    p.arg for p in all_params(info.node)
+                } - (info.jit_static or set())
+                facts = TraceFacts(qual, set(traced), set(traced))
+            else:
+                facts = self.reached.get(qual)
+                if facts is None:
+                    continue
+            state = (
+                len(facts.tainted_general),
+                len(facts.tainted_direct),
+            )
+            if seen_state.get(qual) == state:
+                continue
+            seen_state[qual] = state
+            self._propagate_from(info, facts, work)
+
+    def _propagate_from(
+        self, info: FuncInfo, facts: TraceFacts, work: list[str]
+    ) -> None:
+        taint = Taint(
+            set(facts.tainted_general), set(facts.tainted_direct)
+        )
+        collect_taint(
+            info.node.body, taint, taint_nested_params=info.is_jit
+        )
+        collect_taint(
+            info.node.body, taint, taint_nested_params=info.is_jit
+        )
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.resolve_call(info.unit, node, info.cls)
+            if callee is None or callee.is_jit or callee.self_guarded:
+                continue
+            if callee.qualname == info.qualname:
+                continue  # direct recursion adds nothing new
+            params = [p.arg for p in all_params(callee.node)]
+            if callee.cls is not None and params and params[0] in (
+                "self",
+                "cls",
+            ):
+                params = params[1:]
+            gen: set[str] = set()
+            dire: set[str] = set()
+            for i, arg in enumerate(node.args):
+                if isinstance(arg, ast.Starred) or i >= len(params):
+                    break
+                if taint.tainted(arg, direct=False):
+                    gen.add(params[i])
+                if taint.tainted(arg, direct=True):
+                    dire.add(params[i])
+            for kw in node.keywords:
+                if kw.arg is None or kw.arg not in params:
+                    continue
+                if taint.tainted(kw.value, direct=False):
+                    gen.add(kw.arg)
+                if taint.tainted(kw.value, direct=True):
+                    dire.add(kw.arg)
+            prev = self.reached.get(callee.qualname)
+            if prev is None:
+                self.reached[callee.qualname] = TraceFacts(
+                    f"{facts.chain} -> {callee.qualname}", gen, dire
+                )
+                work.append(callee.qualname)
+            else:
+                before = (
+                    len(prev.tainted_general),
+                    len(prev.tainted_direct),
+                )
+                prev.tainted_general |= gen
+                prev.tainted_direct |= dire
+                if (
+                    len(prev.tainted_general),
+                    len(prev.tainted_direct),
+                ) != before:
+                    work.append(callee.qualname)

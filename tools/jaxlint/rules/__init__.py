@@ -1,0 +1,27 @@
+"""jaxlint rule registry: one module per rule family.
+
+Each family module exposes ``FAMILY`` (its name), ``RULES`` (code ->
+(rule-name, summary)) and ``check(program, add)`` — ``add(unit, node,
+code, message)`` records a raw finding; the driver applies per-line
+suppressions afterwards. Codes are stable across refactors: JX0xx
+trace/hygiene discipline (PR 2), JX1xx concurrency discipline, JX2xx
+telemetry contracts (both PR 11).
+"""
+
+from __future__ import annotations
+
+from tools.jaxlint.rules import concurrency, contracts, hygiene, tracing
+
+#: Family modules in check order (deterministic output ordering).
+FAMILIES = (tracing, hygiene, concurrency, contracts)
+
+#: The aggregate rule registry: code -> (name, summary).
+RULES: dict[str, tuple[str, str]] = {}
+#: code -> family name ("tracing"/"hygiene"/"concurrency"/"contracts").
+RULE_FAMILY: dict[str, str] = {}
+for _mod in FAMILIES:
+    for _code, _entry in _mod.RULES.items():
+        if _code in RULES:  # pragma: no cover — registry integrity
+            raise RuntimeError(f"duplicate jaxlint rule code {_code}")
+        RULES[_code] = _entry
+        RULE_FAMILY[_code] = _mod.FAMILY

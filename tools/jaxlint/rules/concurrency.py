@@ -1,0 +1,406 @@
+"""Concurrency-discipline rule family (JX1xx).
+
+The serving tier, fleet fabric, telemetry registries, and resilience
+watchdog together hold ~19 lock sites, all following three conventions
+this family makes checkable:
+
+- **JX101 guarded-field**: a field a class writes under ``with
+  self._lock:`` in one method is part of that lock's protected state —
+  reading or writing it bare in another method is a data race (torn
+  reads of multi-step updates, lost increments). Guards are discovered
+  structurally: any ``self.X`` assigned a ``threading.Lock`` /
+  ``RLock`` / ``Condition``. ``__init__``/``__del__`` run before
+  publication / at teardown and are exempt, as are methods whose name
+  ends in ``_locked`` (the caller-holds-the-lock helper convention).
+- **JX102 atomic-publish**: durable artifacts (flight bundles, fleet
+  stores, ledgers, span/metric/numerics sinks) survive crashes only
+  because every publish routes through
+  ``utils.checkpoint.publish_atomic`` (temp + fsync + rename + dir
+  fsync) or its append-side twin. A direct write-mode ``open()`` /
+  ``write_text`` / ``write_bytes`` whose path names one of those
+  artifacts is a torn-file bug waiting for a SIGKILL.
+- **JX103 contextvar-across-thread**: ``contextvars`` do NOT flow into
+  a bare ``threading.Thread`` — a target that reads the telemetry
+  context (``log_event``/``current_span``/``ContextVar.get``) sees the
+  defaults unless the spawner copies its context the way
+  ``resilience/watchdog.py`` does (``ctx = contextvars.copy_context()``
+  then ``target=lambda: ctx.run(worker)``) or the target activates its
+  own run context.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Optional
+
+from tools.jaxlint.model import dotted
+from tools.jaxlint.program import FileUnit, Program
+
+FAMILY = "concurrency"
+
+RULES = {
+    "JX101": (
+        "guarded-field-bare-access",
+        "field written under `with self.<lock>:` in one method is "
+        "read/written without the lock in another method of the same "
+        "class (torn reads / lost updates under the serve+fleet thread "
+        "mix)",
+    ),
+    "JX102": (
+        "non-atomic-durable-publish",
+        "direct write-mode open()/write_text/write_bytes to a durable "
+        "artifact path (bundle/store/ledger/span/metric/numerics/"
+        "checkpoint); route through utils.checkpoint.publish_atomic or "
+        "append_durable so a crash mid-write cannot tear the artifact",
+    ),
+    "JX103": (
+        "contextvar-across-thread",
+        "threading.Thread target reads contextvars (telemetry "
+        "run/span identity) but the spawner passes a bare target; copy "
+        "the caller's context (contextvars.copy_context().run — "
+        "resilience/watchdog.py is the pattern) or activate a fresh "
+        "run context inside the target",
+    ),
+}
+
+#: Substrings of a path expression that mark it a durable artifact the
+#: crash-safety contract covers (utils/checkpoint.py module docstring).
+DURABLE_TOKENS = (
+    "bundle", "store", "ledger", "spans", "numerics", "metrics",
+    "slo", "manifest", "checkpoint", "lease", "report",
+)
+
+#: Write modes that truncate or create — the torn-artifact hazard.
+#: ("r+"/"a" appends are covered too: a torn JSONL tail is exactly the
+#: crash class the atomic/append-durable contract exists for.)
+_WRITE_MODES = ("w", "wb", "w+", "wb+", "x", "xb", "a", "ab", "a+", "ab+")
+
+#: Functions that READ the ambient contextvars context (telemetry
+#: identity): calling one from a bare Thread target silently sees the
+#: defaults instead of the spawner's run/span.
+CONTEXT_READERS = {
+    "log_event",
+    "current_fields",
+    "current_span",
+    "current_run",
+    "span",
+}
+
+#: Calls that ESTABLISH a context inside the target (so inheriting the
+#: spawner's context is not relied upon): RunContext.activate(), a
+#: ContextVar.set, or running under an explicitly copied context.
+_CONTEXT_ESTABLISHERS = {"activate", "set", "run", "copy_context"}
+
+
+# --------------------------------------------------------------------------
+# JX101 guarded fields
+
+
+def _guard_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attribute names assigned a threading.Lock/RLock/Condition
+    anywhere in the class body."""
+    guards: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or not isinstance(
+            node.value, ast.Call
+        ):
+            continue
+        fname = dotted(node.value.func) or ""
+        leaf = fname.split(".")[-1]
+        if leaf not in ("Lock", "RLock", "Condition"):
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                guards.add(t.attr)
+    return guards
+
+
+def _is_guard_with(item: ast.withitem, guards: set[str]) -> bool:
+    e = item.context_expr
+    return (
+        isinstance(e, ast.Attribute)
+        and isinstance(e.value, ast.Name)
+        and e.value.id == "self"
+        and e.attr in guards
+    )
+
+
+def _self_field_accesses(
+    method, guards: set[str]
+) -> list[tuple[str, bool, bool, ast.Attribute]]:
+    """(field, is_store, under_lock, node) for every ``self.X`` field
+    access in ``method``. Method calls (``self.m()``) are skipped —
+    only state, not behavior, is lock-protected. Nested functions are
+    walked in the enclosing lock state (closures run where called; the
+    common case here is a locked helper defined inline)."""
+    out: list[tuple[str, bool, bool, ast.Attribute]] = []
+
+    def walk(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, ast.With):
+            inner = locked or any(
+                _is_guard_with(i, guards) for i in node.items
+            )
+            for i in node.items:
+                walk(i.context_expr, locked)
+            for st in node.body:
+                walk(st, inner)
+            return
+        if isinstance(node, ast.Call):
+            # skip the callee attribute itself (self.m() is a method
+            # access, not guarded state), but walk its args
+            if isinstance(node.func, ast.Attribute):
+                walk(node.func.value, locked)
+            else:
+                walk(node.func, locked)
+            for a in node.args:
+                walk(a, locked)
+            for k in node.keywords:
+                walk(k.value, locked)
+            return
+        if isinstance(node, ast.Attribute):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr not in guards
+            ):
+                is_store = isinstance(
+                    node.ctx, (ast.Store, ast.Del)
+                )
+                out.append((node.attr, is_store, locked, node))
+            walk(node.value, locked)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, locked)
+
+    for st in method.body:
+        walk(st, False)
+    return out
+
+
+def _check_jx101(unit: FileUnit, cls: ast.ClassDef, add) -> None:
+    guards = _guard_attrs(cls)
+    if not guards:
+        return
+    methods = [
+        n
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    accesses: dict[str, list] = {}
+    for m in methods:
+        for field, is_store, locked, node in _self_field_accesses(
+            m, guards
+        ):
+            accesses.setdefault(field, []).append(
+                (m.name, is_store, locked, node)
+            )
+    for field, uses in sorted(accesses.items()):
+        locked_writers = {
+            m
+            for m, is_store, locked, _ in uses
+            if is_store and locked and m not in ("__init__", "__del__")
+        }
+        if not locked_writers:
+            continue
+        for m, is_store, locked, node in uses:
+            if locked or m in ("__init__", "__del__"):
+                continue
+            if m.endswith("_locked"):
+                continue  # caller-holds-the-lock helper convention
+            verb = "written" if is_store else "read"
+            add(
+                unit,
+                node,
+                "JX101",
+                f"'{cls.name}.{field}' is written under the lock in "
+                f"{sorted(locked_writers)} but {verb} bare in "
+                f"'{m}': lock-protected state must be accessed under "
+                "the same lock in every method (or from a *_locked "
+                "helper the caller locks around)",
+            )
+
+
+# --------------------------------------------------------------------------
+# JX102 atomic publish
+
+
+def _mentions_durable(expr: ast.expr) -> bool:
+    try:
+        text = ast.unparse(expr).lower()
+    except Exception:  # pragma: no cover — unparse is total on 3.9+
+        return False
+    return any(tok in text for tok in DURABLE_TOKENS)
+
+
+def _check_jx102(unit: FileUnit, add) -> None:
+    posix = Path(unit.path).as_posix()
+    if "yuma_simulation_tpu/" not in posix:
+        return  # tools/tests write scratch files by design
+    if posix.endswith("utils/checkpoint.py"):
+        return  # the atomic primitive itself
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted(node.func) or ""
+        leaf = fname.split(".")[-1]
+        if isinstance(node.func, ast.Name) and node.func.id == "open":
+            mode = "r"
+            if len(node.args) >= 2 and isinstance(
+                node.args[1], ast.Constant
+            ):
+                mode = str(node.args[1].value)
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = str(kw.value.value)
+            if mode not in _WRITE_MODES:
+                continue
+            if node.args and _mentions_durable(node.args[0]):
+                add(
+                    unit,
+                    node,
+                    "JX102",
+                    f"open(..., {mode!r}) on a durable artifact path: a "
+                    "crash between truncate and close leaves a torn "
+                    "file the bundle readers must then survive — "
+                    "publish through utils.checkpoint.publish_atomic "
+                    "(whole-file) or append_durable (JSONL append)",
+                )
+        elif leaf in ("write_text", "write_bytes") and isinstance(
+            node.func, ast.Attribute
+        ):
+            if _mentions_durable(node.func.value):
+                add(
+                    unit,
+                    node,
+                    "JX102",
+                    f".{leaf}() on a durable artifact path writes "
+                    "in place: a crash mid-write tears the artifact — "
+                    "publish through utils.checkpoint.publish_atomic",
+                )
+
+
+# --------------------------------------------------------------------------
+# JX103 contextvars across threads
+
+
+def _contextvar_names(unit: FileUnit) -> set[str]:
+    """Module-level names bound to contextvars.ContextVar(...)."""
+    names: set[str] = set()
+    for node in unit.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            fname = dotted(node.value.func) or ""
+            if fname.split(".")[-1] == "ContextVar":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _reads_context(fn, cvars: set[str]) -> Optional[str]:
+    """The first context-reading call inside ``fn``, or None."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted(node.func) or ""
+        leaf = fname.split(".")[-1]
+        if leaf in CONTEXT_READERS:
+            return fname or leaf
+        if leaf == "get" and isinstance(node.func, ast.Attribute):
+            recv = dotted(node.func.value) or ""
+            if recv in cvars:
+                return f"{recv}.get"
+    return None
+
+
+def _establishes_context(fn) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func) or ""
+            leaf = fname.split(".")[-1]
+            if leaf in _CONTEXT_ESTABLISHERS:
+                return True
+    return False
+
+
+def _local_functions(unit: FileUnit) -> dict:
+    """Every function (any nesting) and method in the unit by bare name
+    — Thread targets are resolved by name within the file."""
+    out: dict = {}
+    for node in ast.walk(unit.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _check_jx103(unit: FileUnit, add) -> None:
+    cvars = _contextvar_names(unit)
+    locals_ = _local_functions(unit)
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted(node.func) or ""
+        if fname.split(".")[-1] != "Thread":
+            continue
+        target_expr = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target_expr = kw.value
+        if target_expr is None:
+            continue
+        # resolve to a function defined in this file
+        target_fn = None
+        if isinstance(target_expr, ast.Name):
+            target_fn = locals_.get(target_expr.id)
+        elif isinstance(target_expr, ast.Attribute):
+            d = dotted(target_expr) or ""
+            if d.endswith(".run"):
+                continue  # Thread(target=ctx.run, args=(worker,)) form
+            if d.startswith(("self.", "cls.")):
+                target_fn = locals_.get(target_expr.attr)
+        elif isinstance(target_expr, ast.Lambda):
+            # `lambda: ctx.run(worker)` — the watchdog pattern — is the
+            # fix itself; any other lambda resolves to its called names.
+            body = target_expr.body
+            if isinstance(body, ast.Call):
+                inner = dotted(body.func) or ""
+                if inner.endswith(".run"):
+                    continue
+                if isinstance(body.func, ast.Name):
+                    target_fn = locals_.get(body.func.id)
+        if target_fn is None:
+            continue
+        reader = _reads_context(target_fn, cvars)
+        if reader is None:
+            continue
+        if _establishes_context(target_fn):
+            continue
+        add(
+            unit,
+            node,
+            "JX103",
+            f"Thread target '{target_fn.name}' reads the ambient "
+            f"contextvars context ({reader}) but is spawned bare: "
+            "contextvars do not flow into a new thread, so telemetry "
+            "records lose their run/span identity — copy the spawner's "
+            "context (ctx = contextvars.copy_context(); "
+            "target=lambda: ctx.run(worker)) as resilience/watchdog.py "
+            "does, or activate a fresh run context inside the target",
+        )
+
+
+def check(program: Program, add) -> None:
+    for unit in program.units:
+        if unit.tree is None:
+            continue
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.ClassDef):
+                _check_jx101(unit, node, add)
+        _check_jx102(unit, add)
+        _check_jx103(unit, add)

@@ -1,0 +1,427 @@
+"""Telemetry-contract rule family (JX2xx).
+
+The observability pipeline is only as trustworthy as its names: a
+typo'd ``log_event`` name silently drops a recovery record out of every
+``grep event=`` and every report tool; a metric name nobody declared
+drifts away from the dashboards; an event nobody consumes is dead
+weight that LOOKS monitored. PR 11 makes the names a checked contract:
+
+- ``yuma_simulation_tpu/telemetry/registry.py`` *declares* every
+  structured event name (``log_event`` + ledger appends) and every
+  metric name, each with its expected consumers among the report tools
+  (``obsreport``/``sloreport``/``driftreport``) or an explicit
+  operator-only justification;
+- **JX201** flags an emitted event name the registry does not declare
+  (typos become lint failures at the emission site) — and non-literal
+  event names, which defeat the registry entirely;
+- **JX202** does the same for metric names at their
+  ``counter()``/``gauge()``/``histogram()`` creation sites;
+- **JX203** audits the registry itself: a declared consumer tool whose
+  source never mentions the event name (the "looks monitored" lie), an
+  operator-only event with no recorded justification, and — in
+  whole-program runs over the package — a declared event no code ever
+  emits.
+
+The registry is parsed statically (stdlib ``ast``), never imported, so
+jaxlint keeps running without jax installed. When the analyzed path set
+does not include the registry (single-fixture runs), the real package
+registry next to this tool is used.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Optional
+
+from tools.jaxlint.model import dotted
+from tools.jaxlint.program import FileUnit, Program
+
+FAMILY = "contracts"
+
+RULES = {
+    "JX201": (
+        "undeclared-event",
+        "log_event / ledger event name is not declared in "
+        "telemetry/registry.py (or is not a string literal, which "
+        "defeats the registry): typo'd telemetry silently vanishes "
+        "from every report tool",
+    ),
+    "JX202": (
+        "undeclared-metric",
+        "counter/gauge/histogram name is not declared in "
+        "telemetry/registry.py: undeclared series drift away from "
+        "dashboards and the obsreport reconciliation",
+    ),
+    "JX203": (
+        "registry-drift",
+        "registry entry out of sync with reality: a declared consumer "
+        "tool never references the event, an operator-only event "
+        "carries no justification, or (whole-package runs) no code "
+        "emits a declared event",
+    ),
+}
+
+REGISTRY_RELPATH = "yuma_simulation_tpu/telemetry/registry.py"
+CONSUMER_TOOLS = ("obsreport", "sloreport", "driftreport")
+
+#: Call leaves that emit a structured event; the event name is the
+#: FIRST positional arg unless listed in _SECOND_ARG_EMITTERS.
+_EVENT_EMITTERS = {"log_event", "append", "_append_ledger"}
+_SECOND_ARG_EMITTERS = {"log_event"}  # log_event(logger, event, ...)
+_METRIC_LEAVES = {"counter", "gauge", "histogram"}
+
+
+class RegistryView:
+    """The statically-parsed registry: names, consumers, reasons, and
+    the source lines declarations sit on (JX203 anchors there)."""
+
+    def __init__(self) -> None:
+        self.events: dict[str, dict] = {}
+        self.metrics: dict[str, dict] = {}
+        self.path: Optional[str] = None
+        self.unit: Optional[FileUnit] = None
+
+    @property
+    def loaded(self) -> bool:
+        return bool(self.events or self.metrics)
+
+
+def _parse_spec_call(value: ast.expr) -> dict:
+    """EventSpec(...)/MetricSpec(...) keywords, literally parseable."""
+    out: dict = {"line": getattr(value, "lineno", 0)}
+    if not isinstance(value, ast.Call):
+        return out
+    for i, arg in enumerate(value.args):
+        if i == 0 and isinstance(arg, ast.Constant):
+            out["summary"] = arg.value
+    for kw in value.keywords:
+        if kw.arg is None:
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant):
+            out[kw.arg] = v.value
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            out[kw.arg] = tuple(
+                el.value
+                for el in v.elts
+                if isinstance(el, ast.Constant)
+            )
+    return out
+
+
+def _parse_registry_tree(tree: ast.Module, view: RegistryView) -> None:
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [
+            t.id for t in node.targets if isinstance(t, ast.Name)
+        ]
+        if not names or not isinstance(node.value, ast.Dict):
+            continue
+        target = None
+        if "EVENTS" in names:
+            target = view.events
+        elif "METRICS" in names:
+            target = view.metrics
+        if target is None:
+            continue
+        for key, value in zip(node.value.keys, node.value.values):
+            if isinstance(key, ast.Constant) and isinstance(
+                key.value, str
+            ):
+                spec = _parse_spec_call(value)
+                spec.setdefault("line", getattr(key, "lineno", 0))
+                target[key.value] = spec
+
+
+def load_registry(program: Program) -> RegistryView:
+    view = RegistryView()
+    for unit in program.units:
+        if unit.tree is None:
+            continue
+        if Path(unit.path).as_posix().endswith(REGISTRY_RELPATH):
+            view.path = unit.path
+            view.unit = unit
+            _parse_registry_tree(unit.tree, view)
+            return view
+    # Fall back to the real registry next to this tool (fixture runs).
+    root = Path(__file__).resolve().parents[3]
+    candidate = root / REGISTRY_RELPATH
+    if candidate.exists():
+        try:
+            tree = ast.parse(candidate.read_text(encoding="utf-8"))
+        except SyntaxError:
+            return view
+        view.path = str(candidate)
+        _parse_registry_tree(tree, view)
+    return view
+
+
+def _call_leaf(call: ast.Call) -> str:
+    """The called name's leaf, robust to call-valued receivers
+    (``get_registry().counter`` has no dotted spelling)."""
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return ""
+
+
+def _event_name_arg(call: ast.Call, leaf: str) -> Optional[ast.expr]:
+    idx = 1 if leaf in _SECOND_ARG_EMITTERS else 0
+    if len(call.args) > idx:
+        return call.args[idx]
+    for kw in call.keywords:
+        if kw.arg == "event":
+            return kw.value
+    return None
+
+
+def _literal_names(arg: ast.expr) -> Optional[list[str]]:
+    """The literal event name(s) of an emission argument: a plain
+    string, or a trace-resolvable choice between strings
+    (``"slo_alert" if bad else "slo_recovered"``)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.IfExp):
+        a = _literal_names(arg.body)
+        b = _literal_names(arg.orelse)
+        if a is not None and b is not None:
+            return a + b
+    return None
+
+
+def _is_ledger_append(call: ast.Call) -> bool:
+    """`x.append(...)` only counts as an event emission when the
+    receiver is ledger-shaped — list.append must stay invisible."""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    recv = (dotted(call.func.value) or "").lower()
+    return "ledger" in recv
+
+
+def _emitted_events(
+    unit: FileUnit,
+) -> list[tuple[ast.Call, Optional[list[str]]]]:
+    """(call, literal-names-or-None) for every event emission site."""
+    out: list[tuple[ast.Call, Optional[list[str]]]] = []
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = _call_leaf(node)
+        if leaf not in _EVENT_EMITTERS:
+            continue
+        if leaf == "append" and not _is_ledger_append(node):
+            continue
+        arg = _event_name_arg(node, leaf)
+        if arg is None:
+            continue
+        names = _literal_names(arg)
+        if names is not None:
+            out.append((node, names))
+        elif isinstance(arg, (ast.Name, ast.Attribute)):
+            # a forwarded `event` parameter (the serve ledger shim) is
+            # checked at ITS literal call sites, not here
+            continue
+        else:
+            out.append((node, None))
+    return out
+
+
+def _in_package(unit: FileUnit) -> bool:
+    return "yuma_simulation_tpu/" in Path(unit.path).as_posix()
+
+
+def check(program: Program, add) -> None:
+    # Whole-package runs MUST carry their own registry unit: analyzing
+    # the package without one is the pre-PR-11 state where no telemetry
+    # name was a checked contract at all. The real-registry fallback
+    # inside load_registry exists for FIXTURE runs only (single files,
+    # no package program), so gate on the unit census first.
+    package_units = [
+        u for u in program.units if u.tree is not None and _in_package(u)
+    ]
+    has_registry_unit = any(
+        Path(u.path).as_posix().endswith(REGISTRY_RELPATH)
+        for u in package_units
+    )
+    if len(package_units) > 1 and not has_registry_unit:
+        anchor_unit = min(package_units, key=lambda u: u.path)
+        add(
+            anchor_unit,
+            anchor_unit.tree,
+            "JX203",
+            "package analyzed without a telemetry registry: "
+            f"{REGISTRY_RELPATH} must declare every event/metric "
+            "name (the contract JX201/JX202 check emissions "
+            "against)",
+        )
+        return
+    registry = load_registry(program)
+    if not registry.loaded:
+        return  # fixture run, nothing to check against
+
+    emitted_names: set[str] = set()
+    for unit in program.units:
+        if unit.tree is None:
+            continue
+        if registry.path is not None and unit.path == registry.path:
+            continue
+        if not _in_package(unit):
+            continue  # tools/tests fixtures emit freely
+        for call, names in _emitted_events(unit):
+            if names is None:
+                add(
+                    unit,
+                    call,
+                    "JX201",
+                    "event name is not a string literal: the registry "
+                    "cross-check (and every `grep event=`) cannot see "
+                    "dynamic names — emit a declared literal",
+                )
+                continue
+            for name in names:
+                if name not in registry.events:
+                    add(
+                        unit,
+                        call,
+                        "JX201",
+                        f"event '{name}' is not declared in "
+                        f"telemetry/registry.py: declare it (with its "
+                        "consumers) or fix the typo",
+                    )
+                else:
+                    emitted_names.add(name)
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _call_leaf(node)
+            if leaf not in _METRIC_LEAVES:
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue  # bare gauge()/counter() builders elsewhere
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (
+                isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+            ):
+                add(
+                    unit,
+                    node,
+                    "JX202",
+                    f"{leaf}() metric name is not a string literal: "
+                    "the registry cross-check cannot see dynamic names",
+                )
+                continue
+            if arg.value not in registry.metrics:
+                add(
+                    unit,
+                    node,
+                    "JX202",
+                    f"metric '{arg.value}' is not declared in "
+                    "telemetry/registry.py: declare it or fix the typo",
+                )
+
+    # -- JX203: audit the registry itself --------------------------------
+    reg_unit = registry.unit
+    if reg_unit is None:
+        return  # fixture run against the fallback registry: emission
+        # sites were checked above; the registry audit runs when the
+        # registry file itself is in the analyzed set (package runs).
+    root = Path(registry.path).resolve().parents[2]
+    source_cache: dict[str, Optional[str]] = {}
+
+    def consumer_source(consumer: str) -> tuple[Optional[str], str]:
+        """(source-or-None, display-path) for a declared consumer: a
+        report tool (tools/<name>.py) or a dotted package module."""
+        if consumer in source_cache:
+            return source_cache[consumer], _display(consumer)
+        if consumer in CONSUMER_TOOLS:
+            candidate = root / "tools" / f"{consumer}.py"
+        else:
+            candidate = (
+                root
+                / "yuma_simulation_tpu"
+                / Path(*consumer.split("."))
+            ).with_suffix(".py")
+        src = (
+            candidate.read_text(encoding="utf-8")
+            if candidate.exists()
+            else None
+        )
+        source_cache[consumer] = src
+        return src, _display(consumer)
+
+    def _display(consumer: str) -> str:
+        if consumer in CONSUMER_TOOLS:
+            return f"tools/{consumer}.py"
+        return "yuma_simulation_tpu/" + "/".join(consumer.split(".")) + ".py"
+
+    def anchor(line: int):
+        class _A:
+            lineno = line
+            col_offset = 0
+
+        return _A()
+
+    def check_consumers(
+        name: str, kind: str, spec: dict, *, require_reason: bool
+    ) -> None:
+        consumers = tuple(spec.get("consumers") or ())
+        reason = spec.get("operator_reason") or ""
+        line = int(spec.get("line", 0))
+        if require_reason and not consumers and not reason:
+            add(
+                reg_unit,
+                anchor(line),
+                "JX203",
+                f"{kind} '{name}' declares no consumer and no "
+                "operator_reason: every telemetry name is either "
+                "consumed by a tool/module or justified as "
+                "operator-grep-only",
+            )
+        for consumer in consumers:
+            src, display = consumer_source(consumer)
+            if src is None:
+                add(
+                    reg_unit,
+                    anchor(line),
+                    "JX203",
+                    f"{kind} '{name}' declares consumer '{consumer}' "
+                    f"but {display} does not exist (expected one of "
+                    f"{CONSUMER_TOOLS} or a dotted package module)",
+                )
+            elif f'"{name}"' not in src and f"'{name}'" not in src:
+                add(
+                    reg_unit,
+                    anchor(line),
+                    "JX203",
+                    f"{kind} '{name}' declares consumer '{consumer}' "
+                    f"but {display} never references the name: the "
+                    f"{kind} LOOKS monitored and is not — wire the "
+                    "consumer or re-declare it operator-only with a "
+                    "reason",
+                )
+
+    package_run = sum(1 for u in program.units if _in_package(u)) > 1
+    for name, spec in sorted(registry.events.items()):
+        check_consumers(name, "event", spec, require_reason=True)
+        if package_run and name not in emitted_names:
+            add(
+                reg_unit,
+                anchor(int(spec.get("line", 0))),
+                "JX203",
+                f"event '{name}' is declared but no analyzed package "
+                "code emits it: delete the entry or restore the "
+                "emitter (dead registry entries hide real coverage "
+                "gaps)",
+            )
+    # Metrics are consumed generically by construction — every
+    # registered series lands in metrics.jsonl snapshots and the
+    # Prometheus exposition — so only EXPLICIT consumer claims are
+    # verified (an event, by contrast, vanishes into greps unless
+    # someone reads it back by name).
+    for name, spec in sorted(registry.metrics.items()):
+        check_consumers(name, "metric", spec, require_reason=False)

@@ -1,0 +1,189 @@
+"""Structural-hygiene rule family: numeric literals, API surface, carries.
+
+These are the module-level rules of PR 2, unchanged in semantics:
+JX005 (dtype-less numeric literals break the x64 bit-parity harness),
+JX007 (the frozen v1 API surface must not import private modules), and
+JX008 (engine scan carries must be the registered pytree dataclasses of
+simulation/carry.py, never raw tuple/dict literals).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Optional
+
+from tools.jaxlint.model import (
+    dotted,
+    is_literal_like,
+    scope_nodes,
+    target_names,
+)
+from tools.jaxlint.program import FileUnit, Program
+
+FAMILY = "hygiene"
+
+RULES = {
+    "JX005": (
+        "dtypeless-literal",
+        "jnp.asarray/jnp.array of a numeric literal without an explicit "
+        "dtype (bit-parity discipline: x64 mode silently promotes)",
+    ),
+    "JX007": (
+        "private-import-in-v1",
+        "public v1 API module imports a private (underscore-prefixed) "
+        "module or name",
+    ),
+    "JX008": (
+        "raw-scan-carry",
+        "lax.scan carry built as a raw tuple/dict literal in engine.py; "
+        "engine carries must be registered pytree dataclasses "
+        "(simulation/carry.py)",
+    ),
+}
+
+
+def _check_jx005(unit: FileUnit, call: ast.Call, add) -> None:
+    fname = dotted(call.func) or ""
+    if fname.split(".")[-1] not in ("asarray", "array"):
+        return
+    root = fname.split(".", 1)[0]
+    if root not in ("jnp", "jax", "numpy", "np"):
+        return
+    if not call.args or not is_literal_like(call.args[0]):
+        return
+    has_dtype = len(call.args) >= 2 or any(
+        kw.arg == "dtype" for kw in call.keywords
+    )
+    if not has_dtype:
+        add(
+            unit,
+            call,
+            "JX005",
+            f"{fname}({ast.unparse(call.args[0])}) literal without an "
+            "explicit dtype: under the x64 parity harness this "
+            "silently promotes to f64 and breaks the bit-parity "
+            "contract — pass dtype= explicitly",
+        )
+
+
+def _check_jx007(unit: FileUnit, node, add) -> None:
+    if isinstance(node, ast.ImportFrom):
+        mod = node.module or ""
+        comps = [c for c in mod.split(".") if c]
+        if any(c.startswith("_") and c != "__future__" for c in comps):
+            add(
+                unit,
+                node,
+                "JX007",
+                f"v1 public API imports private module '{mod}': the "
+                "frozen ApiVer surface must depend only on public "
+                "modules",
+            )
+        for alias in node.names:
+            if alias.name.startswith("_") and alias.name != "*":
+                add(
+                    unit,
+                    node,
+                    "JX007",
+                    f"v1 public API imports private name "
+                    f"'{alias.name}' from '{mod}'",
+                )
+    else:
+        for alias in node.names:
+            comps = alias.name.split(".")
+            if any(c.startswith("_") and c != "__future__" for c in comps):
+                add(
+                    unit,
+                    node,
+                    "JX007",
+                    f"v1 public API imports private module "
+                    f"'{alias.name}'",
+                )
+
+
+def _is_container_literal(e: ast.expr) -> bool:
+    if isinstance(e, (ast.Tuple, ast.List, ast.Dict)):
+        return True
+    if isinstance(e, ast.IfExp):
+        return _is_container_literal(e.body) or _is_container_literal(
+            e.orelse
+        )
+    if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Add):
+        return _is_container_literal(e.left) or _is_container_literal(
+            e.right
+        )
+    return False
+
+
+def _check_jx008(unit: FileUnit, add) -> None:
+    """lax.scan inits in engine.py must not be raw tuple/dict pytrees."""
+    scopes: list[ast.AST] = [unit.tree]
+    scopes.extend(
+        n
+        for n in ast.walk(unit.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    )
+    for fn in scopes:
+        nodes = scope_nodes(fn)
+        # name -> literal-RHS assignments, for resolving `carry0`
+        literal_names: set[str] = set()
+        for sub in nodes:
+            rhs: Optional[ast.expr] = None
+            names: list[str] = []
+            if isinstance(sub, ast.Assign):
+                rhs = sub.value
+                names = [n for t in sub.targets for n in target_names(t)]
+            elif isinstance(sub, ast.AugAssign) and isinstance(
+                sub.target, ast.Name
+            ):
+                rhs = sub.value
+                names = [sub.target.id]
+            if rhs is not None and names and _is_container_literal(rhs):
+                literal_names.update(names)
+        for call in nodes:
+            if not isinstance(call, ast.Call):
+                continue
+            fname = dotted(call.func) or ""
+            if fname.split(".")[-1] != "scan":
+                continue
+            if not (fname.startswith("lax.") or "jax.lax" in fname):
+                continue
+            init = None
+            if len(call.args) >= 2:
+                init = call.args[1]
+            else:
+                for kw in call.keywords:
+                    if kw.arg == "init":
+                        init = kw.value
+            if init is None:
+                continue
+            bad = _is_container_literal(init) or (
+                isinstance(init, ast.Name) and init.id in literal_names
+            )
+            if bad:
+                add(
+                    unit,
+                    call,
+                    "JX008",
+                    "lax.scan carry is a raw tuple/dict literal; "
+                    "engine carries must be the registered pytree "
+                    "dataclasses of simulation/carry.py (stable "
+                    "field names, no positional-unpack drift)",
+                )
+
+
+def check(program: Program, add) -> None:
+    for unit in program.units:
+        if unit.tree is None:
+            continue
+        posix = Path(unit.path).as_posix()
+        is_engine = posix.endswith("simulation/engine.py")
+        is_v1 = "/v1/" in posix or posix.startswith("v1/")
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.Call):
+                _check_jx005(unit, node, add)
+            if is_v1 and isinstance(node, (ast.Import, ast.ImportFrom)):
+                _check_jx007(unit, node, add)
+        if is_engine:
+            _check_jx008(unit, add)

@@ -1,0 +1,379 @@
+"""Trace-discipline rule family: what may (not) happen under a jit trace.
+
+Intra-scope rules run on every jit-decorated function (plus its nested
+closures, traced as part of the same program) exactly as PR 2's
+analyzer did. The interprocedural extension applies the SAME checks to
+every function the whole-program layer proved *reachable by call* from
+a jit scope (:mod:`tools.jaxlint.program`), with the callee's
+per-parameter taint inferred from its call sites — so
+``float(x.sum())`` one helper away from the jit boundary is JX002 now,
+not invisible.
+
+JX010 is the dedicated wall-clock / host-RNG rule: trace-time values
+(`time.time()`, `datetime.now()`, `os.urandom`, `uuid4`, ...) bake into
+the compiled artifact and silently replay on every cached execution.
+Inside a literal jit body the long-standing JX006 impurity rule already
+covers the classic spellings; JX010 adds (a) the extended catalog
+(uuid/secrets/urandom/localtime) in literal jit bodies and (b) the
+whole catalog in functions only *reachable* from a jit scope, where
+JX006 deliberately stays quiet to keep its historical meaning stable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.jaxlint.model import (
+    Taint,
+    all_params,
+    annotation_mentions,
+    calls_of,
+    collect_taint,
+    dotted,
+)
+from tools.jaxlint.program import FuncInfo, Program, TraceFacts
+
+FAMILY = "tracing"
+
+RULES = {
+    "JX001": (
+        "jit-static-completeness",
+        "str/bool-typed parameter of a jitted function is not listed in "
+        "static_argnames (it would be traced, or retrace per call)",
+    ),
+    "JX002": (
+        "tracer-host-cast",
+        "host cast (float()/int()/bool()/.item()/.tolist()/np.*) applied "
+        "to a value reachable from a jitted function's traced params — "
+        "including inside helpers the jit scope calls",
+    ),
+    "JX003": (
+        "tracer-branch",
+        "Python if/while branches on a traced value inside a jit-traced "
+        "region (trace-time concretization; use lax.cond/jnp.where)",
+    ),
+    "JX004": (
+        "fault-hook-in-trace",
+        "fault-injection hook called inside a jit-traced region; hooks "
+        "are host-level and self-guard with the is-tracing check — a "
+        "traced call site would bake the arming state into the jit cache",
+    ),
+    "JX006": (
+        "impure-in-trace",
+        "impure host call (time.*/random.*/np.random.*/datetime.now) "
+        "literally inside a jitted body; the value freezes into the trace",
+    ),
+    "JX009": (
+        "device-put-in-trace",
+        "jax.device_put inside a scan/jit-traced region: under trace it "
+        "is a layout hint at best and a silent no-op at worst — the "
+        "transfer the caller meant to overlap with compute never "
+        "happens there; stage the buffer from the host-level dispatch "
+        "driver (the bug class the double-buffered streaming rewrite "
+        "removed)",
+    ),
+    "JX010": (
+        "wallclock-rng-in-trace",
+        "wall-clock or host-RNG call (time.*, datetime.*, os.urandom, "
+        "uuid.*, secrets.*, random.*, np.random.*) in a function "
+        "reachable from a jitted scope: the value is sampled once at "
+        "trace time and silently replayed by every cached execution",
+    ),
+}
+
+#: Host-level fault-injection hooks (resilience/faults.py). Inside a
+#: traced body their is-tracing self-guard silently no-ops (or worse:
+#: bakes the armed plan into a cached executable) — JX004.
+FAULT_HOOKS = {
+    "maybe_fail_fused_dispatch",
+    "active_nan_fault",
+    "mangle_chunk_file",
+}
+
+#: JX006's historical impurity catalog (kept stable): fires literally
+#: inside jit bodies only.
+_TIME_LEAVES = {
+    "time", "perf_counter", "monotonic", "process_time",
+    "time_ns", "perf_counter_ns",
+}
+_DATETIME_LEAVES = {"now", "today", "utcnow"}
+
+
+def _is_jx006_impure(root: str, leaf: str, fname: str) -> bool:
+    return (
+        (root == "time" and leaf in _TIME_LEAVES)
+        or (root == "random" and fname.startswith("random."))
+        or fname.startswith(("np.random", "numpy.random"))
+        or (root == "datetime" and leaf in _DATETIME_LEAVES)
+    )
+
+
+#: JX010's full wall-clock / host-RNG catalog: the JX006 classics plus
+#: the spellings JX006 never covered.
+_JX010_EXTRA_LEAVES = {"localtime", "gmtime", "ctime", "strftime"}
+
+
+def _is_wallclock_rng(root: str, leaf: str, fname: str) -> bool:
+    if _is_jx006_impure(root, leaf, fname):
+        return True
+    if root == "time" and leaf in _JX010_EXTRA_LEAVES:
+        return True
+    if root == "os" and leaf == "urandom":
+        return True
+    if root == "uuid" and leaf.startswith("uuid"):
+        return True
+    if root == "secrets":
+        return True
+    return False
+
+
+def _default_for(fn, param: ast.arg):
+    a = fn.args
+    pos = [*a.posonlyargs, *a.args]
+    if param in pos:
+        idx = pos.index(param)
+        off = len(pos) - len(a.defaults)
+        if idx >= off:
+            return a.defaults[idx - off]
+        return None
+    if param in a.kwonlyargs:
+        return a.kw_defaults[a.kwonlyargs.index(param)]
+    return None
+
+
+class TraceScopeChecker:
+    """Run the trace-discipline checks over ONE scope: either a jit
+    body (``chain`` None) or a helper reachable from one (``chain`` is
+    the seed call path, appended to every message)."""
+
+    def __init__(self, info: FuncInfo, add, chain=None):
+        self.info = info
+        self.unit = info.unit
+        self._add = add
+        self.chain = chain
+
+    def add(self, node: ast.AST, code: str, message: str) -> None:
+        if self.chain:
+            message = f"{message} [traced via {self.chain}]"
+        self._add(self.unit, node, code, message)
+
+    def run(self, traced_general: set, traced_direct: set) -> None:
+        taint = Taint(set(traced_general), set(traced_direct))
+        # two ordered passes ~= fixpoint for straight-line + one loop
+        # level; nested-closure params are tracers by construction only
+        # in LITERAL jit bodies (see collect_taint)
+        nested = self.chain is None
+        collect_taint(self.info.node.body, taint, taint_nested_params=nested)
+        collect_taint(self.info.node.body, taint, taint_nested_params=nested)
+        self._walk(self.info.node.body, taint)
+
+    def _walk(self, stmts: list[ast.stmt], taint: Taint) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.If, ast.While)):
+                test = st.test
+                if taint.tainted(test, direct=True):
+                    kw = "if" if isinstance(st, ast.If) else "while"
+                    self.add(
+                        test,
+                        "JX003",
+                        f"Python `{kw}` branches on a traced value inside "
+                        "a jit-traced region — this concretizes at trace "
+                        "time; use jnp.where / lax.cond / lax.while_loop",
+                    )
+            for call in calls_of(st):
+                self._check_call(call, taint)
+            # recurse into nested function bodies — closures (scan
+            # steps, vmapped lambdas-made-def) trace as part of this
+            # same program. FunctionDefs inside nested suites are
+            # reached through the suite recursion below.
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(st.body, taint)
+            if isinstance(st, (ast.If, ast.While, ast.For)):
+                self._walk(st.body, taint)
+                self._walk(st.orelse, taint)
+            elif isinstance(st, ast.With):
+                self._walk(st.body, taint)
+            elif isinstance(st, ast.Try):
+                self._walk(st.body, taint)
+                for h in st.handlers:
+                    self._walk(h.body, taint)
+                self._walk(st.orelse, taint)
+                self._walk(st.finalbody, taint)
+
+    def _check_call(self, call: ast.Call, taint: Taint) -> None:
+        fname = dotted(call.func) or ""
+        leaf = fname.split(".")[-1]
+        root = fname.split(".", 1)[0]
+
+        # JX002: host casts on traced values
+        if isinstance(call.func, ast.Name) and call.func.id in (
+            "float",
+            "int",
+            "bool",
+        ):
+            if any(taint.tainted(a, direct=False) for a in call.args):
+                self.add(
+                    call,
+                    "JX002",
+                    f"{call.func.id}() applied to a traced value inside a "
+                    "jit-traced region: concretizes the tracer (or silently "
+                    "freezes a weak-typed constant into the trace)",
+                )
+        elif isinstance(call.func, ast.Attribute) and call.func.attr in (
+            "item",
+            "tolist",
+        ):
+            if taint.tainted(call.func.value, direct=False):
+                self.add(
+                    call,
+                    "JX002",
+                    f".{call.func.attr}() on a traced value inside a "
+                    "jit-traced region: forces a host transfer at trace time",
+                )
+        elif root in ("np", "numpy") and not fname.startswith(
+            ("np.random", "numpy.random")
+        ):
+            if any(
+                taint.tainted(a, direct=False)
+                for a in call.args
+                if not isinstance(a, ast.Starred)
+            ):
+                self.add(
+                    call,
+                    "JX002",
+                    f"{fname}() applied to a traced value inside a "
+                    "jit-traced region: numpy concretizes tracers to host "
+                    "arrays — use the jnp equivalent",
+                )
+
+        # JX009: host->device staging belongs to the host-level driver.
+        if leaf == "device_put":
+            self.add(
+                call,
+                "JX009",
+                f"{fname}() inside a jit-traced region: under trace "
+                "device_put is at best a layout constraint and never "
+                "the async host->HBM transfer the call site implies — "
+                "stage buffers from the host-level dispatch driver "
+                "(engine.simulate_streamed's double-buffer is the "
+                "pattern)",
+            )
+
+        # JX004: fault hooks must stay host-level
+        if leaf in FAULT_HOOKS:
+            self.add(
+                call,
+                "JX004",
+                f"fault-injection hook '{leaf}' called inside a "
+                "jit-traced region: the hook's is-tracing guard makes it "
+                "a silent no-op under trace (and an armed plan would "
+                "otherwise bake into the jit cache) — call it from the "
+                "host-level dispatch wrapper instead",
+            )
+
+        # JX006 (literal jit bodies only — historical catalog) and
+        # JX010 (extended catalog; the ONLY impurity code in reachable
+        # helpers, so one call never double-reports).
+        jx006 = _is_jx006_impure(root, leaf, fname)
+        jx010 = _is_wallclock_rng(root, leaf, fname)
+        if self.chain is None and jx006:
+            self.add(
+                call,
+                "JX006",
+                f"impure host call {fname}() inside a jitted body: the "
+                "value freezes at trace time and silently re-used across "
+                "calls — compute it on the host and pass it in (or use "
+                "jax.random with explicit keys)",
+            )
+        elif jx010 and (self.chain is not None or not jx006):
+            self.add(
+                call,
+                "JX010",
+                f"wall-clock/host-RNG call {fname}() executes at trace "
+                "time here: the sampled value bakes into the compiled "
+                "artifact and replays on every cached execution — "
+                "compute it on the host side of the dispatch and pass "
+                "it in (or use jax.random with explicit keys)",
+            )
+
+
+def _check_jx001(unit, fn, static: set[str], add) -> None:
+    for p in all_params(fn):
+        if p.arg in static:
+            continue
+        str_like = annotation_mentions(p.annotation, {"str"})
+        bool_like = annotation_mentions(p.annotation, {"bool"})
+        default = _default_for(fn, p)
+        str_default = isinstance(default, ast.Constant) and isinstance(
+            default.value, str
+        )
+        if str_like or bool_like or str_default:
+            kind = "str" if (str_like or str_default) else "bool"
+            add(
+                unit,
+                p,
+                "JX001",
+                f"jitted function '{fn.name}' takes {kind}-typed param "
+                f"'{p.arg}' that is not in static_argnames: it either "
+                "fails to trace or silently keys a recompile per value",
+            )
+
+
+def check(program: Program, add) -> None:
+    """Run the tracing family over the whole program."""
+    for info in program.functions.values():
+        if info.unit.tree is None:
+            continue
+        if info.is_jit:
+            if info.jit_parseable:
+                _check_jx001(info.unit, info.node, info.jit_static, add)
+            traced = {p.arg for p in all_params(info.node)} - (
+                info.jit_static or set()
+            )
+            TraceScopeChecker(info, add).run(set(traced), set(traced))
+    # Nested jit scopes (functions jit-decorated inside another
+    # function) are not in the program index; analyze them per unit so
+    # the PR 2 behavior — every literal jit body is checked — holds.
+    for unit in program.units:
+        if unit.tree is None:
+            continue
+        indexed = {
+            info.node
+            for info in program.functions.values()
+            if info.unit is unit
+        }
+        from tools.jaxlint.model import jit_decoration
+
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node in indexed:
+                continue
+            jit = jit_decoration(node)
+            if jit is None:
+                continue
+            static, parseable = jit
+            stub = FuncInfo(
+                qualname=f"{unit.module}.<nested>.{node.name}",
+                module=unit.module,
+                cls=None,
+                node=node,
+                unit=unit,
+                jit_static=static,
+                jit_parseable=parseable,
+                self_guarded=False,
+            )
+            if parseable:
+                _check_jx001(unit, node, static, add)
+            traced = {p.arg for p in all_params(node)} - static
+            TraceScopeChecker(stub, add).run(set(traced), set(traced))
+    # Interprocedural: helpers the fixpoint proved reachable from a jit
+    # scope, with their inferred per-param taint.
+    for qual, facts in sorted(program.reached.items()):
+        info = program.functions.get(qual)
+        if info is None or info.unit.tree is None:
+            continue
+        checker = TraceScopeChecker(info, add, chain=facts.chain)
+        checker.run(
+            set(facts.tainted_general), set(facts.tainted_direct)
+        )

