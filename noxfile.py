@@ -258,6 +258,39 @@ def drift(session: nox.Session) -> None:
 
 
 @nox.session
+def scenarios(session: nox.Session) -> None:
+    """Scenario lane (mirrors the CI `scenarios` job): the foundry
+    property suite (DSL bitwise pins, metagraph schema round-trips,
+    adversarial dividend properties, Monte-Carlo carrier round-trips),
+    then the generated-suite supervisor drill gated by obsreport and
+    driftreport."""
+    session.install("-e", ".[test]")
+    session.run(
+        "python", "-m", "pytest",
+        "tests/unit/test_foundry_dsl.py",
+        "tests/unit/test_foundry_metagraph.py",
+        "tests/unit/test_foundry_properties.py",
+        "tests/unit/test_foundry_montecarlo.py",
+        "tests/unit/test_scenario_contract.py",
+        "-q",
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    tmp = session.create_tmp()
+    import os
+
+    bundle = os.path.join(tmp, "foundry-bundle")
+    session.run(
+        "python", "-m", "yuma_simulation_tpu.foundry", "--drill",
+        "--bundle-dir", bundle, "--suite-size", "8",
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    session.run("python", "-m", "tools.obsreport", bundle, "--check")
+    session.run(
+        "python", "-m", "tools.driftreport", bundle, "--check", "--require"
+    )
+
+
+@nox.session
 def slo(session: nox.Session) -> None:
     """SLO lane (mirrors the CI sloreport gates): the distributed-
     tracing + SLO test battery — sketch algebra property tests,

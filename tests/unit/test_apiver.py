@@ -19,11 +19,31 @@ _FROZEN_SURFACE = [
     "YumaConfig",
     "YumaParams",
     "YumaSimulationNames",
+    # -- scenario foundry (0.16.0, additive): the DSL compiler, the
+    # metagraph snapshot loader, and the adversarial family builders.
+    "cartel_scenario",
+    "compile_spec",
     "generate_chart_table",
     "generate_total_dividends_table",
+    "load_metagraph_snapshot",
     "run_simulation",
     "serve",
+    "stake_churn_scenario",
+    "takeover_scenario",
+    "weight_copier_scenario",
 ]
+
+
+def test_v1_surface_growth_is_additive():
+    """0.16.0 grew the surface; the 0.15.0 names must all survive (the
+    ApiVer contract is additive-only growth)."""
+    for name in (
+        "HTML", "Scenario", "SimulationClient",
+        "SimulationHyperparameters", "YumaConfig", "YumaParams",
+        "YumaSimulationNames", "generate_chart_table",
+        "generate_total_dividends_table", "run_simulation", "serve",
+    ):
+        assert name in _FROZEN_SURFACE
 
 
 def test_v1_api_surface_is_frozen():

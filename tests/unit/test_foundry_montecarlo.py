@@ -1,0 +1,234 @@
+"""Monte-Carlo bridge (ISSUE 12 tentpole pillar 4): seeded parameter
+sampling, generated-suite carriers (batch / supervised / fleet), and
+the run_fleet_grid bitwise round-trip over a sampled config population
+on a DSL-compiled scenario."""
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.foundry import (
+    Choice,
+    IntRange,
+    LogUniform,
+    OneHot,
+    ScenarioSpec,
+    Stakes,
+    Uniform,
+    at_epochs,
+    builtin_case_specs,
+    compile_spec,
+    derived_seed,
+    montecarlo_config_batch,
+    montecarlo_suite,
+    run_montecarlo,
+    sample_params,
+    sequence,
+)
+
+VERSION = "Yuma 1 (paper)"
+
+
+def _drifting_spec(seed: int = 0, shift_epoch: int = 5,
+                   stake: float = 0.6) -> ScenarioSpec:
+    """A tiny DSL builder parameterized the way a Monte-Carlo study
+    samples it: shift epoch and anchor stake vary per draw."""
+    rest = (1.0 - stake) / 2.0
+    return ScenarioSpec(
+        name=f"mc drift (seed={seed})",
+        validators=("anchor", "a", "b"),
+        base_validator="anchor",
+        num_miners=2,
+        num_epochs=10,
+        stakes=sequence(Stakes((stake, rest, rest))),
+        weights=sequence(
+            at_epochs(OneHot((0, 0, 0)), 0, int(shift_epoch)),
+            at_epochs(OneHot((1, 1, 1)), int(shift_epoch)),
+        ),
+    )
+
+
+# ------------------------------------------------------------- sampling
+
+
+def test_sample_params_is_deterministic_and_typed():
+    dists = {
+        "stake": Uniform(0.4, 0.7),
+        "shift_epoch": IntRange(2, 7),
+        "family": Choice(("copier", "cartel")),
+        "sigma": LogUniform(0.01, 0.1),
+        "constant": 3,
+    }
+    a = sample_params(dists, 5, seed=42)
+    b = sample_params(dists, 5, seed=42)
+    assert a == b
+    assert all(0.4 <= p["stake"] <= 0.7 for p in a)
+    assert all(2 <= p["shift_epoch"] <= 7 for p in a)
+    assert all(p["family"] in ("copier", "cartel") for p in a)
+    assert all(0.01 <= p["sigma"] <= 0.1 for p in a)
+    assert all(p["constant"] == 3 for p in a)
+    assert sample_params(dists, 5, seed=43) != a
+
+
+def test_sample_params_prefix_is_stable():
+    dists = {"x": Uniform(0.0, 1.0)}
+    long = sample_params(dists, 8, seed=7)
+    short = sample_params(dists, 3, seed=7)
+    assert long[:3] == short
+
+
+def test_derived_seed_is_stable_and_spread():
+    assert derived_seed(1, 0) == derived_seed(1, 0)
+    seeds = {derived_seed(1, i) for i in range(64)}
+    assert len(seeds) == 64
+
+
+def test_montecarlo_suite_compiles_spec_draws():
+    scenarios, points = montecarlo_suite(
+        _drifting_spec,
+        {"shift_epoch": IntRange(2, 7), "stake": Uniform(0.4, 0.7)},
+        4,
+        seed=0,
+    )
+    assert len(scenarios) == len(points) == 4
+    shapes = {s.weights.shape for s in scenarios}
+    assert shapes == {(10, 3, 2)}
+    # draws actually vary
+    assert len({s.weights.tobytes() for s in scenarios}) > 1
+
+
+def test_montecarlo_suite_accepts_adversarial_builders():
+    from yuma_simulation_tpu.foundry import weight_copier_scenario
+
+    scenarios, _ = montecarlo_suite(
+        lambda seed, lag: weight_copier_scenario(int(seed), lag=int(lag)),
+        {"lag": IntRange(1, 2)},
+        3,
+        seed=5,
+    )
+    assert len(scenarios) == 3
+
+
+# ------------------------------------------------------------- carriers
+
+
+def test_generated_suite_batch_vs_supervised_is_bitwise():
+    """The same generated population lands bit-for-bit identical
+    dividends on the plain batched engine and the full supervised
+    tier."""
+    scenarios, _ = montecarlo_suite(
+        _drifting_spec,
+        {"shift_epoch": IntRange(2, 7), "stake": Uniform(0.4, 0.7)},
+        5,
+        seed=1,
+    )
+    plain = run_montecarlo(scenarios, VERSION, route="batch")
+    supervised = run_montecarlo(scenarios, VERSION, route="supervised")
+    np.testing.assert_array_equal(
+        plain["dividends"], np.asarray(supervised["dividends"])
+    )
+
+
+def test_generated_suite_fleet_vs_supervised_is_bitwise(tmp_path):
+    """The fleet carrier (lease-claimed units over a shared store)
+    reproduces the supervised dividends bitwise for a generated
+    population."""
+    from yuma_simulation_tpu.fabric import FleetConfig
+
+    scenarios, _ = montecarlo_suite(
+        _drifting_spec,
+        {"shift_epoch": IntRange(2, 7), "stake": Uniform(0.4, 0.7)},
+        4,
+        seed=2,
+    )
+    fleet = run_montecarlo(
+        scenarios,
+        VERSION,
+        route="fleet",
+        fleet=FleetConfig(directory=tmp_path, unit_size=2),
+    )
+    supervised = run_montecarlo(scenarios, VERSION, route="supervised")
+    np.testing.assert_array_equal(
+        np.asarray(fleet["dividends"]),
+        np.asarray(supervised["dividends"]),
+    )
+
+
+def test_unknown_route_is_rejected():
+    scenario = compile_spec(_drifting_spec())
+    with pytest.raises(ValueError, match="unknown route"):
+        run_montecarlo([scenario], VERSION, route="teleport")
+    with pytest.raises(ValueError, match="mesh"):
+        run_montecarlo([scenario], VERSION, route="sharded")
+    with pytest.raises(ValueError, match="fleet"):
+        run_montecarlo([scenario], VERSION, route="fleet")
+
+
+# -------------------------------------------- config-space MC -> fleet
+
+
+def test_montecarlo_config_batch_is_seeded_and_batched():
+    import jax
+
+    configs, points = montecarlo_config_batch(
+        {"kappa": Uniform(0.4, 0.6), "bond_alpha": LogUniform(0.02, 0.3)},
+        6,
+        seed=3,
+    )
+    assert len(points) == 6
+    leaves = [leaf for leaf in jax.tree.leaves(configs)]
+    assert all(leaf.shape[0] == 6 for leaf in leaves)
+    again, points2 = montecarlo_config_batch(
+        {"kappa": Uniform(0.4, 0.6), "bond_alpha": LogUniform(0.02, 0.3)},
+        6,
+        seed=3,
+    )
+    assert points == points2
+
+
+def test_montecarlo_config_batch_rejects_static_fields():
+    with pytest.raises(ValueError, match="static"):
+        montecarlo_config_batch({"liquid_alpha": Choice((True, False))},
+                                2, seed=0)
+
+
+def test_config_montecarlo_round_trips_fleet_grid_bitwise(tmp_path):
+    """The acceptance pin: a Monte-Carlo sample over hyperparameters of
+    a DSL-compiled scenario round-trips through `run_fleet_grid`
+    BITWISE against the single-host supervised grid."""
+    from yuma_simulation_tpu.fabric import FleetConfig, run_fleet_grid
+    from yuma_simulation_tpu.resilience import SweepSupervisor
+
+    scenario = compile_spec(builtin_case_specs()["Case 1"])
+    configs, points = montecarlo_config_batch(
+        {"kappa": Uniform(0.35, 0.65), "bond_penalty": Uniform(0.0, 1.0)},
+        5,
+        seed=4,
+    )
+    fleet_out = run_fleet_grid(
+        scenario,
+        VERSION,
+        FleetConfig(directory=tmp_path, unit_size=2),
+        configs=configs,
+        points=points,
+    )
+    ref = SweepSupervisor(directory=None, unit_size=2).run_grid(
+        scenario, VERSION, configs
+    )
+    assert fleet_out["points"] == points
+    np.testing.assert_array_equal(
+        np.asarray(fleet_out["dividends"]), np.asarray(ref["dividends"])
+    )
+
+
+# ------------------------------------------------------------- drill CLI
+
+
+def test_drill_suite_is_deterministic():
+    from yuma_simulation_tpu.foundry.__main__ import build_drill_suite
+
+    a = build_drill_suite(0, 8)
+    b = build_drill_suite(0, 8)
+    assert len(a) == 8
+    for sa, sb in zip(a, b):
+        np.testing.assert_array_equal(sa.weights, sb.weights)
+        np.testing.assert_array_equal(sa.stakes, sb.stakes)

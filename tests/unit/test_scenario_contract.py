@@ -1,0 +1,146 @@
+"""Scenario input contract + case-registry memoization (ISSUE 12
+satellites: `Scenario.validate()` rejection paths; `get_cases()` no
+longer re-invokes every builder per call, with copy-on-return)."""
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.scenarios.base import (
+    Scenario,
+    ScenarioValidationError,
+    class_registry,
+    constant_stakes,
+    get_cases,
+    register_case,
+)
+
+
+def _scenario(weights=None, stakes=None):
+    W = np.zeros((4, 2, 2), np.float32)
+    W[:, :, 0] = 1.0
+    return Scenario(
+        name="contract",
+        validators=["a", "b"],
+        base_validator="a",
+        weights=W if weights is None else weights,
+        stakes=(
+            constant_stakes(4, [0.5, 0.5]) if stakes is None else stakes
+        ),
+        num_epochs=4,
+    )
+
+
+# ------------------------------------------------------------- validate()
+
+
+def test_validate_accepts_clean_scenario_and_returns_self():
+    s = _scenario()
+    assert s.validate(normalized=True) is s
+
+
+def test_validate_rejects_nan_weight_with_provenance():
+    W = np.zeros((4, 2, 2), np.float32)
+    W[:, :, 0] = 1.0
+    W[2, 1, 0] = np.nan
+    with pytest.raises(ScenarioValidationError, match=r"\(2, 1, 0\)"):
+        _scenario(weights=W).validate()
+
+
+def test_validate_rejects_negative_weight():
+    W = np.zeros((4, 2, 2), np.float32)
+    W[:, :, 0] = 1.0
+    W[1, 0, 1] = -0.25
+    with pytest.raises(ScenarioValidationError, match="negative weight"):
+        _scenario(weights=W).validate()
+
+
+def test_validate_rejects_nonfinite_stake():
+    S = constant_stakes(4, [0.5, 0.5])
+    S[3, 0] = np.inf
+    with pytest.raises(ScenarioValidationError, match="non-finite stake"):
+        _scenario(stakes=S).validate()
+
+
+def test_validate_rejects_negative_stake():
+    S = constant_stakes(4, [0.5, 0.5])
+    S[0, 1] = -1.0
+    with pytest.raises(ScenarioValidationError, match="negative stake"):
+        _scenario(stakes=S).validate()
+
+
+def test_validate_rejects_all_zero_stake():
+    S = np.zeros((4, 2), np.float32)
+    with pytest.raises(ScenarioValidationError, match="zero total stake"):
+        _scenario(stakes=S).validate()
+
+
+def test_validate_normalization_tolerance():
+    W = np.full((4, 2, 2), 0.55, np.float32)  # rows sum to 1.1
+    with pytest.raises(ScenarioValidationError, match="sums to"):
+        _scenario(weights=W).validate(normalized=True)
+    # the same scenario passes without the normalization contract, and
+    # with a tolerance that admits the excess
+    _scenario(weights=W).validate()
+    _scenario(weights=W).validate(normalized=True, normalization_tol=0.2)
+
+
+def test_validate_allows_all_zero_rows_under_normalized():
+    W = np.zeros((4, 2, 2), np.float32)
+    W[:, 0, 0] = 1.0  # validator b abstains every epoch
+    _scenario(weights=W).validate(normalized=True)
+
+
+# ----------------------------------------------------- get_cases() memo
+
+
+def test_get_cases_returns_equal_but_independent_arrays():
+    a, b = get_cases(), get_cases()
+    assert len(a) == len(b)
+    for sa, sb in zip(a, b):
+        np.testing.assert_array_equal(sa.weights, sb.weights)
+        np.testing.assert_array_equal(sa.stakes, sb.stakes)
+        assert sa.weights is not sb.weights
+        assert sa.stakes is not sb.stakes
+    # mutating one call's arrays must not leak into the next call
+    a[0].weights[:] = -1.0
+    c = get_cases()
+    np.testing.assert_array_equal(c[0].weights, b[0].weights)
+
+
+def test_get_cases_materializes_each_builder_once():
+    calls = {"n": 0}
+
+    @register_case("_memo_probe")
+    def _probe(num_epochs: int = 4, **kw):
+        calls["n"] += 1
+        return _scenario()
+
+    try:
+        first = get_cases()
+        second = get_cases()
+        assert calls["n"] == 1  # builder ran once across both calls
+        assert first[-1].name == second[-1].name == "contract"
+    finally:
+        class_registry.pop("_memo_probe", None)
+    # registry changed again: the cache key rotates and rebuilds
+    rebuilt = get_cases()
+    assert all(s.name != "contract" for s in rebuilt)
+
+
+def test_get_cases_invalidates_on_rebind_of_existing_name():
+    """Re-registering an EXISTING case name under a new builder must
+    rotate the cache (the key covers builders, not just names)."""
+    get_cases()  # warm the cache
+    original = class_registry["Case 1"]
+    try:
+
+        @register_case("Case 1")
+        def _override(num_epochs: int = 4, **kw):
+            s = _scenario()
+            s.name = "overridden"
+            return s
+
+        assert get_cases()[0].name == "overridden"
+    finally:
+        class_registry["Case 1"] = original
+    assert get_cases()[0].name != "overridden"

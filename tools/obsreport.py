@@ -165,7 +165,36 @@ def render(bundle, run_id: str | None) -> str:
     if numerics:
         lines.append("")
         lines.extend(numerics)
+    foundry = render_foundry(bundle, target)
+    if foundry:
+        lines.append("")
+        lines.extend(foundry)
     return "\n".join(lines)
+
+
+def render_foundry(bundle, run_id: str) -> list[str]:
+    """The scenario-foundry section: how much of the bundle's workload
+    was GENERATED rather than hand-written — the `scenarios_generated`
+    counter of the last metrics snapshot (process-lifetime). The
+    per-scenario provenance records (`event=scenario_compiled`,
+    `event=metagraph_loaded`) ride the LOG stream, not the bundle
+    ledger — `grep event=` the process log for them."""
+    del run_id  # the counter is process-scoped, not per-run
+    generated = 0
+    if bundle.metrics:
+        generated = (
+            bundle.metrics[-1]
+            .get("counters", {})
+            .get("scenarios_generated", 0)
+        )
+    if not generated:
+        return []
+    return [
+        "scenario foundry (generated workload):",
+        f"  scenarios_generated={_num(generated)} (process total; "
+        "per-scenario provenance rides event=scenario_compiled / "
+        "event=metagraph_loaded log records)",
+    ]
 
 
 def render_numerics(bundle) -> list[str]:

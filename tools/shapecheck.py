@@ -75,6 +75,7 @@ GRID_WORKLOADS = (
     (9, 129, 5, 3),     # crosses both tile boundaries -> (16, 256)
     (64, 256, 7, 2),    # mid-size sweep shape
     (256, 1024, 3, 1),  # bench flagship class
+    (256, 4096, 3, 1),  # metagraph flagship (foundry real-subnet shape)
 )
 
 #: Variant specs the contracts run under: the plain EMA baseline, the

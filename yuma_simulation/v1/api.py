@@ -14,10 +14,16 @@ from yuma_simulation_tpu.v1.api import (  # noqa: F401
     YumaConfig,
     YumaParams,
     YumaSimulationNames,
+    cartel_scenario,
+    compile_spec,
     generate_chart_table,
     generate_total_dividends_table,
+    load_metagraph_snapshot,
     run_simulation,
     serve,
+    stake_churn_scenario,
+    takeover_scenario,
+    weight_copier_scenario,
 )
 
 __all__ = [
@@ -28,8 +34,14 @@ __all__ = [
     "YumaConfig",
     "YumaParams",
     "YumaSimulationNames",
+    "cartel_scenario",
+    "compile_spec",
     "generate_chart_table",
     "generate_total_dividends_table",
+    "load_metagraph_snapshot",
     "run_simulation",
     "serve",
+    "stake_churn_scenario",
+    "takeover_scenario",
+    "weight_copier_scenario",
 ]

@@ -1,0 +1,82 @@
+"""The scenario foundry: generated workloads for every platform tier.
+
+Three pillars, all compiling down to the ONE dense `Scenario`
+representation (`weights[E, V, M]` / `stakes[E, V]`) the planner,
+donor packing, numerics capture, and every engine rung already consume:
+
+- :mod:`.dsl` — the declarative scenario DSL: frozen, serializable
+  primitives (stake trajectories, weight schedules, epoch events)
+  combined by `sequence`/`overlay`/`at_epochs` into a `ScenarioSpec`,
+  compiled deterministically by `compile_spec` (built-in cases
+  re-expressed in it compile BITWISE equal to the hand-built arrays);
+- :mod:`.metagraph` — Bittensor metagraph snapshot ingestion (documented
+  JSON/npz schema, deterministic synthetic snapshots at real-subnet
+  shape V=256 x M=4096) so real subnets run through every Yuma variant;
+- :mod:`.adversarial` — weight-copying, collusion cartels, stake-churn
+  shocks and validator takeover as parameterized generated families,
+  each paired with property assertions on dividend outcomes;
+- :mod:`.montecarlo` — distributions over DSL/generator parameters as
+  batched suites feeding `simulate_batch`, `SweepSupervisor`,
+  `simulate_batch_sharded`, and the fleet drivers.
+
+``python -m yuma_simulation_tpu.foundry --drill --bundle-dir DIR`` runs
+a seeded generated-suite supervisor drill into a flight bundle (the CI
+scenario lane, gated by ``obsreport --check`` + ``driftreport --check``).
+"""
+
+from yuma_simulation_tpu.foundry.adversarial import (  # noqa: F401
+    CARTEL_INCENTIVE_FLOOR_PER_EPOCH,
+    LIQUID_ALPHA_VERSIONS,
+    AdversarialScenario,
+    cartel_miner_incentive,
+    cartel_scenario,
+    copier_dividend_gap,
+    liquid_config,
+    stake_churn_scenario,
+    takeover_scenario,
+    total_dividends,
+    weight_copier_scenario,
+)
+from yuma_simulation_tpu.foundry.dsl import (  # noqa: F401
+    BondReset,
+    Clause,
+    CopyWithLag,
+    NoisyConsensusFollower,
+    OneHot,
+    Rows,
+    ScenarioSpec,
+    SpecError,
+    StakeDrift,
+    Stakes,
+    Takeover,
+    at_epochs,
+    builtin_case_specs,
+    compile_spec,
+    overlay,
+    sequence,
+    spec_from_dict,
+    spec_from_json,
+    spec_key,
+    spec_to_dict,
+    spec_to_json,
+)
+from yuma_simulation_tpu.foundry.metagraph import (  # noqa: F401
+    MetagraphSnapshot,
+    SnapshotError,
+    load_metagraph_snapshot,
+    save_metagraph_snapshot,
+    scenario_from_snapshot,
+    synthetic_snapshot,
+)
+from yuma_simulation_tpu.foundry.montecarlo import (  # noqa: F401
+    Choice,
+    IntRange,
+    LogUniform,
+    Uniform,
+    derived_seed,
+    montecarlo_config_batch,
+    montecarlo_specs,
+    montecarlo_suite,
+    run_montecarlo,
+    sample_params,
+)

@@ -1,0 +1,260 @@
+"""Monte-Carlo bridge: parameter distributions -> batched suites.
+
+The fleet, serve, and sharded tiers consume *suites* — stacked
+`[B, E, V, M]` batches, supervised unit partitions, lease-claimed fleet
+grids. This module maps **distributions over DSL/generator parameters**
+onto those carriers, replacing "the 14 fixed cases" as the population
+the platform exercises:
+
+- :func:`sample_params` draws seeded parameter dicts from declarative
+  distributions (:class:`Uniform` / :class:`LogUniform` /
+  :class:`IntRange` / :class:`Choice`);
+- :func:`montecarlo_suite` feeds each draw (plus a per-draw derived
+  seed) to any spec/scenario builder — a DSL `ScenarioSpec` factory or
+  an adversarial family — and compiles the resulting population;
+- :func:`run_montecarlo` dispatches a suite down the chosen carrier:
+  the plain batched engine (`simulate_batch`), the supervised tier
+  (`SweepSupervisor.run_batch`), the sharded pod path
+  (`simulate_batch_sharded`), or the work-stealing fleet
+  (`run_fleet_batch`) — bitwise-identical dividends on every route
+  (the carriers' own contracts, exercised over *generated* populations
+  by tests/unit/test_foundry_montecarlo.py);
+- :func:`montecarlo_config_batch` is the hyperparameter twin: a seeded
+  sample over `YumaConfig` float fields as one batched config pytree
+  (+ its points list), the exact payload `run_fleet_grid(configs=...,
+  points=...)` and `SweepSupervisor.run_grid` share.
+
+Determinism contract: every draw derives from one integer seed via
+`np.random.default_rng`; hosts coordinate by exchanging the SEED (and
+the distribution spec), never the sample.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+import numpy as np
+
+from yuma_simulation_tpu.foundry.dsl import ScenarioSpec, compile_spec
+from yuma_simulation_tpu.scenarios.base import Scenario
+
+# ------------------------------------------------------------ distributions
+
+
+@dataclass(frozen=True)
+class Uniform:
+    lo: float
+    hi: float
+
+    def sample(self, rng: np.random.Generator):
+        return float(rng.uniform(self.lo, self.hi))
+
+
+@dataclass(frozen=True)
+class LogUniform:
+    lo: float
+    hi: float
+
+    def sample(self, rng: np.random.Generator):
+        return float(
+            np.exp(rng.uniform(np.log(self.lo), np.log(self.hi)))
+        )
+
+
+@dataclass(frozen=True)
+class IntRange:
+    lo: int
+    hi: int  # inclusive
+
+    def sample(self, rng: np.random.Generator):
+        return int(rng.integers(self.lo, self.hi + 1))
+
+
+@dataclass(frozen=True)
+class Choice:
+    values: tuple
+
+    def sample(self, rng: np.random.Generator):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+
+def sample_params(
+    distributions: dict, num_samples: int, seed: int
+) -> list[dict]:
+    """`num_samples` seeded draws from `{param: distribution}` (a plain
+    value is treated as a constant). Deterministic in (distributions,
+    num_samples, seed); draw i of a longer run equals draw i of a
+    shorter one (one child RNG per draw, spawned in order)."""
+    out = []
+    for i in range(num_samples):
+        rng = np.random.default_rng((seed, i))
+        point = {}
+        for name in sorted(distributions):
+            dist = distributions[name]
+            point[name] = (
+                dist.sample(rng) if hasattr(dist, "sample") else dist
+            )
+        out.append(point)
+    return out
+
+
+def derived_seed(seed: int, index: int) -> int:
+    """The per-draw integer seed handed to scenario builders — stable,
+    collision-resistant (SeedSequence-hashed), exchangeable between
+    hosts as plain ints."""
+    return int(np.random.SeedSequence([seed, index]).generate_state(1)[0])
+
+
+# ------------------------------------------------------------ suite builders
+
+
+def montecarlo_specs(
+    builder: Callable[..., ScenarioSpec],
+    distributions: dict,
+    num_samples: int,
+    seed: int,
+) -> tuple[list[ScenarioSpec], list[dict]]:
+    """Sample `builder(seed=<derived>, **params)` spec draws. The
+    builder is any callable returning a :class:`ScenarioSpec`."""
+    points = sample_params(distributions, num_samples, seed)
+    specs = [
+        builder(seed=derived_seed(seed, i), **point)
+        for i, point in enumerate(points)
+    ]
+    return specs, points
+
+
+def montecarlo_suite(
+    builder: Callable,
+    distributions: dict,
+    num_samples: int,
+    seed: int,
+) -> tuple[list[Scenario], list[dict]]:
+    """Sample and MATERIALIZE a scenario population. `builder` may
+    return a `ScenarioSpec` (compiled here), a `Scenario`, or an
+    :class:`~.adversarial.AdversarialScenario` (unwrapped)."""
+    points = sample_params(distributions, num_samples, seed)
+    scenarios: list[Scenario] = []
+    for i, point in enumerate(points):
+        built = builder(seed=derived_seed(seed, i), **point)
+        if isinstance(built, ScenarioSpec):
+            scenarios.append(compile_spec(built))
+        elif isinstance(built, Scenario):
+            scenarios.append(built.validate())
+        elif hasattr(built, "scenario"):
+            scenarios.append(built.scenario)
+        else:
+            raise TypeError(
+                "montecarlo builder must return a ScenarioSpec, "
+                f"Scenario, or AdversarialScenario, got {type(built)!r}"
+            )
+    return scenarios, points
+
+
+def montecarlo_config_batch(
+    distributions: dict, num_samples: int, seed: int, **base
+):
+    """A seeded Monte-Carlo sample over `YumaConfig` FLOAT fields as one
+    batched config pytree + its points list — the `run_fleet_grid(
+    configs=..., points=...)` / `SweepSupervisor.run_grid` payload
+    (config_grid's cartesian twin, with distributions for axes).
+    Static fields (`liquid_alpha`, overrides) cannot be sampled — they
+    select different compiled programs; set them via `base`
+    (`simulation=` / `yuma_params=`)."""
+    from yuma_simulation_tpu.simulation.sweep import build_config_batch
+
+    base_simulation = base.pop("simulation", None)
+    base_params = base.pop("yuma_params", None)
+    if base:
+        raise ValueError(f"unknown base config fields: {sorted(base)}")
+    points = sample_params(distributions, num_samples, seed)
+    # build_config_batch owns the static-field exclusion and the f32
+    # leaf stacking — one source of truth with config_grid.
+    return build_config_batch(points, base_simulation, base_params), points
+
+
+# ------------------------------------------------------------------ carriers
+
+
+def run_montecarlo(
+    scenarios: Sequence[Scenario],
+    yuma_version: str,
+    config=None,
+    *,
+    route: str = "batch",
+    mesh=None,
+    fleet=None,
+    supervisor=None,
+    pack: bool = False,
+) -> dict:
+    """Dispatch a generated suite down one platform carrier.
+
+    `route`:
+      - ``"batch"`` — one batched engine dispatch (`simulate_batch`;
+        same-shaped suites stack, heterogeneous suites donor-pack);
+      - ``"supervised"`` — the full single-host resilience tier
+        (:meth:`..resilience.supervisor.SweepSupervisor.run_batch`);
+      - ``"sharded"`` — the pod path
+        (:func:`..parallel.sharded.simulate_batch_sharded`; needs
+        `mesh`);
+      - ``"fleet"`` — this process's share of a work-stealing fleet
+        (:func:`..fabric.scheduler.run_fleet_batch`; needs `fleet`, a
+        store dir or FleetConfig).
+
+    Returns the carrier's own dict with `"dividends"` always present.
+    Bitwise contract: per-lane dividends are identical on every route
+    (each carrier's existing bitwise guarantee, now quantified over
+    generated populations)."""
+    scenarios = list(scenarios)
+    if route == "batch":
+        from yuma_simulation_tpu.models.config import YumaConfig
+        from yuma_simulation_tpu.models.variants import variant_for_version
+        from yuma_simulation_tpu.simulation.sweep import (
+            pack_scenarios,
+            simulate_batch,
+            stack_scenarios,
+        )
+
+        config = config if config is not None else YumaConfig()
+        spec = variant_for_version(yuma_version)
+        same_shape = len({s.weights.shape for s in scenarios}) == 1
+        if same_shape and not pack:
+            W, S, ri, re = stack_scenarios(scenarios)
+            ys = simulate_batch(W, S, ri, re, config, spec)
+        else:
+            W, S, ri, re, mask = pack_scenarios(scenarios)
+            ys = simulate_batch(
+                W, S, ri, re, config, spec, miner_mask=mask
+            )
+        return {"dividends": np.asarray(ys["dividends"])}
+    if route == "supervised":
+        from yuma_simulation_tpu.resilience.supervisor import SweepSupervisor
+
+        sup = supervisor if supervisor is not None else SweepSupervisor(
+            directory=None
+        )
+        return sup.run_batch(scenarios, yuma_version, config, pack=pack)
+    if route == "sharded":
+        if mesh is None:
+            raise ValueError("route='sharded' needs mesh=")
+        from yuma_simulation_tpu.parallel.sharded import (
+            simulate_batch_sharded,
+        )
+
+        return simulate_batch_sharded(
+            scenarios, yuma_version, config, mesh=mesh
+        )
+    if route == "fleet":
+        if fleet is None:
+            raise ValueError("route='fleet' needs fleet= (a store dir)")
+        from yuma_simulation_tpu.fabric.scheduler import run_fleet_batch
+
+        return run_fleet_batch(
+            scenarios, yuma_version, fleet, config=config,
+            supervisor=supervisor,
+        )
+    raise ValueError(
+        f"unknown route {route!r} "
+        "(want batch | supervised | sharded | fleet)"
+    )

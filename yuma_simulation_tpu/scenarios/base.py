@@ -12,7 +12,7 @@ mirrors the reference's string-keyed factory API (cases.py:6-48).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Callable, Optional
 
 import numpy as np
@@ -38,9 +38,44 @@ def create_case(case_name: str, **kwargs) -> "Scenario":
     return class_registry[case_name](**kwargs)
 
 
+#: Materialized builtin-case cache keyed by the registry's
+#: (name, builder) pairs: re-registering a name under a NEW builder (or
+#: registering a new case) invalidates it, a plain repeat call reuses
+#: the built arrays. `get_cases` COPIES on return.
+_CASES_CACHE: dict = {}
+
+
 def get_cases() -> list["Scenario"]:
-    """All registered cases, in registration order (cases.py:601)."""
-    return [builder() for builder in class_registry.values()]
+    """All registered cases, in registration order (cases.py:601).
+
+    Before 0.16.0 every call re-invoked every registered builder — an
+    O(cases) array-construction bill per call that callers paid dozens
+    of times per process (the chart suite, every drill, every test
+    module importing `scenarios.cases`). The materialized suite is now
+    memoized per registry state, and each call returns equal-but-
+    INDEPENDENT scenarios (fresh array copies), so a caller mutating
+    its suite — padding in place, fault injection — cannot poison the
+    cache for the next caller."""
+    key = tuple(class_registry.items())
+    if key not in _CASES_CACHE:
+        _CASES_CACHE.clear()
+        _CASES_CACHE[key] = [
+            builder() for builder in class_registry.values()
+        ]
+    return [
+        replace(
+            s,
+            weights=s.weights.copy(),
+            stakes=s.stakes.copy(),
+            validators=list(s.validators),
+            servers=list(s.servers),
+        )
+        for s in _CASES_CACHE[key]
+    ]
+
+
+class ScenarioValidationError(ValueError):
+    """A scenario whose arrays violate the foundry's input contract."""
 
 
 @dataclass
@@ -84,6 +119,67 @@ class Scenario:
     @property
     def num_miners(self) -> int:
         return self.weights.shape[2]
+
+    def validate(
+        self,
+        *,
+        normalized: bool = False,
+        normalization_tol: float = 1e-3,
+    ) -> "Scenario":
+        """The foundry input contract: every generated scenario passes
+        through here before it can reach an engine (compile_spec,
+        snapshot ingestion, the adversarial builders), so a generator
+        bug surfaces as a typed :class:`ScenarioValidationError` with
+        provenance instead of a NaN-poisoned batch reduction three
+        layers down.
+
+        Checks: weights finite and non-negative; stakes finite and
+        non-negative; at least one epoch with positive total stake.
+        `normalized=True` additionally requires every non-zero weight
+        row to sum to 1 within `normalization_tol` (DSL outputs are
+        row-normalized by construction; raw chain snapshots normalize
+        during ingestion). Returns self for fluent use."""
+        W, S = self.weights, self.stakes
+        if not np.isfinite(W).all():
+            bad = np.argwhere(~np.isfinite(W))[0]
+            raise ScenarioValidationError(
+                f"scenario {self.name!r}: non-finite weight at "
+                f"(epoch, validator, miner)={tuple(int(i) for i in bad)}"
+            )
+        if (W < 0).any():
+            bad = np.argwhere(W < 0)[0]
+            raise ScenarioValidationError(
+                f"scenario {self.name!r}: negative weight at "
+                f"(epoch, validator, miner)={tuple(int(i) for i in bad)}"
+            )
+        if not np.isfinite(S).all():
+            bad = np.argwhere(~np.isfinite(S))[0]
+            raise ScenarioValidationError(
+                f"scenario {self.name!r}: non-finite stake at "
+                f"(epoch, validator)={tuple(int(i) for i in bad)}"
+            )
+        if (S < 0).any():
+            bad = np.argwhere(S < 0)[0]
+            raise ScenarioValidationError(
+                f"scenario {self.name!r}: negative stake at "
+                f"(epoch, validator)={tuple(int(i) for i in bad)}"
+            )
+        if not (S.sum(axis=1) > 0).any():
+            raise ScenarioValidationError(
+                f"scenario {self.name!r}: zero total stake in every epoch"
+            )
+        if normalized:
+            row_sums = W.sum(axis=2)
+            off = np.abs(row_sums - 1.0) > normalization_tol
+            bad_rows = off & (row_sums != 0.0)
+            if bad_rows.any():
+                e, v = (int(i) for i in np.argwhere(bad_rows)[0])
+                raise ScenarioValidationError(
+                    f"scenario {self.name!r}: weight row (epoch {e}, "
+                    f"validator {v}) sums to {float(row_sums[e, v]):.6g}, "
+                    f"not 1 within {normalization_tol}"
+                )
+        return self
 
     # --- reference-compatible list-of-tensors views (cases.py:27-35) ---
     @property

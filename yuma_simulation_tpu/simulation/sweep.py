@@ -471,33 +471,46 @@ def sweep_scaled_fused(
     )
 
 
-def config_grid(
-    base_simulation: Optional[SimulationHyperparameters] = None,
-    base_params: Optional[YumaParams] = None,
-    **axes: Sequence[float],
-) -> tuple[YumaConfig, list[dict]]:
-    """Build a batched `YumaConfig` from a cartesian hyperparameter grid.
-
-    `axes` maps flattened field names (e.g. `kappa`, `bond_alpha`,
-    `bond_penalty`) to value lists. Returns the batched config (float
-    leaves stacked over the grid's flat order) and the list of grid-point
-    dicts in the same order. Static fields (`liquid_alpha`, overrides)
-    cannot be swept this way — they select different compiled programs.
-    """
-    base_simulation = base_simulation or SimulationHyperparameters()
-    base_params = base_params or YumaParams()
+def sweepable_config_fields(
+    base_simulation: SimulationHyperparameters,
+    base_params: YumaParams,
+) -> tuple[set, set]:
+    """The (simulation, yuma_params) field names a batched config may
+    vary: floats only. Static fields (`consensus_precision`,
+    `liquid_alpha`, the quantile overrides) select different compiled
+    programs and are excluded — ONE exclusion list, shared by the
+    cartesian `config_grid` and the foundry's Monte-Carlo sampler."""
     sim_fields = {f for f in vars(base_simulation) if f != "consensus_precision"}
     par_fields = {
         f
         for f in vars(base_params)
         if f not in ("liquid_alpha", "override_consensus_high", "override_consensus_low")
     }
-    for name in axes:
-        if name not in sim_fields and name not in par_fields:
-            raise ValueError(f"cannot sweep non-float/static field '{name}'")
+    return sim_fields, par_fields
 
-    names = list(axes)
-    points = [dict(zip(names, combo)) for combo in itertools.product(*axes.values())]
+
+def build_config_batch(
+    points: Sequence[dict],
+    base_simulation: Optional[SimulationHyperparameters] = None,
+    base_params: Optional[YumaParams] = None,
+) -> YumaConfig:
+    """Stack per-point float-field overrides into ONE batched
+    `YumaConfig` pytree (leaves `[len(points)]` f32). Rejects static/
+    unknown field names. The shared back half of :func:`config_grid`
+    and `foundry.montecarlo.montecarlo_config_batch`."""
+    if not points:
+        raise ValueError("config batch needs at least one point")
+    base_simulation = base_simulation or SimulationHyperparameters()
+    base_params = base_params or YumaParams()
+    sim_fields, par_fields = sweepable_config_fields(
+        base_simulation, base_params
+    )
+    for point in points:
+        for name in point:
+            if name not in sim_fields and name not in par_fields:
+                raise ValueError(
+                    f"cannot sweep non-float/static field '{name}'"
+                )
 
     def build(point: dict) -> YumaConfig:
         sim = replace(
@@ -513,9 +526,27 @@ def config_grid(
     # of Python floats would produce f64 leaves, which poison the f32
     # engine carries via dtype promotion (framework arrays stay f32 —
     # DESIGN.md "Precision policy").
-    batched = jax.tree.map(
+    return jax.tree.map(
         lambda *leaves: jnp.asarray(np.asarray(leaves, np.float32)), *configs
     )
+
+
+def config_grid(
+    base_simulation: Optional[SimulationHyperparameters] = None,
+    base_params: Optional[YumaParams] = None,
+    **axes: Sequence[float],
+) -> tuple[YumaConfig, list[dict]]:
+    """Build a batched `YumaConfig` from a cartesian hyperparameter grid.
+
+    `axes` maps flattened field names (e.g. `kappa`, `bond_alpha`,
+    `bond_penalty`) to value lists. Returns the batched config (float
+    leaves stacked over the grid's flat order) and the list of grid-point
+    dicts in the same order. Static fields (`liquid_alpha`, overrides)
+    cannot be swept this way — they select different compiled programs.
+    """
+    names = list(axes)
+    points = [dict(zip(names, combo)) for combo in itertools.product(*axes.values())]
+    batched = build_config_batch(points, base_simulation, base_params)
     return batched, points
 
 

@@ -258,6 +258,18 @@ EVENTS = {
         "burn-rate alert recovered to ok",
         consumers=("serve.service",),
     ),
+    # -- scenario foundry ------------------------------------------------
+    "scenario_compiled": EventSpec(
+        "one foundry ScenarioSpec materialized to dense Scenario arrays",
+        operator_reason="DEBUG-level log-stream provenance per generated "
+        "scenario; the scenarios_generated counter (obsreport-rendered) "
+        "is the machine-readable process-lifetime aggregate",
+    ),
+    "metagraph_loaded": EventSpec(
+        "one metagraph snapshot file ingested (netuid/block/shape)",
+        operator_reason="ingestion audit trail on the log stream: which "
+        "snapshot file fed which generated suite (grep event=)",
+    ),
 }
 
 
@@ -354,6 +366,12 @@ METRICS = {
     ),
     "serve_canary_drift": MetricSpec(
         "counter", "serve canary comparisons that confirmed drift",
+    ),
+    # -- scenario foundry ------------------------------------------------
+    "scenarios_generated": MetricSpec(
+        "counter", "foundry-generated scenarios (DSL compiles + "
+        "metagraph ingestions + adversarial builds)",
+        consumers=("obsreport",),
     ),
     # -- SLO engine ------------------------------------------------------
     "slo_alerts_total": MetricSpec(

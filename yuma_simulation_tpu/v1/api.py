@@ -39,6 +39,14 @@ from yuma_simulation_tpu.reporting.tables import (
 from yuma_simulation_tpu.reporting.tables import (  # noqa: F401  (promoted)
     generate_total_dividends_table,
 )
+from yuma_simulation_tpu.foundry import (  # noqa: F401  (promoted, 0.16.0)
+    cartel_scenario,
+    compile_spec,
+    load_metagraph_snapshot,
+    stake_churn_scenario,
+    takeover_scenario,
+    weight_copier_scenario,
+)
 from yuma_simulation_tpu.scenarios.base import Scenario
 from yuma_simulation_tpu.serve.server import (  # noqa: F401  (promoted)
     SimulationClient,
@@ -52,7 +60,9 @@ from yuma_simulation_tpu.simulation.sweep import (
 #: The frozen ApiVer surface (reference README.md:15-18): exactly these
 #: names are public; everything else in this module is an implementation
 #: detail that may change without notice. 0.12.0 grows it ADDITIVELY
-#: with the serving tier's entry point + client (MIGRATION.md).
+#: with the serving tier's entry point + client; 0.16.0 with the
+#: scenario foundry — the DSL compiler, metagraph snapshot ingestion,
+#: and the four adversarial family builders (MIGRATION.md).
 __all__ = [
     "HTML",
     "Scenario",
@@ -61,10 +71,16 @@ __all__ = [
     "YumaConfig",
     "YumaParams",
     "YumaSimulationNames",
+    "cartel_scenario",
+    "compile_spec",
     "generate_chart_table",
     "generate_total_dividends_table",
+    "load_metagraph_snapshot",
     "run_simulation",
     "serve",
+    "stake_churn_scenario",
+    "takeover_scenario",
+    "weight_copier_scenario",
 ]
 
 
