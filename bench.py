@@ -204,6 +204,110 @@ def _true_weights_reps(
 #: bitwise commit-to-commit comparable, which is what perfgate diffs.
 COST_EPOCHS = 512
 
+#: Shape for the cold-start drill: small enough that the CI lane's two
+#: subprocesses stay cheap, real enough that the engine path (planner +
+#: XLA scan + AOT cache seam) is the production one. FIXED so the
+#: cold/warm pair stays commit-to-commit comparable.
+COLD_START_SHAPE = (64, 32, 64)  # (epochs, V, M)
+
+#: The fresh-subprocess driver for the cold-start metric: process start
+#: (well, interpreter entry — the closest portable anchor) to the first
+#: completed engine dispatch, with the executable cache joined via the
+#: environment. Run twice against ONE cache directory, the pair is the
+#: metric: run 1 is the true cold start, run 2 the cache-warm start the
+#: autoscaler drill cares about.
+_COLD_START_CHILD = r"""
+import time
+_t0 = time.perf_counter()
+import json
+import os
+
+import numpy as np
+
+from yuma_simulation_tpu.scenarios.base import Scenario
+from yuma_simulation_tpu.simulation.engine import simulate
+
+E, V, M = (int(d) for d in os.environ["YUMA_COLD_SHAPE"].split("x"))
+validators = [f"v{i}" for i in range(V)]
+scenario = Scenario(
+    name="cold_start",
+    validators=validators,
+    base_validator=validators[0],
+    weights=np.zeros((E, V, M), np.float32),
+    stakes=np.ones((E, V), np.float32),
+    num_epochs=E,
+)
+simulate(scenario, "Yuma 1 (paper)")
+_t1 = time.perf_counter()
+from yuma_simulation_tpu.simulation.aot import process_stats
+
+print(json.dumps({"seconds": _t1 - _t0, "aot": process_stats().to_json()}))
+"""
+
+
+def _measure_cold_start() -> dict:
+    """The `cold_start` history object: first-dispatch wall seconds of a
+    fresh subprocess, cold (empty cache) vs cache-warm (second run over
+    the same cache dir), plus run 2's AOT stats so the gate can assert
+    the warm start actually hit the cache. A failed child yields an
+    explicit error object — the perfgate structural gate then fails the
+    record rather than silently shipping a history without the metric.
+
+    Deliberately NOT skipped under --smoke: the structural gate demands
+    the pair on every gated record, and at the fixed small
+    :data:`COLD_START_SHAPE` the drill costs two seconds-scale
+    subprocesses (``--skip-cold-start`` exists for local loops)."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    shape = "x".join(str(d) for d in COLD_START_SHAPE)
+    runs = []
+    with tempfile.TemporaryDirectory(prefix="yuma-coldstart-") as cache:
+        env = dict(
+            os.environ,
+            YUMA_TPU_EXECUTABLE_CACHE=cache,
+            YUMA_COLD_SHAPE=shape,
+        )
+        for _ in range(2):
+            # EVERY child failure mode — nonzero exit, hang past the
+            # timeout, empty or non-JSON stdout — must come back as the
+            # error object, never a raise: the contract is that bench
+            # always appends a record and perfgate's structural gate is
+            # what fails it.
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", _COLD_START_CHILD],
+                    capture_output=True,
+                    text=True,
+                    env=env,
+                    timeout=600,
+                )
+            except subprocess.TimeoutExpired:
+                return {"shape": shape, "error": "child timed out (600s)"}
+            if proc.returncode != 0:
+                return {
+                    "shape": shape,
+                    "error": (proc.stderr or "no stderr")[-500:],
+                }
+            try:
+                runs.append(json.loads(proc.stdout.splitlines()[-1]))
+            except (IndexError, ValueError):
+                return {
+                    "shape": shape,
+                    "error": (
+                        "child emitted no JSON line (stdout: "
+                        f"{proc.stdout[-200:]!r})"
+                    ),
+                }
+    return {
+        "shape": shape,
+        "first_dispatch_seconds_cold": round(runs[0]["seconds"], 3),
+        "first_dispatch_seconds_warm": round(runs[1]["seconds"], 3),
+        "warm_aot": runs[1]["aot"],
+    }
+
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
@@ -230,6 +334,13 @@ def main(argv=None) -> None:
         help="skip the AOT cost capture (it compiles each rung once); "
         "note the perfgate structural gate fails a cost-less record by "
         "design",
+    )
+    parser.add_argument(
+        "--skip-cold-start",
+        action="store_true",
+        help="skip the fresh-subprocess cold-start measurement (two "
+        "python startups); like --skip-costs, the structural gate "
+        "fails a record without it by design",
     )
     args = parser.parse_args(argv)
     if args.smoke:
@@ -539,9 +650,15 @@ def _bench(args) -> None:
     print(json.dumps(line))
 
     if not args.no_history:
+        # The cold-start drill (ROADMAP item 1): fresh-subprocess first-
+        # dispatch seconds, cold vs cache-warm over one executable-cache
+        # dir — the number the autoscaler drill budgets against.
+        cold_start = (
+            {} if args.skip_cold_start else _measure_cold_start()
+        )
         _append_history(line, primary_impl, primary, smoke=args.smoke,
                         skip_costs=args.skip_costs, history=args.history,
-                        numerics=numerics_overhead)
+                        numerics=numerics_overhead, cold_start=cold_start)
 
 
 def _append_history(
@@ -553,6 +670,7 @@ def _append_history(
     skip_costs: bool,
     history: str,
     numerics: Optional[dict] = None,
+    cold_start: Optional[dict] = None,
 ) -> dict:
     """One richer record per run into the JSONL history perfgate gates
     on: the stdout fields + per-metric dispersion + the AOT cost report
@@ -603,6 +721,9 @@ def _append_history(
         # Numerics-capture overhead (in-scan sketch capture on vs off
         # over the same workload) — a tracked, perfgate-gated metric.
         "numerics": numerics if numerics is not None else {},
+        # Cold-start first-dispatch seconds (fresh subprocess, cold vs
+        # cache-warm) — a tracked, perfgate-gated metric (ISSUE 13).
+        "cold_start": cold_start if cold_start is not None else {},
         # Declared floors for perfgate's attained-fraction gate: the
         # distance-to-ceiling itself is gated, not just absolute rates.
         "attained_floor": dict(ATTAINED_FLOORS),

@@ -1,0 +1,489 @@
+"""AOT executable cache (ISSUE 13): bitwise parity, cache keying,
+corruption/staleness taxonomy, concurrent publish, and the sentinel's
+cache-hit-vs-true-compile distinction.
+
+The acceptance bars pinned here:
+
+- an AOT-dispatched result is BITWISE equal to the JIT path for every
+  engine rung the planner resolves on this backend, across the planner
+  bucket grid (off-TPU the grid resolves to the XLA rung; the fused
+  rungs ride the same seam and are covered by the TPU parity tooling);
+- a cache-warm "second process" (fresh memo + fresh cache handle over
+  the same directory) performs ZERO builds — loads only — and a
+  budget-0 RecompilationSentinel region accepts it;
+- a corrupted/truncated artifact is a typed miss that requeues to JIT
+  (never a crash, never a wrong result), and a jaxlib-version bump is a
+  typed STALE miss;
+- concurrent writers racing the same artifact through publish_atomic
+  leave exactly one whole, loadable winner.
+"""
+
+import json
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.models.config import YumaConfig
+from yuma_simulation_tpu.models.variants import variant_for_version
+from yuma_simulation_tpu.scenarios import create_case
+from yuma_simulation_tpu.simulation import aot
+from yuma_simulation_tpu.simulation.engine import _simulate_scan, simulate
+from yuma_simulation_tpu.simulation.planner import plan_dispatch
+from yuma_simulation_tpu.simulation.sweep import (
+    _simulate_batch_xla,
+    simulate_batch,
+    stack_scenarios,
+)
+from yuma_simulation_tpu.utils.profiling import (
+    RecompilationBudgetExceeded,
+    RecompilationSentinel,
+)
+
+VERSION = "Yuma 1 (paper)"
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    """A fresh cache root with the process-global state isolated: the
+    env var cleared, and the active cache + memo dropped afterward so
+    the rest of the suite keeps the legacy always-JIT path."""
+    monkeypatch.delenv(aot.EXECUTABLE_CACHE_ENV, raising=False)
+    aot.deactivate_executable_cache()
+    yield tmp_path / "cache"
+    aot.deactivate_executable_cache()
+
+
+def _tree_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def _monolithic_args(E, V, M, seed=0):
+    rng = np.random.default_rng(seed)
+    W = jnp.asarray(rng.random((E, V, M)), jnp.float32)
+    S = jnp.asarray(rng.random((E, V)) + 0.01, jnp.float32)
+    ri = jnp.asarray(-1, jnp.int32)
+    re = jnp.asarray(-1, jnp.int32)
+    return W, S, ri, re
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity: AOT dispatch == JIT dispatch
+
+
+#: The planner bucket grid's small workloads ((V, M, E, B) — the
+#: tools/shapecheck.py spelling): the reference case shape, the exact
+#: one-tile shape, and a cross-tile-boundary batched shape. The large
+#: bench flagships are deliberately excluded — this is a per-push
+#: bitwise pin, not a compile-time benchmark.
+PARITY_WORKLOADS = (
+    (3, 2, 5, 1),
+    (8, 128, 1, 1),
+    (9, 129, 5, 3),
+)
+
+
+@pytest.mark.parametrize("V,M,E,B", PARITY_WORKLOADS)
+def test_aot_dispatch_bitwise_equals_jit_on_planner_grid(
+    V, M, E, B, cache_dir
+):
+    """For each planner-grid bucket: plan the dispatch, resolve the
+    plan's rung through attach_executable, and pin the executable's
+    output bitwise against the plain jitted engine at identical
+    statics. Off-TPU the planner resolves every bucket to the XLA rung;
+    the same seam carries the fused rungs on chip."""
+    cfg = YumaConfig()
+    spec = variant_for_version(VERSION)
+    shape = (B, E, V, M) if B > 1 else (E, V, M)
+    plan = plan_dispatch(
+        "aot_parity", shape, spec, cfg, jnp.float32, check_memory=False
+    )
+    cache = aot.configure_executable_cache(cache_dir)
+    planned = plan.attach_executable(VERSION, cache=cache)
+    assert planned.executable is not None
+    assert planned.executable.source == "built"
+    from yuma_simulation_tpu.telemetry.numerics import numerics_enabled
+
+    capture = numerics_enabled()
+    if B > 1:
+        rng = np.random.default_rng(1)
+        W = jnp.asarray(rng.random((B, E, V, M)), jnp.float32)
+        S = jnp.asarray(rng.random((B, E, V)) + 0.01, jnp.float32)
+        ri = jnp.full((B,), -1, jnp.int32)
+        re = jnp.full((B,), -1, jnp.int32)
+        direct = _simulate_batch_xla(
+            W, S, ri, re, cfg, spec,
+            save_bonds=False, save_incentives=False,
+            consensus_impl=plan.consensus_impl,
+            capture_numerics=capture, miner_mask=None,
+        )
+        via_aot = planned.executable.call(W, S, ri, re, cfg, miner_mask=None)
+    else:
+        W, S, ri, re = _monolithic_args(E, V, M, seed=1)
+        direct = _simulate_scan(
+            W, S, ri, re, cfg, spec=spec,
+            save_bonds=False, save_incentives=False, save_consensus=False,
+            consensus_impl=plan.consensus_impl, capture_numerics=capture,
+        )
+        via_aot = planned.executable.call(W, S, ri, re, cfg)
+    assert jax.tree.structure(direct) == jax.tree.structure(via_aot)
+    assert _tree_equal(direct, via_aot)
+
+
+def test_engine_results_identical_with_and_without_cache(cache_dir):
+    """The end-to-end pin: simulate() and simulate_batch() produce
+    bitwise-identical results with the cache off, cold, and warm."""
+    case = create_case("Case 2")
+    baseline = simulate(case, VERSION)
+    cases = [create_case("Case 1"), create_case("Case 2")]
+    W, S, ri, re = stack_scenarios(cases)
+    cfg = YumaConfig()
+    spec = variant_for_version(VERSION)
+    batch_baseline = simulate_batch(W, S, ri, re, cfg, spec)
+
+    cache = aot.configure_executable_cache(cache_dir)
+    cold = simulate(case, VERSION)
+    batch_cold = simulate_batch(W, S, ri, re, cfg, spec)
+    assert cache.stats.builds >= 2 and cache.stats.hits == 0
+    warm = simulate(case, VERSION)
+    batch_warm = simulate_batch(W, S, ri, re, cfg, spec)
+    for got in (cold, warm):
+        assert np.array_equal(baseline.dividends, got.dividends)
+        assert np.array_equal(baseline.bonds, got.bonds)
+        assert np.array_equal(baseline.incentives, got.incentives)
+    assert _tree_equal(batch_baseline, batch_cold)
+    assert _tree_equal(batch_baseline, batch_warm)
+
+
+# ---------------------------------------------------------------------------
+# the cache-warm second process: loads, zero builds, sentinel-clean
+
+
+def test_second_process_loads_with_zero_builds(cache_dir):
+    case = create_case("Case 3")
+    aot.configure_executable_cache(cache_dir)
+    first = simulate(case, VERSION)
+    # "Second process": fresh memo + fresh cache handle, same directory.
+    aot.deactivate_executable_cache()
+    cache2 = aot.configure_executable_cache(cache_dir)
+    with RecompilationSentinel(
+        _simulate_scan, budget=0, label="cache-warm second process"
+    ) as sentinel:
+        second = simulate(case, VERSION)
+    assert cache2.stats.hits == 1
+    assert cache2.stats.builds == 0 and cache2.stats.misses == 0
+    assert sentinel.new_entries == 0
+    assert sentinel.aot_hits == 1 and sentinel.aot_builds == 0
+    assert np.array_equal(first.dividends, second.dividends)
+
+
+def test_sentinel_counts_aot_build_as_true_compile(cache_dir):
+    """An AOT MISS that exports a program is a real compile: a budget-0
+    region must fail on it exactly as it fails on a tracked re-trace —
+    otherwise the executable cache would let cold compiles slip past
+    every zero-warm-compile pin."""
+    aot.configure_executable_cache(cache_dir)
+    case = create_case("Case 1")
+    with pytest.raises(RecompilationBudgetExceeded, match="aot builds"):
+        with RecompilationSentinel(
+            _simulate_scan, budget=0, label="cold aot region"
+        ):
+            simulate(case, VERSION)
+
+
+def test_cache_off_dispatch_seam_is_inert(cache_dir):
+    """Without an active cache the seam returns None and the legacy
+    path runs untouched — the default for the whole existing test
+    surface."""
+    assert aot.active_cache() is None
+    W, S, ri, re = _monolithic_args(4, 3, 2)
+    spec = variant_for_version(VERSION)
+    kwargs = dict(spec=spec, save_bonds=False, save_incentives=False)
+    out = aot.dispatch_via_cache(
+        _simulate_scan,
+        (W, S, ri, re, YumaConfig()),
+        kwargs,
+        static_names=tuple(kwargs),
+        label="inert",
+    )
+    assert out is None
+
+
+# ---------------------------------------------------------------------------
+# cache keying: corruption, staleness, concurrency
+
+
+def _entry_paths(cache):
+    blobs = sorted(cache.artifact_dir.glob("*/*.bin"))
+    metas = sorted(cache.artifact_dir.glob("*/*.json"))
+    return blobs, metas
+
+
+def test_corrupted_artifact_is_typed_miss_and_requeues_to_jit(
+    cache_dir, caplog
+):
+    case = create_case("Case 2")
+    aot.configure_executable_cache(cache_dir)
+    expected = simulate(case, VERSION)
+    blobs, _ = _entry_paths(aot.active_cache())
+    assert blobs
+    # Truncate every artifact: the digest check must reject the torn
+    # bytes BEFORE deserialization ever sees them.
+    for blob in blobs:
+        blob.write_bytes(blob.read_bytes()[: max(1, blob.stat().st_size // 3)])
+    aot.deactivate_executable_cache()
+    cache2 = aot.configure_executable_cache(cache_dir)
+    with caplog.at_level(
+        logging.INFO, logger="yuma_simulation_tpu.simulation.aot"
+    ):
+        result = simulate(case, VERSION)
+    assert np.array_equal(expected.dividends, result.dividends)
+    assert cache2.stats.hits == 0
+    assert cache2.stats.misses == 1 and cache2.stats.builds == 1
+    assert any(
+        "executable_cache_miss" in r.getMessage()
+        and "corrupt" in r.getMessage()
+        for r in caplog.records
+    )
+    # The rebuild republished a whole artifact: a third process loads.
+    aot.deactivate_executable_cache()
+    cache3 = aot.configure_executable_cache(cache_dir)
+    simulate(case, VERSION)
+    assert cache3.stats.hits == 1 and cache3.stats.builds == 0
+
+
+def test_missing_metadata_is_typed_miss(cache_dir):
+    case = create_case("Case 2")
+    aot.configure_executable_cache(cache_dir)
+    simulate(case, VERSION)
+    _, metas = _entry_paths(aot.active_cache())
+    for meta in metas:
+        meta.unlink()
+    aot.deactivate_executable_cache()
+    cache2 = aot.configure_executable_cache(cache_dir)
+    simulate(case, VERSION)
+    assert cache2.stats.misses == 1 and cache2.stats.builds == 1
+
+
+def test_jaxlib_version_bump_is_typed_stale_miss(
+    cache_dir, monkeypatch, caplog
+):
+    case = create_case("Case 3")
+    aot.configure_executable_cache(cache_dir)
+    expected = simulate(case, VERSION)
+    # Simulate the next deploy: same artifacts, bumped jaxlib.
+    real_env = aot.environment_descriptor()
+    monkeypatch.setattr(
+        aot,
+        "environment_descriptor",
+        lambda: {**real_env, "jaxlib": real_env["jaxlib"] + ".post99"},
+    )
+    aot.deactivate_executable_cache()
+    cache2 = aot.configure_executable_cache(cache_dir)
+    assert cache2.env_key != _entry_key_of(real_env)
+    with caplog.at_level(
+        logging.INFO, logger="yuma_simulation_tpu.simulation.aot"
+    ):
+        result = simulate(case, VERSION)
+    assert np.array_equal(expected.dividends, result.dividends)
+    assert cache2.stats.stale == 1 and cache2.stats.hits == 0
+    assert cache2.stats.builds == 1
+    assert any(
+        "executable_cache_stale" in r.getMessage() for r in caplog.records
+    )
+    # Both environments' artifacts now coexist under one fingerprint.
+    blobs, _ = _entry_paths(cache2)
+    fingerprints = {b.parent.name for b in blobs}
+    assert len(fingerprints) == 1 and len(blobs) == 2
+
+
+def _entry_key_of(env: dict) -> str:
+    return aot._environment_key(env)
+
+
+def test_concurrent_writers_race_safely_through_publish_atomic(cache_dir):
+    """N threads exporting and publishing the SAME program concurrently:
+    every publish lands whole (publish_atomic's writer-unique temp +
+    atomic rename), the final artifact loads, and its digest verifies."""
+    from jax import export as jax_export
+
+    aot.register_export_serialization()
+    cache = aot.ExecutableCache(cache_dir)
+    cache.artifact_dir.mkdir(parents=True, exist_ok=True)
+    spec = variant_for_version(VERSION)
+    W, S, ri, re = _monolithic_args(4, 3, 2)
+    kwargs = dict(spec=spec, save_bonds=False, save_incentives=False)
+    from yuma_simulation_tpu.telemetry.cost import hlo_fingerprint
+
+    lowered = _simulate_scan.lower(W, S, ri, re, YumaConfig(), **kwargs)
+    fingerprint = hlo_fingerprint(lowered, digits=None)
+    exported = jax_export.export(_simulate_scan)(
+        W, S, ri, re, YumaConfig(), **kwargs
+    )
+    errors: list = []
+
+    def publish():
+        try:
+            assert cache.store(fingerprint, exported, label="race")
+        except Exception as e:  # pragma: no cover - the failure surface
+            errors.append(e)
+
+    threads = [threading.Thread(target=publish) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    loaded = cache.load(fingerprint, label="race")
+    assert loaded is not None
+    assert cache.stats.hits == 1
+    out = jax.jit(loaded.call)(W, S, ri, re, YumaConfig())
+    direct = _simulate_scan(W, S, ri, re, YumaConfig(), **kwargs)
+    assert _tree_equal(direct, out)
+    # No stray temp files survived the race.
+    assert not list(cache.artifact_dir.glob("*/.*tmp"))
+
+
+# ---------------------------------------------------------------------------
+# plan surface + stats artifact
+
+
+def test_attach_executable_mirrors_attach_cost_contract(cache_dir):
+    cfg = YumaConfig()
+    case = create_case("Case 1")
+    plan = plan_dispatch(
+        "seam", np.shape(case.weights), VERSION, cfg, jnp.float32
+    )
+    cache = aot.configure_executable_cache(cache_dir)
+    attached = plan.attach_executable(VERSION, cache=cache)
+    assert attached.executable is not None
+    # The handle is metadata, not identity: plans still compare equal,
+    # JSON stays serializable with a describable stub.
+    assert attached == plan
+    payload = json.dumps(attached.to_json())
+    assert "fingerprint" in payload
+    # A second attach resolves from the in-process memo (same handle
+    # class, zero additional builds).
+    builds_before = cache.stats.builds
+    again = plan.attach_executable(VERSION, cache=cache)
+    assert again.executable is not None
+    assert cache.stats.builds == builds_before
+    # Re-anchoring drops the stale handle: a demoted plan must not
+    # carry the old rung's program.
+    if len(plan.ladder) > 1:
+        assert attached.demoted(plan.ladder[-1]).executable is None
+
+
+def test_process_stats_survive_cache_swap(cache_dir):
+    """Sentinel accounting: replacing the active cache mid-region must
+    not reset the process totals (a FleetHost/serve construction inside
+    a budget-0 pin would otherwise hide real builds behind a fresh
+    zeroed AotStats)."""
+    c1 = aot.configure_executable_cache(cache_dir / "a")
+    c1.stats.builds = 3
+    base = aot.process_stats().builds
+    c2 = aot.configure_executable_cache(cache_dir / "b")
+    c2.stats.builds = 2
+    assert aot.process_stats().builds == base + 2
+
+
+def test_bad_env_cache_path_degrades_to_no_cache(cache_dir, monkeypatch):
+    """A typo'd/unwritable YUMA_TPU_EXECUTABLE_CACHE must disable the
+    cache with one warning, never crash a dispatch — and must not retry
+    the failing configuration on every call."""
+    blocker = cache_dir.parent / "blocker"
+    blocker.write_text("not a directory")
+    monkeypatch.setenv(aot.EXECUTABLE_CACHE_ENV, str(blocker / "sub"))
+    monkeypatch.setattr(aot, "_ENV_FAILED", None)
+    assert aot.active_cache() is None
+    assert aot._ENV_FAILED == str(blocker / "sub")
+    assert aot.active_cache() is None  # remembered — no retry storm
+    # The seam stays inert, and a real dispatch still works.
+    result = simulate(create_case("Case 1"), VERSION)
+    assert np.isfinite(result.dividends).all()
+
+
+def test_write_stats_artifact_shape(cache_dir):
+    cache = aot.configure_executable_cache(cache_dir)
+    simulate(create_case("Case 1"), VERSION)
+    payload = cache.write_stats()
+    on_disk = json.loads((cache_dir / aot.STATS_FILENAME).read_text())
+    assert on_disk == payload
+    assert on_disk["builds"] >= 1 and on_disk["entries_on_disk"] >= 1
+    assert on_disk["environment"]["jax"]
+
+
+def test_preload_shapes_resolves_buckets(cache_dir):
+    aot.configure_executable_cache(cache_dir)
+    assert aot.preload_shapes([(6, 3, 2)], yuma_version=VERSION) == 1
+    # A second process preloading the same bucket loads, not builds.
+    aot.deactivate_executable_cache()
+    cache2 = aot.configure_executable_cache(cache_dir)
+    assert aot.preload_shapes([(6, 3, 2)], yuma_version=VERSION) == 1
+    assert cache2.stats.hits == 1 and cache2.stats.builds == 0
+
+
+def test_fleet_host_preload_before_first_claim(cache_dir, tmp_path):
+    """FleetHost.preload_executables: unit-shaped programs resolve
+    against the shared cache before any lease is claimed (here: the
+    mechanism; the lease-ordering is by construction — preload runs in
+    FleetHost construction order, run_units claims after)."""
+    from yuma_simulation_tpu.fabric.scheduler import FleetConfig, FleetHost
+
+    fleet = FleetConfig(
+        directory=tmp_path / "store",
+        host_id="host-a",
+        executable_cache_dir=str(cache_dir),
+    )
+    host = FleetHost(fleet)
+    assert aot.active_cache() is not None
+    assert host.preload_executables([(5, 3, 2)], VERSION, batch=2) == 1
+    assert aot.active_cache().stats.builds == 1
+    # The published artifact is the batched unit program: a second host
+    # on the same store loads it.
+    aot.deactivate_executable_cache()
+    host_b = FleetHost(
+        FleetConfig(
+            directory=tmp_path / "store",
+            host_id="host-b",
+            executable_cache_dir=str(cache_dir),
+        )
+    )
+    assert host_b.preload_executables([(5, 3, 2)], VERSION, batch=2) == 1
+    assert aot.active_cache().stats.hits == 1
+    assert aot.active_cache().stats.builds == 0
+
+
+def test_serve_warm_start_loads_from_cache(cache_dir):
+    """ServeConfig.executable_cache_dir: worker 1 warms up by building
+    + publishing; worker 2 (fresh memo, same directory) warms up from
+    loads alone — the serve-tier cold-start acceptance."""
+    from yuma_simulation_tpu.serve import ServeConfig, SimulationService
+
+    shape = (8, 3, 2)
+    svc = SimulationService(
+        ServeConfig(
+            warmup_shapes=(shape,),
+            executable_cache_dir=str(cache_dir),
+            start_dispatcher=False,
+        )
+    )
+    svc.close()
+    assert aot.active_cache().stats.builds >= 1
+    aot.deactivate_executable_cache()
+    svc2 = SimulationService(
+        ServeConfig(
+            warmup_shapes=(shape,),
+            executable_cache_dir=str(cache_dir),
+            start_dispatcher=False,
+        )
+    )
+    svc2.close()
+    stats = aot.active_cache().stats
+    assert stats.hits >= 1 and stats.builds == 0

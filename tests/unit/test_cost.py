@@ -335,6 +335,14 @@ def _history_record(value, cv=0.02, smoke=False, backend="cpu", t=0.0,
             "epochs_per_sec_on": value / 10 * 0.99,
             "overhead_frac": 0.01,
         },
+        # The 0.17.0 schema: fresh-subprocess cold-start seconds (cold
+        # vs executable-cache-warm) are first-class gated metrics.
+        "cold_start": {
+            "shape": "64x32x64",
+            "first_dispatch_seconds_cold": 6.0,
+            "first_dispatch_seconds_warm": 3.5,
+            "warm_aot": {"hits": 1, "misses": 0, "builds": 0},
+        },
     }
     record.update(overrides)
     return record
@@ -512,6 +520,57 @@ def test_perfgate_attained_fraction_rides_baseline_diff():
     history = [rec(0.5, t=i) for i in range(5)] + [rec(0.2, t=9)]
     verdict = compare(history)["verdicts"]["attained:xla"]
     assert verdict["status"] == "regression"
+
+
+def test_perfgate_cold_start_is_structural(tmp_path):
+    """ISSUE 13 satellite: the cold-start pair is schema — a record
+    that drops it, ships a non-numeric value, or carries the child's
+    error object is rot, exactly like a missing cost rung."""
+    from tools.perfgate import COLD_START_FIELDS, check_structure, main
+
+    sound = _history_record(100.0)
+    assert check_structure(sound) == []
+    for field in COLD_START_FIELDS:
+        record = _history_record(100.0)
+        del record["cold_start"][field]
+        assert any(field in p for p in check_structure(record)), field
+    missing = _history_record(100.0)
+    del missing["cold_start"]
+    assert any("cold_start" in p for p in check_structure(missing))
+    # A failed measurement ({} or an error object) is rot, with the
+    # child's error surfaced in the problem line.
+    skipped = _history_record(100.0, cold_start={})
+    assert any("cold_start" in p for p in check_structure(skipped))
+    errored = _history_record(
+        100.0, cold_start={"shape": "64x32x64", "error": "child died"}
+    )
+    problems = check_structure(errored)
+    assert any("child died" in p for p in problems)
+    path = _write_history(tmp_path, [errored])
+    assert main(["--history", path, "--check", "--structural"]) == 2
+
+
+def test_perfgate_cold_start_ceiling_gate(tmp_path, capsys):
+    """--cold-start-ceiling: the CACHE-WARM first dispatch is gated
+    against a declared wall-seconds budget — active in structural mode
+    (the pair is an in-record measurement), vacuous without the flag."""
+    from tools.perfgate import check_cold_start, main
+
+    record = _history_record(100.0)
+    assert check_cold_start(record) == []  # no ceiling declared
+    assert check_cold_start(record, ceiling=10.0) == []
+    failures = check_cold_start(record, ceiling=1.0)
+    assert len(failures) == 1 and "3.5" in failures[0]
+    path = _write_history(tmp_path, [record])
+    assert main(
+        ["--history", path, "--check", "--structural",
+         "--cold-start-ceiling", "10.0"]
+    ) == 0
+    assert main(
+        ["--history", path, "--check", "--structural",
+         "--cold-start-ceiling", "1.0"]
+    ) == 1
+    capsys.readouterr()
 
 
 def test_perfgate_report_artifact(tmp_path):

@@ -51,6 +51,17 @@ DEFAULT_NOISE_MULT = 3.0
 REQUIRED_FIELDS = (
     "t", "backend", "smoke", "metric", "value", "unit", "secondary",
     "cv", "costs", "rooflines", "attained_floor", "numerics",
+    "cold_start",
+)
+
+#: Fields the ``cold_start`` object must carry as numbers (0.17.0:
+#: fresh-subprocess first-dispatch seconds, cold vs executable-cache-
+#: warm — bench.py `_measure_cold_start`). A record without them is
+#: schema rot: the cold-start economics ROADMAP item 1 gates on cannot
+#: silently drop out of the history again.
+COLD_START_FIELDS = (
+    "first_dispatch_seconds_cold",
+    "first_dispatch_seconds_warm",
 )
 
 #: The numerics-capture overhead ceiling (ISSUE 10 acceptance: the
@@ -130,6 +141,28 @@ def check_structure(record: dict) -> list[str]:
                         )
                         + " — the numerics-capture overhead is a "
                         "first-class gated metric"
+                    )
+    cold = record.get("cold_start")
+    if "cold_start" in record:
+        if not isinstance(cold, dict):
+            problems.append("cold_start must be an object")
+        else:
+            for field in COLD_START_FIELDS:
+                if not isinstance(cold.get(field), (int, float)):
+                    problems.append(
+                        f"cold_start.{field} is "
+                        + (
+                            "missing"
+                            if cold.get(field) is None
+                            else f"invalid ({cold.get(field)!r})"
+                        )
+                        + " — cold-start wall time is a first-class "
+                        "gated metric"
+                        + (
+                            f" (measurement error: {cold['error']!r})"
+                            if "error" in cold
+                            else ""
+                        )
                     )
     costs = record.get("costs")
     if isinstance(costs, dict):
@@ -218,6 +251,34 @@ def check_attained(record: dict, floors: Optional[dict] = None) -> list[str]:
                 f"prediction, below the declared floor {floor:.3g}"
             )
     return failures
+
+
+def check_cold_start(
+    record: dict, ceiling: Optional[float] = None
+) -> list[str]:
+    """The cold-start gate: the CACHE-WARM fresh-subprocess first
+    dispatch must land under `ceiling` seconds (``--cold-start-ceiling``
+    — the ROADMAP item 1 bar is "well under a second" on top of
+    interpreter+jax import, so lanes declare their own budget). The
+    cold run is deliberately ungated here: it is machine- and
+    toolchain-priced; the rolling history keeps it for trend reading.
+    Vacuous without a ceiling or without the measurement — the
+    STRUCTURAL gate already fails a record that lacks it."""
+    if ceiling is None:
+        return []
+    cold = record.get("cold_start")
+    if not isinstance(cold, dict):
+        return []
+    warm = cold.get("first_dispatch_seconds_warm")
+    if not isinstance(warm, (int, float)):
+        return []
+    if warm > ceiling:
+        return [
+            f"cache-warm first dispatch took {warm:.3f}s, above the "
+            f"--cold-start-ceiling of {ceiling:.3f}s (cold run: "
+            f"{cold.get('first_dispatch_seconds_cold')}s)"
+        ]
+    return []
 
 
 def _numerics_noise(record: dict) -> float:
@@ -366,6 +427,13 @@ def main(argv=None) -> int:
         "fails --check — in structural mode too (the gate is vacuous "
         "where the fraction is null, e.g. every CPU build)",
     )
+    parser.add_argument(
+        "--cold-start-ceiling", type=float, default=None, metavar="SECONDS",
+        help="fail --check when the record's CACHE-WARM fresh-subprocess "
+        "first dispatch exceeds this many seconds (active in "
+        "--structural too: the cold_start pair is an in-record "
+        "measurement, no baseline needed)",
+    )
     parser.add_argument("--json", action="store_true")
     parser.add_argument(
         "--report", default=None,
@@ -391,12 +459,16 @@ def main(argv=None) -> int:
     problems = check_structure(latest)
     attained_failures = check_attained(latest, floor_overrides)
     numerics_failures = check_numerics_overhead(latest)
+    cold_start_failures = check_cold_start(
+        latest, args.cold_start_ceiling
+    )
     result: dict = {
         "history": args.history,
         "records": len(history),
         "structural_problems": problems,
         "attained_failures": attained_failures,
         "numerics_failures": numerics_failures,
+        "cold_start_failures": cold_start_failures,
     }
     if not args.structural:
         result.update(
@@ -437,6 +509,13 @@ def main(argv=None) -> int:
             print(f"perfgate: NUMERICS-OVERHEAD: {f}", file=sys.stderr)
         if args.check:
             return 1
+    if cold_start_failures:
+        # Also active in --structural: the cold/warm pair is one
+        # in-record measurement against a declared ceiling.
+        for f in cold_start_failures:
+            print(f"perfgate: COLD-START: {f}", file=sys.stderr)
+        if args.check:
+            return 1
     regressions = [
         k
         for k, v in result.get("verdicts", {}).items()
@@ -466,6 +545,20 @@ def _render(result: dict, latest: dict) -> None:
         print(f"  attained-fraction: {len(attained)} rung(s) below floor")
     elif latest.get("attained_floor"):
         print("  attained-fraction: within declared floors")
+    cold = latest.get("cold_start") or {}
+    if result.get("cold_start_failures"):
+        print(
+            f"  cold-start: ABOVE CEILING "
+            f"(warm {cold.get('first_dispatch_seconds_warm')}s)"
+        )
+    elif isinstance(
+        cold.get("first_dispatch_seconds_warm"), (int, float)
+    ):
+        print(
+            f"  cold-start: cold "
+            f"{cold.get('first_dispatch_seconds_cold')}s -> warm "
+            f"{cold.get('first_dispatch_seconds_warm')}s"
+        )
     numerics = result.get("numerics_failures", [])
     overhead = (latest.get("numerics") or {}).get("overhead_frac")
     if numerics:

@@ -95,6 +95,14 @@ class FleetConfig:
     #: canary/drift counts ride the host ledger's ``unit_ok`` records
     #: into :class:`..fabric.health.FleetHealthReport`. 0 disables.
     canary_fraction: float = 0.0
+    #: AOT executable-cache directory (:mod:`..simulation.aot`),
+    #: typically ON the shared store's filesystem so every host of the
+    #: fleet shares one artifact set: the host preloads its unit-shaped
+    #: executables BEFORE claiming its first lease (a lease must not
+    #: burn TTL on a compile another host already published), and every
+    #: miss it does compile is published for the next host. None
+    #: (default) leaves the legacy always-compile path untouched.
+    executable_cache_dir: Optional[str] = None
 
     def heartbeat_interval(self) -> float:
         if self.heartbeat_seconds is not None:
@@ -161,6 +169,50 @@ class FleetHost:
         )
         self.host_dir = self.store.host_dir(config.host_id)
         self._numerics_records: list = []
+        if config.executable_cache_dir:
+            from yuma_simulation_tpu.simulation.aot import (
+                configure_executable_cache,
+            )
+
+            configure_executable_cache(config.executable_cache_dir)
+
+    def preload_executables(
+        self,
+        shapes,
+        yuma_version: str,
+        *,
+        batch: int = 1,
+        quarantine: bool = True,
+        config=None,
+        dtype=None,
+    ) -> int:
+        """Resolve unit-shaped executables BEFORE the first lease claim
+        (:func:`..simulation.aot.preload_shapes`): a cache hit makes
+        this host dispatch-ready in milliseconds; a miss pays the AOT
+        compile NOW — outside any lease TTL, so a freshly claimed unit
+        never stalls its heartbeat window on a compile another host
+        already published. `config`/`dtype` must be the sweep's own —
+        they select the compiled program. No-op (0) when no cache is
+        active."""
+        from yuma_simulation_tpu.simulation.aot import (
+            active_cache,
+            preload_shapes,
+        )
+
+        if active_cache() is None:
+            return 0
+        return preload_shapes(
+            shapes,
+            yuma_version=yuma_version,
+            batch=batch,
+            quarantine=quarantine,
+            config=config,
+            dtype=dtype,
+            # Fleet units ALWAYS dispatch the batched program, even at
+            # one lane (stack_scenarios yields [1, E, V, M]).
+            batched=True,
+            label=f"fleet:{self.config.host_id}",
+        )
 
     def run_units(
         self,
@@ -597,6 +649,29 @@ def run_fleet_batch(
         }
 
     host = FleetHost(fleet)
+    if scenarios and fleet.executable_cache_dir:
+        # Preload the unit-shaped executables BEFORE the first lease
+        # claim: hits make this host dispatch-ready in milliseconds;
+        # misses pay the compile outside any lease TTL and publish for
+        # every other host on the shared store. The sweep's OWN
+        # config/dtype thread through (they select the compiled
+        # program), and both distinct unit widths — the full units and
+        # the trailing remainder — are warmed. Homogeneous-suite shapes
+        # only: a mixed suite's per-unit shapes are not known until
+        # claim time, and preload must stay best-effort.
+        shapes = {np.shape(s.weights) for s in scenarios}
+        if len(shapes) == 1:
+            widths = {min(fleet.unit_size, len(scenarios))}
+            if len(scenarios) % fleet.unit_size:
+                widths.add(len(scenarios) % fleet.unit_size)
+            for width in sorted(widths):
+                host.preload_executables(
+                    sorted(shapes),
+                    yuma_version,
+                    batch=width,
+                    config=config,
+                    dtype=dtype,
+                )
     summary = host.run_units(
         compute,
         num_units=len(lanes),

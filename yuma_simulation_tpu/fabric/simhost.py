@@ -71,6 +71,14 @@ def _build_parser() -> argparse.ArgumentParser:
         help="comma-separated unit indices this host claims first",
     )
     p.add_argument("--poach-after", type=float, default=30.0)
+    p.add_argument(
+        "--executable-cache",
+        default=None,
+        metavar="DIR",
+        help="AOT executable-cache directory (simulation.aot): this "
+        "host preloads its unit-shaped executables before claiming its "
+        "first lease, and publishes what it compiles for the fleet",
+    )
     # Deadline knobs (the stall host shrinks these after its warm-up).
     p.add_argument("--deadline", type=float, default=240.0)
     p.add_argument("--grace", type=float, default=240.0)
@@ -129,6 +137,7 @@ def main(argv=None) -> int:
         unit_size=args.unit_size,
         preferred_units=preferred,
         poach_after_seconds=args.poach_after,
+        executable_cache_dir=args.executable_cache,
     )
 
     plan_kwargs: dict = {}

@@ -34,6 +34,7 @@ def _build_config(args, **overrides) -> "ServeConfig":  # noqa: F821
             for shape in (args.warmup or [])
         ),
         canary_interval_seconds=args.canary_interval,
+        executable_cache_dir=args.executable_cache,
     )
 
 
@@ -170,6 +171,15 @@ def run_smoke(args) -> int:
         )
     finally:
         server.close()
+        if args.executable_cache:
+            # The cold-start artifact: process-lifetime cache stats
+            # (hits/misses/builds) beside the artifacts, so the CI
+            # cold-start lane asserts on run 2's copy.
+            from yuma_simulation_tpu.simulation.aot import active_cache
+
+            cache = active_cache()
+            if cache is not None:
+                cache.write_stats()
 
     if failures:
         print(f"\nserve smoke FAILED ({len(failures)} expectation(s))")
@@ -211,6 +221,15 @@ def main(argv=None) -> int:
         action="append",
         metavar="ExVxM",
         help="pre-compile this shape at startup (repeatable), e.g. 40x3x2",
+    )
+    parser.add_argument(
+        "--executable-cache",
+        default=None,
+        metavar="DIR",
+        help="AOT executable-cache directory (simulation.aot): warmup "
+        "preloads published executables, misses publish for the next "
+        "worker, and JAX's persistent compilation cache is enabled "
+        "beside it — the cold-start knob (README 'Cold start')",
     )
     parser.add_argument(
         "--smoke",

@@ -212,6 +212,15 @@ def warmup(
 
     warmed = 0
     spec = variant_for_version(version)
+    # Cold-start accounting (simulation.aot): with an executable cache
+    # active, each warmup dispatch below resolves through it — hits
+    # load published artifacts in milliseconds, misses compile once and
+    # publish for the next worker. The before/after stats delta rides
+    # the serve_warmed event, so a worker that re-paid compiles it
+    # should have loaded is visible in one grep.
+    from yuma_simulation_tpu.simulation.aot import process_stats
+
+    stats_before = process_stats().to_json()
     for shape in shapes:
         try:
             E, V, M = (int(d) for d in shape)
@@ -235,10 +244,13 @@ def warmup(
                 "warmup dispatch for shape %s failed", shape, exc_info=True
             )
     if warmed:
+        stats_after = process_stats().to_json()
         log_event(
             logger_ or logger,
             "serve_warmed",
             level=logging.INFO,
             shapes=warmed,
+            aot_hits=stats_after["hits"] - stats_before["hits"],
+            aot_builds=stats_after["builds"] - stats_before["builds"],
         )
     return warmed

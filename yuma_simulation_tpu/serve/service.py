@@ -83,6 +83,12 @@ class ServeConfig:
     bundle_dir: Optional[str] = None
     #: `(epochs, V, M)` shapes to pre-compile at startup (warm engines).
     warmup_shapes: tuple = ()
+    #: AOT executable-cache directory (:mod:`..simulation.aot`). When
+    #: set, warmup preloads published executables instead of compiling,
+    #: misses publish for the next worker, and JAX's persistent
+    #: compilation cache is enabled beside it as the fallback tier.
+    #: None (default) leaves the legacy always-compile path untouched.
+    executable_cache_dir: Optional[str] = None
     #: Optional device mesh for sharded dispatch (elastic shrink rides
     #: the supervisor's existing path).
     mesh: object = None
@@ -181,6 +187,18 @@ class SimulationService:
 
         self.config = config if config is not None else ServeConfig()
         self.registry = registry if registry is not None else get_registry()
+        if self.config.executable_cache_dir:
+            # Cold-start economics (simulation.aot): activate the AOT
+            # executable cache + the persistent-compilation-cache tier
+            # BEFORE warmup, so the warmup pass below loads published
+            # executables instead of re-paying every compile — this is
+            # what takes a worker from process start to first dispatch
+            # in well under a second once the cache is warm.
+            from yuma_simulation_tpu.simulation.aot import (
+                configure_executable_cache,
+            )
+
+            configure_executable_cache(self.config.executable_cache_dir)
         self.run = RunContext()
         self._slo_installed = False
         if slo_engine is not None:

@@ -1,0 +1,860 @@
+"""AOT executable cache: cold-start economics for every process tier.
+
+Every process in the platform — serve workers, fleet hosts, supervisors,
+CLIs, bench — dispatches the same jitted consensus kernels over the same
+(V, M, epochs, engine) shape buckets, and each one re-pays the full
+XLA/Mosaic compile on every start. Compile cost is *measured* everywhere
+(the ``compile_seconds`` histogram, the cold-start SLO, Server-Timing
+compile spans) but amortized nowhere. This module is the amortization:
+
+- a **content-addressed on-disk executable cache**: each planner-rung
+  program is AOT-lowered and serialized with ``jax.export`` under a key
+  derived from the HLO sha256 fingerprint ``telemetry/cost.py`` already
+  computes, composed with the backend / device kind / jax / jaxlib
+  versions — a toolchain or device change makes stale entries MISS
+  instead of misexecute;
+- a **dispatch seam** (:func:`dispatch_via_cache`, surfaced on
+  :meth:`..simulation.planner.DispatchPlan.attach_executable`): on cache
+  hit the engine dispatches the deserialized executable directly (no
+  re-trace, no re-lower; the XLA compile of the deserialized module is
+  served by the persistent compilation cache tier below); on miss it
+  JITs exactly as today and *publishes* the serialized artifact through
+  ``publish_atomic``, so concurrent writers race safely and the next
+  process start is warm;
+- the **persistent JAX compilation cache** as the fallback tier:
+  :func:`configure_executable_cache` enables
+  ``jax_compilation_cache_dir`` beside the artifact store (min compile
+  time 0 — a cold-start cache that only persists minutes-scale compiles
+  would leave every CPU lane cold), so even programs the executable
+  cache does not cover skip their XLA compile on the second start.
+
+Every load outcome is a typed event — ``executable_cache_hit`` /
+``executable_cache_miss`` (with a ``reason``: absent, corrupt, torn,
+undeserializable) / ``executable_cache_stale`` (an artifact for this
+exact program exists, built under a different toolchain/device) — plus
+registry counters, so a fleet's cache effectiveness is a metrics query,
+not a guess. A corrupt or truncated artifact is ALWAYS a typed miss that
+requeues to the JIT path; it can never crash a dispatch or serve a wrong
+program (the digest check rejects torn bytes before deserialization).
+
+Parity is the gate: an AOT-dispatched result must be bitwise-identical
+to the JIT path (tests/unit/test_aot.py pins every planner rung on the
+bucket grid), which holds by construction — the serialized artifact IS
+the jit-lowered program, round-tripped through StableHLO.
+
+The cache is OFF unless configured (:func:`configure_executable_cache`,
+the ``--executable-cache`` CLI flags, or the
+:data:`EXECUTABLE_CACHE_ENV` environment variable), so the zero-compile
+sentinels and bitwise pins of the existing test surface run the exact
+legacy path by default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import pathlib
+import threading
+from typing import Callable, Optional, Sequence
+
+from yuma_simulation_tpu.utils.logging import log_event
+
+logger = logging.getLogger(__name__)
+
+#: Environment variable naming the cache directory: processes that take
+#: no CLI flag (bench subprocesses, ad-hoc scripts) join the cache by
+#: exporting this.
+EXECUTABLE_CACHE_ENV = "YUMA_TPU_EXECUTABLE_CACHE"
+
+#: Artifact subdirectory under the cache root (the sibling ``xla/`` holds
+#: the persistent-compilation-cache tier).
+ARTIFACT_SUBDIR = "aot"
+
+#: Stats artifact name (:meth:`ExecutableCache.write_stats`) — the CI
+#: cold-start lane asserts on the second run's copy.
+STATS_FILENAME = "cache_stats.json"
+
+
+# ---------------------------------------------------------------------------
+# export serialization of the package's pytree nodes
+
+
+#: Pytree dataclasses that may appear in a dispatch's input/output trees.
+#: ``jax.export`` serialization refuses unregistered node types, so each
+#: is registered once with a stable name; auxdata (the static-field
+#: tuple of ``register_dataclass``) round-trips through JSON with
+#: list->tuple restoration (the flatten contract wants tuples back).
+_EXPORT_PYTREE_TYPES_DONE = False
+_EXPORT_LOCK = threading.Lock()
+
+
+def _auxdata_from_json(raw: bytes):
+    def detuple(v):
+        if isinstance(v, list):
+            return tuple(detuple(x) for x in v)
+        return v
+
+    return detuple(json.loads(raw.decode()))
+
+
+def register_export_serialization() -> None:
+    """Register the package's pytree dataclasses with ``jax.export``
+    serialization (idempotent; re-registration errors are swallowed —
+    another caller already did the work)."""
+    global _EXPORT_PYTREE_TYPES_DONE
+    with _EXPORT_LOCK:
+        if _EXPORT_PYTREE_TYPES_DONE:
+            return
+        from jax import export as jax_export
+
+        from yuma_simulation_tpu.models.config import (
+            SimulationHyperparameters,
+            YumaConfig,
+            YumaParams,
+        )
+        from yuma_simulation_tpu.simulation.carry import NumericsSketch
+
+        for cls in (
+            SimulationHyperparameters,
+            YumaParams,
+            YumaConfig,
+            NumericsSketch,
+        ):
+            try:
+                jax_export.register_pytree_node_serialization(
+                    cls,
+                    serialized_name=f"yuma_simulation_tpu.{cls.__name__}",
+                    serialize_auxdata=lambda aux: json.dumps(aux).encode(),
+                    deserialize_auxdata=_auxdata_from_json,
+                )
+            except ValueError:
+                # Already registered (a prior cache instance in this
+                # process) — the registration is process-global.
+                pass
+        _EXPORT_PYTREE_TYPES_DONE = True
+
+
+# ---------------------------------------------------------------------------
+# environment key: what must match for an artifact to be executable here
+
+
+def environment_descriptor() -> dict:
+    """The toolchain/device coordinates an artifact is only valid under.
+    Composed into every cache key: a jax/jaxlib upgrade or a different
+    device kind turns yesterday's artifacts into typed stale misses
+    instead of programs that deserialize into the wrong runtime."""
+    import jax
+    import jaxlib
+
+    from yuma_simulation_tpu.telemetry.cost import _probe_device
+
+    kind, _ = _probe_device()
+    return {
+        "backend": jax.default_backend(),
+        "device_kind": kind or "unknown",
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+    }
+
+
+def _environment_key(env: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(env, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# stats
+
+
+@dataclasses.dataclass
+class AotStats:
+    """Process-lifetime cache effectiveness counters. ``hits`` counts
+    artifacts loaded from disk (one per program per process — further
+    dispatches ride the in-process memo silently); ``builds`` counts
+    true AOT compiles (a miss that exported + published); ``errors``
+    counts load/build failures that fell back to the plain JIT path."""
+
+    hits: int = 0
+    misses: int = 0
+    stale: int = 0
+    builds: int = 0
+    errors: int = 0
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# the on-disk cache
+
+
+class ExecutableCache:
+    """Content-addressed executable artifacts under ``root/aot/``.
+
+    Layout: one directory per full HLO sha256 fingerprint, one
+    ``<envkey>.bin`` (serialized ``jax.export.Exported``) plus
+    ``<envkey>.json`` metadata per environment. The metadata is
+    published LAST (both through ``publish_atomic``), so a reader that
+    sees the metadata sees a complete artifact; the blob digest recorded
+    there rejects corrupt/truncated bytes before deserialization."""
+
+    def __init__(self, root: str | pathlib.Path):
+        self.root = pathlib.Path(root)
+        self.artifact_dir = self.root / ARTIFACT_SUBDIR
+        self.env = environment_descriptor()
+        self.env_key = _environment_key(self.env)
+        self.stats = AotStats()
+        # Registry counters created ONCE with literal names (the
+        # jaxlint JX202 contract); metrics must never break a dispatch.
+        try:
+            from yuma_simulation_tpu.telemetry.metrics import get_registry
+
+            registry = get_registry()
+            self._counters = {
+                "hits": registry.counter("executable_cache_hits"),
+                "misses": registry.counter("executable_cache_misses"),
+                "stale": registry.counter("executable_cache_stale"),
+                "builds": registry.counter("executable_cache_builds"),
+            }
+        except Exception:
+            self._counters = {}
+
+    # -- paths ---------------------------------------------------------
+
+    def _entry_dir(self, fingerprint: str) -> pathlib.Path:
+        return self.artifact_dir / fingerprint
+
+    def _blob_path(self, fingerprint: str) -> pathlib.Path:
+        return self._entry_dir(fingerprint) / f"{self.env_key}.bin"
+
+    def _meta_path(self, fingerprint: str) -> pathlib.Path:
+        return self._entry_dir(fingerprint) / f"{self.env_key}.json"
+
+    # -- counters ------------------------------------------------------
+
+    def _count(self, key: str) -> None:
+        counter = self._counters.get(key)
+        if counter is not None:
+            counter.inc()
+
+    def _miss(self, fingerprint: str, label: str, reason: str) -> None:
+        self.stats.misses += 1
+        self._count("misses")
+        log_event(
+            logger,
+            "executable_cache_miss",
+            level=logging.DEBUG if reason == "absent" else logging.INFO,
+            fingerprint=fingerprint[:16],
+            label=label,
+            reason=reason,
+        )
+
+    # -- load / store --------------------------------------------------
+
+    def load(self, fingerprint: str, *, label: str = ""):
+        """The deserialized ``jax.export.Exported`` for `fingerprint`
+        under THIS environment, or None with exactly one typed event
+        saying why: ``executable_cache_stale`` when artifacts for this
+        program exist only under other toolchains/devices,
+        ``executable_cache_miss`` (reason absent/torn/corrupt/
+        undeserializable) otherwise. Never raises — a bad artifact
+        requeues the dispatch to the JIT path."""
+        register_export_serialization()
+        blob_path = self._blob_path(fingerprint)
+        meta_path = self._meta_path(fingerprint)
+        if not meta_path.exists():
+            entry = self._entry_dir(fingerprint)
+            try:
+                siblings = [
+                    p for p in entry.glob("*.json")
+                    if p.name != meta_path.name
+                ]
+            except OSError:
+                siblings = []
+            if siblings:
+                self.stats.stale += 1
+                self._count("stale")
+                log_event(
+                    logger,
+                    "executable_cache_stale",
+                    level=logging.INFO,
+                    fingerprint=fingerprint[:16],
+                    label=label,
+                    foreign_artifacts=len(siblings),
+                    env_key=self.env_key,
+                )
+            else:
+                self._miss(fingerprint, label, "absent")
+            return None
+        try:
+            meta = json.loads(meta_path.read_text())
+        except (OSError, ValueError):
+            self._miss(fingerprint, label, "torn_metadata")
+            return None
+        try:
+            blob = blob_path.read_bytes()
+        except OSError:
+            self._miss(fingerprint, label, "blob_missing")
+            return None
+        if hashlib.sha256(blob).hexdigest() != meta.get("blob_sha256"):
+            self._miss(fingerprint, label, "corrupt")
+            return None
+        if meta.get("environment") != self.env:
+            # Belt and braces: the env key already namespaces the file,
+            # so reaching here means a hash collision or a hand-copied
+            # artifact — refuse it as stale rather than misexecute.
+            self.stats.stale += 1
+            self._count("stale")
+            log_event(
+                logger,
+                "executable_cache_stale",
+                level=logging.INFO,
+                fingerprint=fingerprint[:16],
+                label=label,
+                env_key=self.env_key,
+            )
+            return None
+        try:
+            from jax import export as jax_export
+
+            exported = jax_export.deserialize(blob)
+        except Exception as e:
+            self._miss(
+                fingerprint, label, f"undeserializable:{type(e).__name__}"
+            )
+            return None
+        self.stats.hits += 1
+        self._count("hits")
+        log_event(
+            logger,
+            "executable_cache_hit",
+            level=logging.INFO,
+            fingerprint=fingerprint[:16],
+            label=label,
+            bytes=len(blob),
+        )
+        return exported
+
+    def store(self, fingerprint: str, exported, *, label: str = "") -> bool:
+        """Serialize and publish one artifact (crash-safe, last-writer-
+        wins-whole via ``publish_atomic`` — concurrent builders of the
+        same program cannot interleave bytes). Returns False (with an
+        error counted) instead of raising: publishing is an
+        optimization, never a dispatch dependency."""
+        from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+
+        register_export_serialization()
+        try:
+            blob = exported.serialize()
+            entry = self._entry_dir(fingerprint)
+            entry.mkdir(parents=True, exist_ok=True)
+            publish_atomic(self._blob_path(fingerprint), blob)
+            meta = {
+                "fingerprint": fingerprint,
+                "environment": self.env,
+                "blob_sha256": hashlib.sha256(blob).hexdigest(),
+                "blob_bytes": len(blob),
+                "label": label,
+            }
+            publish_atomic(
+                self._meta_path(fingerprint),
+                json.dumps(meta, sort_keys=True).encode(),
+            )
+        except Exception:
+            self.stats.errors += 1
+            logger.warning(
+                "executable cache publish failed for %s", label,
+                exc_info=True,
+            )
+            return False
+        return True
+
+    # -- stats artifact ------------------------------------------------
+
+    def entries_on_disk(self) -> int:
+        try:
+            return sum(
+                1 for _ in self.artifact_dir.glob("*/*.bin")
+            )
+        except OSError:
+            return 0
+
+    def stats_payload(self) -> dict:
+        return {
+            **self.stats.to_json(),
+            "environment": self.env,
+            "env_key": self.env_key,
+            "entries_on_disk": self.entries_on_disk(),
+            "root": str(self.root),
+        }
+
+    def write_stats(self, path: Optional[str | pathlib.Path] = None) -> dict:
+        """Publish the process's cache-effectiveness stats (the CI
+        cold-start lane's artifact: run 2 must show ``builds == 0``,
+        ``misses == 0`` and ``hits >= 1``)."""
+        from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+
+        payload = self.stats_payload()
+        target = (
+            pathlib.Path(path) if path is not None
+            else self.root / STATS_FILENAME
+        )
+        target.parent.mkdir(parents=True, exist_ok=True)
+        publish_atomic(
+            target, json.dumps(payload, indent=2, sort_keys=True).encode()
+        )
+        return payload
+
+
+# ---------------------------------------------------------------------------
+# process-global activation + in-process memo
+
+
+_ACTIVE: Optional[ExecutableCache] = None
+_MEMO: dict = {}
+_MEMO_LOCK = threading.Lock()
+
+#: Cumulative stats of every cache this process has retired (replaced
+#: or deactivated): :func:`process_stats` reports retired + active, so
+#: RecompilationSentinel's entry/exit deltas stay monotonic even when a
+#: region swaps the active cache mid-flight (a FleetHost/serve
+#: construction inside a pinned region must not reset the build count
+#: a budget is measured against).
+_RETIRED_STATS = AotStats()
+
+#: Environment value whose auto-configuration failed — remembered so a
+#: bad YUMA_TPU_EXECUTABLE_CACHE path degrades to "no cache" ONCE
+#: instead of re-raising (or re-attempting mkdir) on every dispatch.
+_ENV_FAILED: Optional[str] = None
+
+#: Negative-memo sentinel: a program that failed to lower/export once
+#: (e.g. an interpret-mode Pallas rung off-TPU) must not re-pay the
+#: failed attempt's tracing on every subsequent dispatch.
+_UNRESOLVABLE = object()
+
+
+def configure_executable_cache(
+    root: str | pathlib.Path, *, persistent_compilation_cache: bool = True
+) -> ExecutableCache:
+    """Activate the process-global executable cache at `root` and (by
+    default) enable JAX's persistent compilation cache beside it
+    (``root/xla``) as the fallback tier — with min compile time 0, so
+    the sub-second CPU compiles of the CI lanes persist too. Replaces
+    any previously active cache (the in-process memo is kept: already-
+    loaded executables stay valid, they are keyed by program content)."""
+    global _ACTIVE
+    cache = ExecutableCache(root)
+    cache.artifact_dir.mkdir(parents=True, exist_ok=True)
+    if persistent_compilation_cache:
+        from yuma_simulation_tpu.utils.profiling import (
+            enable_compilation_cache,
+        )
+
+        enable_compilation_cache(
+            str(cache.root / "xla"), min_compile_secs=0.0
+        )
+    if _ACTIVE is not None:
+        _retire(_ACTIVE.stats)
+    _ACTIVE = cache
+    return cache
+
+
+def _retire(stats: AotStats) -> None:
+    for field in dataclasses.fields(AotStats):
+        setattr(
+            _RETIRED_STATS,
+            field.name,
+            getattr(_RETIRED_STATS, field.name)
+            + getattr(stats, field.name),
+        )
+
+
+def deactivate_executable_cache() -> None:
+    """Deactivate the cache AND drop the in-process memo (tests)."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _retire(_ACTIVE.stats)
+    _ACTIVE = None
+    with _MEMO_LOCK:
+        _MEMO.clear()
+
+
+def active_cache() -> Optional[ExecutableCache]:
+    """The process-global cache: an explicitly configured one, else one
+    auto-configured from :data:`EXECUTABLE_CACHE_ENV`, else None (the
+    seam is a no-op and every dispatch JITs exactly as before). An env
+    path that fails to configure (typo, read-only filesystem) degrades
+    to None with ONE warning — it must never crash a dispatch."""
+    global _ENV_FAILED
+    if _ACTIVE is not None:
+        return _ACTIVE
+    root = os.environ.get(EXECUTABLE_CACHE_ENV)
+    if root and root != _ENV_FAILED:
+        try:
+            return configure_executable_cache(root)
+        except Exception:
+            _ENV_FAILED = root
+            logger.warning(
+                "%s=%r could not be configured; executable cache "
+                "disabled for this process",
+                EXECUTABLE_CACHE_ENV,
+                root,
+                exc_info=True,
+            )
+    return None
+
+
+def process_stats() -> AotStats:
+    """PROCESS-cumulative cache stats: every retired cache's tallies
+    plus the active one's — what
+    :class:`..utils.profiling.RecompilationSentinel` snapshots to tell
+    cache-hit loads from true compiles (monotonic across cache swaps,
+    so entry/exit deltas never go negative)."""
+    total = dataclasses.replace(_RETIRED_STATS)
+    cache = _ACTIVE
+    if cache is not None:
+        for field in dataclasses.fields(AotStats):
+            setattr(
+                total,
+                field.name,
+                getattr(total, field.name)
+                + getattr(cache.stats, field.name),
+            )
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the dispatch seam
+
+
+@dataclasses.dataclass
+class AotExecutable:
+    """One resolved executable: ``call`` takes the DYNAMIC arguments of
+    the original jitted function (statics are baked into the exported
+    program). ``source`` is "cache" (deserialized from disk) or "built"
+    (AOT-exported this process — a true compile)."""
+
+    call: Callable
+    fingerprint: str
+    source: str
+    label: str
+
+    def describe(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint[:16],
+            "source": self.source,
+            "label": self.label,
+        }
+
+
+def _leaf_token(leaf) -> str:
+    aval = getattr(leaf, "aval", None)
+    if aval is not None:  # jax.Array
+        return aval.str_short()
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:  # np.ndarray / np scalar
+        return f"{dtype}{list(shape)}"
+    if isinstance(leaf, bool) or leaf is None or isinstance(leaf, str):
+        return repr(leaf)
+    if isinstance(leaf, (int, float, complex)):
+        # Dynamic python scalars trace weak-typed: the VALUE does not
+        # change the program, so it must not change the memo key.
+        return f"py_{type(leaf).__name__}"
+    return repr(leaf)
+
+
+def _signature(
+    fn, args: tuple, kwargs: dict, static_names: tuple = ()
+) -> str:
+    """The in-process memo key: function identity + static VALUES +
+    dynamic input tree structure + per-leaf abstract tokens. Statics
+    hash by value (they select the compiled program — an int static of
+    0 vs 7 bakes two different programs); dynamic scalars are
+    value-erased (a traced weak scalar's value never changes the
+    program, and hashing it would fragment the memo per config value).
+    Two calls with the same signature lower to the same program, so the
+    signature resolves to one executable without re-tracing."""
+    import jax
+
+    statics = {k: v for k, v in kwargs.items() if k in static_names}
+    dynamic = {k: v for k, v in kwargs.items() if k not in static_names}
+    leaves, treedef = jax.tree.flatten((args, dict(sorted(dynamic.items()))))
+    name = getattr(fn, "__name__", None) or repr(fn)
+    parts = (
+        [name, repr(sorted(statics.items())), str(treedef)]
+        + [_leaf_token(leaf) for leaf in leaves]
+    )
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def _load_or_build(
+    cache: Optional[ExecutableCache],
+    fn,
+    args: tuple,
+    kwargs: dict,
+    label: str,
+) -> Optional[AotExecutable]:
+    """Resolve one program: fingerprint via the jit lowering (tracing
+    only — no XLA compile), then disk load, else AOT-export + publish.
+    `cache=None` resolves memo-only (export + wrap, nothing touches
+    disk — the no-active-cache ``attach_executable`` path). Any failure
+    returns None (error counted) and the caller JITs as today — the
+    cache can slow nothing down and break nothing."""
+    import jax
+    from jax import export as jax_export
+
+    from yuma_simulation_tpu.telemetry.cost import hlo_fingerprint
+
+    register_export_serialization()
+    try:
+        lowered = fn.lower(*args, **kwargs)
+        fingerprint = hlo_fingerprint(lowered, digits=None)
+    except Exception:
+        if cache is not None:
+            cache.stats.errors += 1
+        logger.debug("AOT lowering failed for %s", label, exc_info=True)
+        return None
+    exported = cache.load(fingerprint, label=label) if cache else None
+    source = "cache"
+    if exported is None:
+        try:
+            exported = jax_export.export(fn)(*args, **kwargs)
+        except Exception:
+            if cache is not None:
+                cache.stats.errors += 1
+            logger.debug("AOT export failed for %s", label, exc_info=True)
+            return None
+        if cache is not None:
+            # The build is counted on the successful EXPORT, not the
+            # publish: the compile happened regardless of whether the
+            # artifact landed (a full/read-only cache disk must not
+            # hide true compiles from RecompilationSentinel budgets).
+            cache.stats.builds += 1
+            cache._count("builds")
+            cache.store(fingerprint, exported, label=label)
+        source = "built"
+    call = jax.jit(exported.call)
+    return AotExecutable(
+        call=call, fingerprint=fingerprint, source=source, label=label
+    )
+
+
+def dispatch_via_cache(
+    fn,
+    args: tuple,
+    kwargs: dict,
+    *,
+    static_names: tuple,
+    label: str,
+):
+    """The engine seam: dispatch `fn(*args, **kwargs)` through the
+    executable cache, or return None meaning "ineligible — JIT exactly
+    as today". Contract: `args` are the dynamic positional operands,
+    `kwargs` may mix dynamic and static keywords, and `static_names`
+    lists the static ones (they are baked into the exported program and
+    dropped from the executable's call).
+
+    No-ops (None) when no cache is active or under an ambient trace
+    (``simulate_batch`` re-enters dispatch inside the ``shard_map``
+    trace, where a host-side cache lookup would bake garbage into the
+    program)."""
+    cache = active_cache()
+    if cache is None:
+        return None
+    from yuma_simulation_tpu.telemetry.runctx import _tracing_now
+
+    if _tracing_now():
+        return None
+    sig = _signature(fn, args, kwargs, static_names)
+    with _MEMO_LOCK:
+        exe = _MEMO.get(sig)
+    if exe is _UNRESOLVABLE:
+        return None
+    if exe is None:
+        exe = _load_or_build(cache, fn, args, kwargs, label)
+        with _MEMO_LOCK:
+            _MEMO.setdefault(sig, exe if exe is not None else _UNRESOLVABLE)
+        if exe is None:
+            return None
+    dynamic_kwargs = {
+        k: v for k, v in kwargs.items() if k not in static_names
+    }
+    return exe.call(*args, **dynamic_kwargs)
+
+
+# ---------------------------------------------------------------------------
+# plan-level resolution (DispatchPlan.attach_executable's back half)
+
+
+def executable_for_plan(
+    plan,
+    yuma_version: str = "Yuma 1 (paper)",
+    *,
+    cache: Optional[ExecutableCache] = None,
+    config=None,
+    dtype=None,
+    save_bonds: bool = False,
+    save_incentives: bool = False,
+    quarantine: bool = False,
+    batched: Optional[bool] = None,
+) -> Optional[AotExecutable]:
+    """Resolve (load, or AOT-build and publish) the executable for a
+    :class:`..simulation.planner.DispatchPlan`'s engine rung at its
+    bucket shape — the explicit preload seam warmup and the fleet hosts
+    use, sharing the disk artifacts and the in-process memo with the hot
+    path. Explicit-call only: a miss COMPILES, exactly like
+    ``attach_cost``. With no cache active the executable is resolved
+    memo-only (nothing touches disk). Returns None when the rung cannot
+    be resolved on this backend (the caller's warmup falls back to a
+    plain dispatch)."""
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.models.config import YumaConfig
+    from yuma_simulation_tpu.models.variants import variant_for_version
+    from yuma_simulation_tpu.telemetry.numerics import numerics_enabled
+
+    target = cache if cache is not None else active_cache()
+    config = config if config is not None else YumaConfig()
+    dtype = jnp.dtype(dtype if dtype is not None else jnp.float32)
+    spec = variant_for_version(yuma_version)
+    bucket = plan.bucket
+    E = max(1, int(bucket.epochs))
+    V, M = int(bucket.V), int(bucket.M)
+    B = int(bucket.batch)
+    # `batched=True` forces the BATCHED program even at one lane (a
+    # fleet unit of width 1 still dispatches [1, E, V, M] through
+    # `_simulate_batch_xla` — the bucket alone cannot tell the two
+    # apart); default: batched exactly when the bucket carries lanes.
+    batched = (B > 1) if batched is None else batched
+    capture = numerics_enabled()
+    ri_shape = (B,) if batched else ()
+    W = jnp.zeros(((B,) if batched else ()) + (E, V, M), dtype)
+    S = jnp.ones(((B,) if batched else ()) + (E, V), dtype)
+    ri = jnp.full(ri_shape, -1, jnp.int32)
+    re = jnp.full(ri_shape, -1, jnp.int32)
+    if plan.engine in ("fused_scan", "fused_scan_mxu"):
+        from yuma_simulation_tpu.simulation.engine import (
+            _simulate_case_fused,
+        )
+
+        fn = _simulate_case_fused
+        kwargs = dict(
+            spec=spec,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=False,
+            mxu=plan.engine == "fused_scan_mxu",
+            capture_numerics=capture,
+        )
+        static_names = tuple(kwargs)
+    elif batched:
+        from yuma_simulation_tpu.simulation.sweep import _simulate_batch_xla
+
+        # Mirror simulate_batch's seam exactly (statics AND the dynamic
+        # miner_mask=None keyword): a preloaded unit-shaped executable
+        # must be THE program the fleet/serve dispatch resolves, or the
+        # preload warms nothing.
+        fn = _simulate_batch_xla
+        kwargs = dict(
+            spec=spec,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            consensus_impl=plan.consensus_impl,
+            guard_nonfinite=quarantine,
+            capture_numerics=capture,
+        )
+        # miner_mask stays DYNAMIC — part of the exported call, not a
+        # static — exactly as the simulate_batch seam spells it.
+        static_names = tuple(kwargs)
+        kwargs["miner_mask"] = None
+    else:
+        from yuma_simulation_tpu.simulation.engine import _simulate_scan
+
+        fn = _simulate_scan
+        kwargs = dict(
+            spec=spec,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=False,
+            consensus_impl=plan.consensus_impl,
+            capture_numerics=capture,
+        )
+        static_names = tuple(kwargs)
+    args = (W, S, ri, re, config)
+    sig = _signature(fn, args, kwargs, static_names)
+    with _MEMO_LOCK:
+        exe = _MEMO.get(sig)
+    if exe is _UNRESOLVABLE:
+        return None
+    if exe is not None:
+        return exe
+    exe = _load_or_build(target, fn, args, kwargs, label=plan.label)
+    with _MEMO_LOCK:
+        _MEMO.setdefault(sig, exe if exe is not None else _UNRESOLVABLE)
+    return exe
+
+
+def preload_shapes(
+    shapes: Sequence[tuple],
+    *,
+    yuma_version: str = "Yuma 1 (paper)",
+    batch: int = 1,
+    quarantine: bool = False,
+    config=None,
+    dtype=None,
+    batched: Optional[bool] = None,
+    label: str = "preload",
+) -> int:
+    """Resolve executables for a set of ``(epochs, V, M)`` shape buckets
+    before serving traffic / claiming a lease: cache hits load in
+    milliseconds; misses pay the AOT build NOW — outside any request
+    deadline or lease TTL — and publish for the next process.
+    `config`/`dtype` must match the real dispatch's (they select the
+    compiled program: a float32 preload warms nothing for a bfloat16
+    fleet). Returns the number of buckets resolved. Failures are
+    logged, never fatal."""
+    import jax.numpy as jnp
+
+    from yuma_simulation_tpu.models.config import YumaConfig
+    from yuma_simulation_tpu.simulation.planner import plan_dispatch
+
+    config = config if config is not None else YumaConfig()
+    dtype = jnp.dtype(dtype if dtype is not None else jnp.float32)
+    batched = (batch > 1) if batched is None else batched
+    resolved = 0
+    for shape in shapes:
+        try:
+            E, V, M = (int(d) for d in shape)
+            dims = (batch, E, V, M) if batched else (E, V, M)
+            plan = plan_dispatch(
+                f"{label}:{E}x{V}x{M}",
+                dims,
+                yuma_version,
+                config,
+                dtype,
+                quarantine=quarantine,
+                check_memory=False,
+            )
+            if (
+                executable_for_plan(
+                    plan,
+                    yuma_version,
+                    quarantine=quarantine,
+                    config=config,
+                    dtype=dtype,
+                    batched=batched,
+                )
+                is not None
+            ):
+                resolved += 1
+        except Exception:
+            logger.warning(
+                "executable preload for shape %s failed", shape,
+                exc_info=True,
+            )
+    return resolved

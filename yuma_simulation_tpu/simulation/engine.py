@@ -797,21 +797,41 @@ def simulate(
     capture = numerics_enabled()
 
     def _dispatch_engine(rung: str):
+        # The AOT executable-cache seam (simulation.aot): when a cache
+        # is active and the dispatch carries no dynamic fault operands
+        # or sharding, resolve the rung's program by content — a hit
+        # dispatches the deserialized executable directly (bitwise the
+        # JIT path, pinned by tests/unit/test_aot.py); a miss JITs as
+        # today and publishes the artifact. Inactive cache = None fast
+        # path, so the legacy pipeline is untouched by default.
+        from yuma_simulation_tpu.simulation.aot import dispatch_via_cache
+
         if rung in ("fused_scan", "fused_scan_mxu"):
             faults.maybe_fail_fused_dispatch()
-            out = _simulate_case_fused(
-                weights,
-                stakes,
-                reset_index,
-                reset_epoch,
-                config,
-                spec,
+            fused_kwargs = dict(
+                spec=spec,
                 save_bonds=save_bonds,
                 save_incentives=save_incentives,
                 save_consensus=save_consensus,
                 mxu=rung == "fused_scan_mxu",
                 capture_numerics=capture,
             )
+            out = dispatch_via_cache(
+                _simulate_case_fused,
+                (weights, stakes, reset_index, reset_epoch, config),
+                fused_kwargs,
+                static_names=tuple(fused_kwargs),
+                label=f"simulate:{rung}",
+            )
+            if out is None:
+                out = _simulate_case_fused(
+                    weights,
+                    stakes,
+                    reset_index,
+                    reset_epoch,
+                    config,
+                    **fused_kwargs,
+                )
         else:
             # Demoted off a fused rung: the plan pre-resolved the
             # XLA-rung consensus exactly as a direct request would be.
@@ -827,25 +847,40 @@ def simulate(
                     W, NamedSharding(mesh, PartitionSpec(None, None, axis))
                 )
             nf = faults.active_nan_fault()
-            out = _simulate_scan(
-                W,
-                stakes,
-                reset_index,
-                reset_epoch,
-                config,
-                spec,
+            xla_kwargs = dict(
+                spec=spec,
                 save_bonds=save_bonds,
                 save_incentives=save_incentives,
                 save_consensus=save_consensus,
                 consensus_impl=cons,
-                mesh=mesh,
-                nan_fault_epoch=(
-                    None
-                    if nf is None or nf.case is not None
-                    else jnp.asarray(nf.epoch, jnp.int32)
-                ),
                 capture_numerics=capture,
             )
+            out = (
+                dispatch_via_cache(
+                    _simulate_scan,
+                    (W, stakes, reset_index, reset_epoch, config),
+                    xla_kwargs,
+                    static_names=tuple(xla_kwargs),
+                    label=f"simulate:{rung}",
+                )
+                if mesh is None and nf is None
+                else None
+            )
+            if out is None:
+                out = _simulate_scan(
+                    W,
+                    stakes,
+                    reset_index,
+                    reset_epoch,
+                    config,
+                    mesh=mesh,
+                    nan_fault_epoch=(
+                        None
+                        if nf is None or nf.case is not None
+                        else jnp.asarray(nf.epoch, jnp.int32)
+                    ),
+                    **xla_kwargs,
+                )
         if retry_policy is not None or deadline is not None:
             # Surface async dispatch failures (device OOM) inside the
             # ladder's/watchdog's try, not at some later host fetch.

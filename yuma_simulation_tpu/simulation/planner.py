@@ -194,11 +194,23 @@ class DispatchPlan:
     #: Optional AOT cost estimate (a `telemetry.cost.CostRecord` dict);
     #: populated only by :meth:`attach_cost` — never on the hot path.
     cost: Optional[dict] = None
+    #: Optional resolved executable (a `simulation.aot.AotExecutable`);
+    #: populated only by :meth:`attach_executable`. Excluded from
+    #: equality/JSON — a plan with a warm executable is still the SAME
+    #: plan (determinism pins compare the decisions, not the handle).
+    executable: Optional[object] = dataclasses.field(
+        default=None, compare=False, repr=False
+    )
 
     def to_json(self) -> dict:
-        out = dataclasses.asdict(self)
+        out = dataclasses.asdict(dataclasses.replace(self, executable=None))
         out["ladder"] = list(self.ladder)
         out["reasons"] = list(self.reasons)
+        out["executable"] = (
+            self.executable.describe()
+            if self.executable is not None
+            else None
+        )
         return out
 
     def span_attr(self) -> dict:
@@ -270,6 +282,9 @@ class DispatchPlan:
             ladder=ladder_from(rung),
             reasons=self.reasons
             + (f"circuit breaker re-anchored dispatch at {rung!r}",),
+            # An attached executable is the OLD rung's program — a
+            # re-anchored plan must resolve its own.
+            executable=None,
         )
 
     def attach_cost(self, yuma_version: str = "Yuma 1 (paper)") -> "DispatchPlan":
@@ -287,6 +302,30 @@ class DispatchPlan:
             yuma_version=yuma_version,
         )
         return dataclasses.replace(self, cost=rec.to_json())
+
+    def attach_executable(
+        self, yuma_version: str = "Yuma 1 (paper)", *, cache=None, **kwargs
+    ) -> "DispatchPlan":
+        """A copy of this plan with its engine rung's executable
+        resolved through the AOT cache
+        (:func:`..simulation.aot.executable_for_plan`): a cache hit
+        deserializes the published artifact (milliseconds); a miss
+        AOT-COMPILES and publishes it — so, like :meth:`attach_cost`,
+        this is explicit-call only (serve warmup, fleet preload, tools),
+        never the hot path. The resolved executable also lands in the
+        process-wide memo the engine dispatch seam consults, which is
+        what makes warmup-then-serve compile-free. `kwargs` forward to
+        ``executable_for_plan`` (config/dtype/save flags). The plan is
+        returned unchanged when the rung cannot resolve on this
+        backend."""
+        from yuma_simulation_tpu.simulation.aot import executable_for_plan
+
+        exe = executable_for_plan(
+            self, yuma_version, cache=cache, **kwargs
+        )
+        if exe is None:
+            return self
+        return dataclasses.replace(self, executable=exe)
 
 
 # ---------------------------------------------------------------------------

@@ -333,30 +333,58 @@ def simulate_batch(
             # direct XLA dispatch and for a demotion off a fused rung
             # (whose checks admit only auto/bisect requests).
             cons = plan.fallback_consensus
-            out = _simulate_batch_xla(
-                weights,
-                stakes,
-                reset_index,
-                reset_epoch,
-                config,
-                spec,
-                save_bonds=save_bonds,
-                save_incentives=save_incentives,
-                consensus_impl=cons,
-                miner_mask=miner_mask,
-                guard_nonfinite=quarantine,
-                # Reviewed suppression: same host-wrapper re-entry as
-                # above — under the sharded trace the hook returns its
-                # inert value and no fault arms (drills are unsharded).
-                nan_fault_epochs=_lane_epochs(faults.active_nan_fault()),  # jaxlint: disable=JX004
-                capture_numerics=capture,
-                # The drift canary's single-ulp lane flip: armed only
-                # inside canary re-executions (faults.canary_scope), so
-                # primary dispatches trace the exact production program.
-                drift_fault_epochs=_lane_epochs(
-                    faults.active_drift_fault()
-                ),
-            )
+            # Reviewed suppression: same host-wrapper re-entry as
+            # above — under the sharded trace the hook returns its
+            # inert value and no fault arms (drills are unsharded).
+            nan_epochs = _lane_epochs(faults.active_nan_fault())  # jaxlint: disable=JX004
+            # The drift canary's single-ulp lane flip: armed only
+            # inside canary re-executions (faults.canary_scope), so
+            # primary dispatches trace the exact production program.
+            drift_epochs = _lane_epochs(faults.active_drift_fault())
+            out = None
+            if nan_epochs is None and drift_epochs is None:
+                # The AOT executable-cache seam (simulation.aot):
+                # fault-free dispatches resolve the batched program by
+                # content — hit = deserialized executable (bitwise the
+                # JIT path), miss = JIT as today + publish. Self-guards
+                # against the sharded shard_map re-entry (is-tracing
+                # check inside) and is a None fast path with no cache.
+                from yuma_simulation_tpu.simulation.aot import (
+                    dispatch_via_cache,
+                )
+
+                batch_kwargs = dict(
+                    spec=spec,
+                    save_bonds=save_bonds,
+                    save_incentives=save_incentives,
+                    consensus_impl=cons,
+                    guard_nonfinite=quarantine,
+                    capture_numerics=capture,
+                )
+                out = dispatch_via_cache(
+                    _simulate_batch_xla,
+                    (weights, stakes, reset_index, reset_epoch, config),
+                    dict(batch_kwargs, miner_mask=miner_mask),
+                    static_names=tuple(batch_kwargs),
+                    label=f"simulate_batch:{rung}",
+                )
+            if out is None:
+                out = _simulate_batch_xla(
+                    weights,
+                    stakes,
+                    reset_index,
+                    reset_epoch,
+                    config,
+                    spec,
+                    save_bonds=save_bonds,
+                    save_incentives=save_incentives,
+                    consensus_impl=cons,
+                    miner_mask=miner_mask,
+                    guard_nonfinite=quarantine,
+                    nan_fault_epochs=nan_epochs,
+                    capture_numerics=capture,
+                    drift_fault_epochs=drift_epochs,
+                )
         if retry_policy is not None or deadline is not None:
             out = jax.block_until_ready(out)
         return out

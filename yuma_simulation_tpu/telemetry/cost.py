@@ -252,6 +252,17 @@ def _normalize_cost_analysis(analysis) -> dict:
     return merged
 
 
+def hlo_fingerprint(lowered, *, digits: Optional[int] = 16) -> str:
+    """sha256 of a ``jax.stages.Lowered`` program's HLO text — THE
+    content-address of a compiled program. One spelling, two consumers:
+    the cost records here truncate it to 16 hex digits for display, and
+    the AOT executable cache (:mod:`..simulation.aot`) keys its on-disk
+    artifacts on the full digest (``digits=None``) so two programs whose
+    HLO differs anywhere can never collide onto one executable."""
+    digest = hashlib.sha256(lowered.as_text().encode()).hexdigest()
+    return digest if digits is None else digest[:digits]
+
+
 def capture_compiled(
     lowered, *, engine: str, V: int, M: int, epochs: int
 ) -> CostRecord:
@@ -265,9 +276,7 @@ def capture_compiled(
         engine=engine, backend=jax.default_backend(), V=V, M=M, epochs=epochs
     )
     try:
-        record.hlo_fingerprint = hashlib.sha256(
-            lowered.as_text().encode()
-        ).hexdigest()[:16]
+        record.hlo_fingerprint = hlo_fingerprint(lowered)
     except Exception as e:
         record.reason = f"as_text failed: {e}"
     try:

@@ -258,6 +258,29 @@ EVENTS = {
         "burn-rate alert recovered to ok",
         consumers=("serve.service",),
     ),
+    # -- AOT executable cache (simulation.aot) ---------------------------
+    "executable_cache_hit": EventSpec(
+        "one published executable deserialized and dispatched (cold "
+        "start skipped a compile)",
+        operator_reason="cold-start forensics: one record per program "
+        "load; the executable_cache_hits counter is the reconciled "
+        "aggregate the CI cold-start lane asserts on via "
+        "cache_stats.json",
+    ),
+    "executable_cache_miss": EventSpec(
+        "no loadable artifact for this program (reason: absent / "
+        "corrupt / torn / undeserializable) — dispatch requeued to JIT",
+        operator_reason="typed miss taxonomy: a corrupt or truncated "
+        "artifact must surface as a greppable reason, never a crash or "
+        "a silent slow start",
+    ),
+    "executable_cache_stale": EventSpec(
+        "artifacts for this exact program exist only under another "
+        "toolchain/device — rebuilt instead of misexecuted",
+        operator_reason="upgrade forensics: a jax/jaxlib bump or a "
+        "device swap shows up as stale misses, the signal to re-warm "
+        "the cache",
+    ),
     # -- scenario foundry ------------------------------------------------
     "scenario_compiled": EventSpec(
         "one foundry ScenarioSpec materialized to dense Scenario arrays",
@@ -366,6 +389,23 @@ METRICS = {
     ),
     "serve_canary_drift": MetricSpec(
         "counter", "serve canary comparisons that confirmed drift",
+    ),
+    # -- AOT executable cache (simulation.aot) ---------------------------
+    "executable_cache_hits": MetricSpec(
+        "counter", "published executables deserialized from the cache "
+        "(compiles skipped)",
+    ),
+    "executable_cache_misses": MetricSpec(
+        "counter", "cache lookups with no loadable artifact (absent or "
+        "corrupt — dispatch requeued to JIT)",
+    ),
+    "executable_cache_stale": MetricSpec(
+        "counter", "lookups that found only other-toolchain/device "
+        "artifacts for the program",
+    ),
+    "executable_cache_builds": MetricSpec(
+        "counter", "programs AOT-exported and published after a miss "
+        "(true compiles, counted by RecompilationSentinel budgets)",
     ),
     # -- scenario foundry ------------------------------------------------
     "scenarios_generated": MetricSpec(

@@ -99,6 +99,15 @@ class RecompilationSentinel:
     functions (0 = the region must be compile-free; N allows the
     expected cold compiles of a first-call region).
 
+    Under the AOT executable cache (:mod:`..simulation.aot`) the
+    sentinel distinguishes cache-hit LOADS from true compiles: an AOT
+    **build** (a cache miss that exported a program — a real compile)
+    counts against the budget exactly like a tracked re-trace, so a
+    budget-0 pin stays a zero-compile pin even when dispatches route
+    around the tracked jit entries; a cache-hit load costs no budget
+    (the whole point of the cache) but is reported on ``aot_hits`` so
+    a region's cache effectiveness is assertable.
+
     The check runs on clean exit only — an exception inside the region
     propagates untouched (a failing test must not be masked by a
     budget report).
@@ -124,6 +133,11 @@ class RecompilationSentinel:
         #: ``{qualname: (before, after)}``
         self.report: dict[str, tuple[int, int]] = {}
         self.new_entries: Optional[int] = None
+        #: AOT executable-cache activity inside the region, filled at
+        #: exit: hits are free loads, builds are true compiles (counted
+        #: into ``new_entries``).
+        self.aot_hits: int = 0
+        self.aot_builds: int = 0
 
     @staticmethod
     def _name(fn) -> str:
@@ -131,8 +145,22 @@ class RecompilationSentinel:
             fn, "__name__", repr(fn)
         )
 
+    @staticmethod
+    def _aot_snapshot() -> tuple[int, int]:
+        """(hits, builds) of the process AOT cache — zeros when none is
+        active (the import is deferred so the sentinel keeps working in
+        stripped environments)."""
+        try:
+            from yuma_simulation_tpu.simulation.aot import process_stats
+
+            stats = process_stats()
+            return stats.hits, stats.builds
+        except Exception:
+            return 0, 0
+
     def __enter__(self) -> "RecompilationSentinel":
         self._before = [fn._cache_size() for fn in self._functions]
+        self._aot_before = self._aot_snapshot()
         self._t0 = time.perf_counter()
         return self
 
@@ -150,8 +178,16 @@ class RecompilationSentinel:
         # Per-function positive deltas only: a cache shrink elsewhere
         # (eviction, jax.clear_caches) must not cancel out a genuine
         # re-trace in another tracked function.
-        self.new_entries = sum(
-            max(0, a - b) for b, a in self.report.values()
+        aot_after = self._aot_snapshot()
+        self.aot_hits = max(0, aot_after[0] - self._aot_before[0])
+        self.aot_builds = max(0, aot_after[1] - self._aot_before[1])
+        # An AOT build IS a compile (a miss that exported a program);
+        # only cache-hit LOADS are budget-free — without this, routing
+        # a dispatch through the executable cache would let a cold
+        # compile slip past a zero-warm-compile pin unseen.
+        self.new_entries = (
+            sum(max(0, a - b) for b, a in self.report.values())
+            + self.aot_builds
         )
         if self.new_entries:
             # Observability side-channel: every new entry a sentinel
@@ -192,6 +228,12 @@ class RecompilationSentinel:
                 for name, (b, a) in self.report.items()
                 if a != b
             )
+            if self.aot_builds:
+                detail = ", ".join(
+                    part
+                    for part in (detail, f"aot builds: {self.aot_builds}")
+                    if part
+                )
             raise RecompilationBudgetExceeded(
                 f"{self.label}: {self.new_entries} new jit-cache "
                 f"entr{'y' if self.new_entries == 1 else 'ies'} exceed the "
