@@ -309,6 +309,109 @@ def _measure_cold_start() -> dict:
     }
 
 
+#: Shape for the what-if suffix-resume drill (epochs, V, M) and the
+#: epoch the perturbation lands on. FIXED so the speedup line stays
+#: commit-to-commit comparable. A stride-8 baseline checkpoints at 32,
+#: so the what-if resumes there: 8 suffix epochs vs 40 full, epoch
+#: ratio 5 — the in-record floor tools/perfgate.py's `check_whatif`
+#: derives its bar from. The shape is deliberately CPU-lane sized
+#: (the flagship 256x4096 costs seconds per epoch on a CI runner);
+#: the epoch RATIO, which is what the gate normalizes by, matches the
+#: flagship's 40-epoch window shape.
+WHATIF_SHAPE = (40, 128, 1024)
+WHATIF_RESUME_EPOCH = 32
+WHATIF_STRIDE = 8
+
+
+def _measure_whatif() -> dict:
+    """The `whatif` history object: wall seconds of one what-if served
+    by suffix resume from a cached epoch-state checkpoint vs the same
+    perturbed world re-simulated end to end — both through the real
+    :func:`yuma_simulation_tpu.replay.whatif.run_whatif` product path
+    (baseline load, delta computation and telemetry included), warm
+    programs (best-of-3 after a warmup rep, so compiles are excluded
+    and the ratio measures the suffix economics, not jit). A failure
+    yields an explicit error object — the perfgate structural gate
+    fails the record rather than silently shipping a history without
+    the metric."""
+    import tempfile
+
+    from yuma_simulation_tpu.replay.statecache import StateCache
+    from yuma_simulation_tpu.replay.whatif import WhatIfSpec, run_whatif
+    from yuma_simulation_tpu.scenarios.base import Scenario
+
+    E, WV, WM = WHATIF_SHAPE
+    version = "Yuma 1 (paper)"
+    rng = np.random.default_rng(14)
+    W = rng.random((E, WV, WM)).astype(np.float32)
+    W /= W.sum(axis=2, keepdims=True)
+    S = (rng.random((E, WV)) + 0.1).astype(np.float32)
+    validators = [f"v{i}" for i in range(WV)]
+    scenario = Scenario(
+        name="bench_whatif",
+        validators=validators,
+        base_validator=validators[0],
+        weights=W,
+        stakes=S,
+        num_epochs=E,
+    )
+    spec = WhatIfSpec(
+        netuid=0,
+        version=version,
+        from_epoch=WHATIF_RESUME_EPOCH,
+        stake_scale=((1, 2.0),),
+    )
+    try:
+        with tempfile.TemporaryDirectory(prefix="yuma-whatif-") as root:
+            cache = StateCache(root)
+            meta = cache.build_baseline(
+                scenario,
+                version,
+                scenario_fingerprint="bench_whatif",
+                stride=WHATIF_STRIDE,
+            )
+
+            def cached():
+                return run_whatif(
+                    cache, meta, scenario, YumaConfig(), spec, use_cache=True
+                )
+
+            def full():
+                return run_whatif(
+                    cache, meta, scenario, YumaConfig(), spec, use_cache=False
+                )
+
+            result = cached()
+            if not result.cache_hit:
+                return {
+                    "shape": f"{E}x{WV}x{WM}",
+                    "error": "warmup what-if missed the state cache",
+                }
+            full()  # warm the full-length program too
+            suffix_seconds = min(
+                time_it(cached) for _ in range(3)
+            )
+            full_seconds = min(time_it(full) for _ in range(3))
+    except Exception as exc:  # noqa: BLE001 — the record carries it
+        return {"shape": f"{E}x{WV}x{WM}", "error": f"{type(exc).__name__}: {exc}"}
+    ratio = E / (E - result.resume_epoch)
+    return {
+        "shape": f"{E}x{WV}x{WM}",
+        "resume_epoch": int(result.resume_epoch),
+        "epochs": E,
+        "epoch_ratio": round(ratio, 3),
+        "full_seconds": round(full_seconds, 6),
+        "suffix_seconds": round(suffix_seconds, 6),
+        "speedup": round(full_seconds / suffix_seconds, 3),
+    }
+
+
+def time_it(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     parser.add_argument(
@@ -341,6 +444,13 @@ def main(argv=None) -> None:
         help="skip the fresh-subprocess cold-start measurement (two "
         "python startups); like --skip-costs, the structural gate "
         "fails a record without it by design",
+    )
+    parser.add_argument(
+        "--skip-whatif",
+        action="store_true",
+        help="skip the what-if suffix-resume speedup measurement; like "
+        "--skip-costs, the structural gate fails a record without it "
+        "by design",
     )
     args = parser.parse_args(argv)
     if args.smoke:
@@ -656,9 +766,13 @@ def _bench(args) -> None:
         cold_start = (
             {} if args.skip_cold_start else _measure_cold_start()
         )
+        # The what-if suffix-resume economics (ISSUE 14): one cached
+        # suffix what-if vs the same perturbed world end to end, warm.
+        whatif = {} if args.skip_whatif else _measure_whatif()
         _append_history(line, primary_impl, primary, smoke=args.smoke,
                         skip_costs=args.skip_costs, history=args.history,
-                        numerics=numerics_overhead, cold_start=cold_start)
+                        numerics=numerics_overhead, cold_start=cold_start,
+                        whatif=whatif)
 
 
 def _append_history(
@@ -671,6 +785,7 @@ def _append_history(
     history: str,
     numerics: Optional[dict] = None,
     cold_start: Optional[dict] = None,
+    whatif: Optional[dict] = None,
 ) -> dict:
     """One richer record per run into the JSONL history perfgate gates
     on: the stdout fields + per-metric dispersion + the AOT cost report
@@ -724,6 +839,9 @@ def _append_history(
         # Cold-start first-dispatch seconds (fresh subprocess, cold vs
         # cache-warm) — a tracked, perfgate-gated metric (ISSUE 13).
         "cold_start": cold_start if cold_start is not None else {},
+        # What-if suffix-resume speedup (cached carry vs full re-sim)
+        # — a tracked, perfgate-gated metric (ISSUE 14).
+        "whatif": whatif if whatif is not None else {},
         # Declared floors for perfgate's attained-fraction gate: the
         # distance-to-ceiling itself is gated, not just absolute rates.
         "attained_floor": dict(ATTAINED_FLOORS),

@@ -136,6 +136,8 @@ TEST_CHUNKS = [
         "tests/unit/test_slo.py",
         "tests/unit/test_propagation.py",
         "tests/unit/test_numerics.py",
+        "tests/unit/test_replay.py",
+        "tests/unit/test_suffix_resume.py",
     ],
 ]
 
@@ -288,6 +290,47 @@ def scenarios(session: nox.Session) -> None:
     session.run(
         "python", "-m", "tools.driftreport", bundle, "--check", "--require"
     )
+
+
+@nox.session
+def replay(session: nox.Session) -> None:
+    """Replay lane (mirrors the CI `replay` job): the suffix-resume
+    property suite (randomized checkpoint epochs bitwise on every
+    engine rung + under streaming) and the chain-replay battery, then
+    the drill — synthetic 3-snapshot timeline -> trailing-window fleet
+    sweep -> two served what-ifs against one state cache (the second
+    must be a state_cache_hit with zero AOT builds) — with the serve
+    bundle and every fleet store gated by obsreport and driftreport."""
+    session.install("-e", ".[test]")
+    session.run(
+        "python", "-m", "pytest",
+        "tests/unit/test_suffix_resume.py",
+        "tests/unit/test_replay.py",
+        "-q",
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    import glob
+    import os
+
+    bundle = os.path.join(session.create_tmp(), "replay-bundle")
+    import shutil
+
+    shutil.rmtree(bundle, ignore_errors=True)
+    session.run(
+        "python", "-m", "yuma_simulation_tpu.replay", "--drill",
+        "--bundle-dir", bundle,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    session.run(
+        "python", "-m", "tools.obsreport",
+        os.path.join(bundle, "serve"), "--check",
+    )
+    for store in sorted(glob.glob(os.path.join(bundle, "store", "subnet_*", "*"))):
+        session.run("python", "-m", "tools.obsreport", store, "--check")
+        session.run(
+            "python", "-m", "tools.driftreport", store,
+            "--check", "--require",
+        )
 
 
 @nox.session

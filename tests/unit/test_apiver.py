@@ -16,6 +16,12 @@ _FROZEN_SURFACE = [
     "Scenario",
     "SimulationClient",
     "SimulationHyperparameters",
+    # -- chain replay (0.18.0, additive): the snapshot-timeline
+    # archive, the epoch-state cache, what-if specs, and the
+    # trailing-window fleet sweep.
+    "SnapshotArchive",
+    "StateCache",
+    "WhatIfSpec",
     "YumaConfig",
     "YumaParams",
     "YumaSimulationNames",
@@ -29,6 +35,7 @@ _FROZEN_SURFACE = [
     "run_simulation",
     "serve",
     "stake_churn_scenario",
+    "sweep_trailing_window",
     "takeover_scenario",
     "weight_copier_scenario",
 ]

@@ -343,6 +343,17 @@ def _history_record(value, cv=0.02, smoke=False, backend="cpu", t=0.0,
             "first_dispatch_seconds_warm": 3.5,
             "warm_aot": {"hits": 1, "misses": 0, "builds": 0},
         },
+        # The 0.18.0 schema: the what-if suffix-resume speedup is a
+        # first-class gated metric (structural + ratio-floor gates).
+        "whatif": {
+            "shape": "40x128x1024",
+            "resume_epoch": 32,
+            "epochs": 40,
+            "epoch_ratio": 5.0,
+            "full_seconds": 0.15,
+            "suffix_seconds": 0.045,
+            "speedup": 3.3,
+        },
     }
     record.update(overrides)
     return record

@@ -1,0 +1,540 @@
+"""Chain-replay service battery (ISSUE 14): the snapshot-timeline
+archive contract (monotone blocks, content addressing, idempotent
+re-publish, no history rewrites), the state cache's LRU bound and
+corruption degradation, what-if spec JSON round-trips and validation,
+the cached-vs-uncached bitwise pin, the serve tier's what-if/replay
+endpoints (suffix-sized admission included), and the report tooling
+(obsreport's replay section, perfgate's whatif gate)."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.foundry.metagraph import synthetic_snapshot
+from yuma_simulation_tpu.replay import (
+    ArchiveError,
+    ReplayService,
+    SnapshotArchive,
+    StateCache,
+    WhatIfError,
+    WhatIfSpec,
+    run_whatif,
+    synthetic_timeline,
+)
+
+NETUID = 9
+VERSION = "Yuma 2 (Adrian-Fish)"
+
+
+def _archive(tmp_path, snapshots=3, seed=1):
+    arch = SnapshotArchive(tmp_path / "archive")
+    synthetic_timeline(
+        arch,
+        NETUID,
+        snapshots=snapshots,
+        seed=seed,
+        num_validators=3,
+        num_miners=4,
+    )
+    return arch
+
+
+# ---------------------------------------------------------------- archive
+
+
+class TestArchive:
+    def test_timeline_round_trip_and_content_addressing(self, tmp_path):
+        arch = _archive(tmp_path)
+        entries = arch.timeline(NETUID)
+        assert [e.block for e in entries] == [1000, 1100, 1200]
+        snap = arch.load(NETUID, 1100)
+        assert snap.block == 1100 and snap.num_miners == 4
+        # deterministic generator: same seed -> same content address
+        again = SnapshotArchive(tmp_path / "again")
+        e2 = synthetic_timeline(
+            again, NETUID, snapshots=3, seed=1,
+            num_validators=3, num_miners=4,
+        )
+        assert [e.key for e in entries] == [e.key for e in e2]
+
+    def test_append_is_idempotent_but_never_rewrites(self, tmp_path):
+        arch = _archive(tmp_path)
+        snap = synthetic_snapshot(
+            1, num_validators=3, num_miners=4, netuid=NETUID, block=1000
+        )
+        assert arch.append(snap).block == 1000  # idempotent no-op
+        assert len(arch.timeline(NETUID)) == 3
+        rewritten = synthetic_snapshot(
+            99, num_validators=3, num_miners=4, netuid=NETUID, block=1000
+        )
+        with pytest.raises(ArchiveError, match="does not rewrite"):
+            arch.append(rewritten)
+
+    def test_non_monotone_and_shape_drift_rejected(self, tmp_path):
+        arch = _archive(tmp_path)
+        stale = synthetic_snapshot(
+            5, num_validators=3, num_miners=4, netuid=NETUID, block=1150
+        )
+        with pytest.raises(ArchiveError, match="append-only"):
+            arch.append(stale)
+        reshaped = synthetic_snapshot(
+            5, num_validators=4, num_miners=4, netuid=NETUID, block=1300
+        )
+        with pytest.raises(ArchiveError, match="drifts"):
+            arch.append(reshaped)
+
+    def test_corrupt_blob_detected(self, tmp_path):
+        arch = _archive(tmp_path)
+        entry = arch.timeline(NETUID)[0]
+        blob = arch._blob_path(NETUID, entry.key)
+        blob.write_bytes(b"torn" + blob.read_bytes()[4:])
+        with pytest.raises(ArchiveError, match="content address"):
+            arch.load(NETUID, entry.block)
+
+    def test_unknown_subnet_and_window_scenario(self, tmp_path):
+        arch = _archive(tmp_path)
+        with pytest.raises(ArchiveError, match="no timeline"):
+            arch.timeline(4242)
+        scenario = arch.window_scenario(
+            NETUID, window=2, epochs_per_snapshot=3
+        )
+        assert scenario.weights.shape == (6, 3, 4)
+        # snapshot i's rows hold for its 3 epochs, then switch
+        assert np.array_equal(scenario.weights[0], scenario.weights[2])
+        assert not np.array_equal(scenario.weights[2], scenario.weights[3])
+        fp_full = arch.timeline_fingerprint(NETUID)
+        fp_win = arch.timeline_fingerprint(NETUID, window=2)
+        assert fp_full != fp_win
+
+
+# ------------------------------------------------------------- state cache
+
+
+class TestStateCache:
+    def test_lru_eviction_bound(self, tmp_path):
+        from tests.unit.test_suffix_resume import _scenario
+
+        cache = StateCache(tmp_path / "cache", max_baselines=2)
+        keys = []
+        for i in range(3):
+            meta = cache.build_baseline(
+                _scenario(seed=i),
+                "Yuma 1 (paper)",
+                scenario_fingerprint=f"lru-{i}",
+                stride=4,
+                engine="xla",
+            )
+            keys.append(meta.key)
+        assert len(cache.keys()) == 2
+        assert keys[0] not in cache.keys()  # oldest evicted whole
+        assert keys[1] in cache.keys() and keys[2] in cache.keys()
+
+    def test_identical_build_is_idempotent(self, tmp_path):
+        from tests.unit.test_suffix_resume import _scenario
+
+        cache = StateCache(tmp_path / "cache")
+        a = cache.build_baseline(
+            _scenario(seed=1), "Yuma 1 (paper)",
+            scenario_fingerprint="idem", stride=4, engine="xla",
+        )
+        b = cache.build_baseline(
+            _scenario(seed=1), "Yuma 1 (paper)",
+            scenario_fingerprint="idem", stride=4, engine="xla",
+        )
+        assert a.key == b.key and len(cache.keys()) == 1
+
+    def test_resume_epoch_picks_nearest_checkpoint(self, tmp_path):
+        from tests.unit.test_suffix_resume import _scenario
+
+        cache = StateCache(tmp_path / "cache")
+        meta = cache.build_baseline(
+            _scenario(seed=2), "Yuma 1 (paper)",
+            scenario_fingerprint="near", stride=3, engine="xla",
+        )
+        assert meta.checkpoints == (3, 6, 9)
+        assert cache.resume_epoch(meta.key, 2) == 0
+        assert cache.resume_epoch(meta.key, 3) == 3
+        assert cache.resume_epoch(meta.key, 8) == 6
+        assert cache.resume_epoch(meta.key, 9) == 9
+
+    def test_corrupt_state_degrades_to_full_run(self, tmp_path):
+        from tests.unit.test_suffix_resume import _scenario
+
+        scenario = _scenario(seed=4)
+        cache = StateCache(tmp_path / "cache")
+        meta = cache.build_baseline(
+            scenario, VERSION,
+            scenario_fingerprint="corrupt", stride=4, engine="xla",
+        )
+        spec = WhatIfSpec(
+            netuid=NETUID, version=VERSION, from_epoch=9,
+            stake_scale=((0, 2.0),),
+        )
+        clean = run_whatif(
+            cache, meta, scenario, None, spec, use_cache=True
+        )
+        assert clean.cache_hit and clean.resume_epoch == 8
+        cache._state_path(meta.key, 8).write_bytes(b"rot")
+        degraded = run_whatif(
+            cache, meta, scenario, None, spec, use_cache=True
+        )
+        assert not degraded.cache_hit and degraded.epochs_simulated == 10
+        np.testing.assert_array_equal(
+            degraded.dividends, clean.dividends
+        )
+
+
+# ----------------------------------------------------------------- whatif
+
+
+class TestWhatIfSpec:
+    def test_json_round_trip_and_key_stability(self):
+        spec = WhatIfSpec(
+            netuid=3,
+            version=VERSION,
+            from_epoch=5,
+            hparams=(("bond_alpha", 0.2),),
+            weight_rows=((1, (0.25, 0.75, 0.0, 0.0)),),
+            stake_scale=((0, 1.5),),
+        )
+        again = WhatIfSpec.from_json(json.loads(json.dumps(spec.to_json())))
+        assert again == spec
+        assert again.spec_key() == spec.spec_key()
+        other = dataclasses.replace(spec, from_epoch=6)
+        assert other.spec_key() != spec.spec_key()
+
+    @pytest.mark.parametrize(
+        "payload,match",
+        [
+            ({"netuid": 1, "version": VERSION}, "must perturb"),
+            (
+                {"netuid": 1, "version": VERSION, "from_epoch": -1,
+                 "stake_scale": [[0, 2.0]]},
+                "from_epoch",
+            ),
+            (
+                {"netuid": 1, "version": VERSION,
+                 "hparams": [["liquid_alpha", 1.0]]},
+                "not what-if-settable",
+            ),
+            (
+                {"netuid": 1, "version": VERSION,
+                 "stake_scale": [[0, -2.0]]},
+                "finite number",
+            ),
+            (
+                {"netuid": 1, "version": VERSION, "bogus": 1,
+                 "stake_scale": [[0, 2.0]]},
+                "unknown what-if fields",
+            ),
+            # a non-numeric pair value must be the TYPED spec error
+            # (admission turns WhatIfError into a 400; a bare
+            # ValueError would escape as a 503)
+            (
+                {"netuid": 1, "version": VERSION,
+                 "stake_scale": [[1, "x"]]},
+                "stake_scale entry",
+            ),
+            (
+                {"netuid": 1, "version": VERSION,
+                 "weight_rows": [[0, 7]]},
+                "weight_rows entry",
+            ),
+        ],
+    )
+    def test_invalid_specs_are_typed(self, payload, match):
+        with pytest.raises(WhatIfError, match=match):
+            WhatIfSpec.from_json(payload)
+
+    def test_out_of_range_indices_rejected_at_apply(self, tmp_path):
+        from tests.unit.test_suffix_resume import _scenario
+
+        scenario = _scenario(seed=5)
+        cache = StateCache(tmp_path / "cache")
+        meta = cache.build_baseline(
+            scenario, VERSION,
+            scenario_fingerprint="oob", stride=4, engine="xla",
+        )
+        for spec, match in [
+            (
+                WhatIfSpec(netuid=1, version=VERSION,
+                           stake_scale=((99, 2.0),)),
+                "out of range",
+            ),
+            (
+                WhatIfSpec(netuid=1, version=VERSION, from_epoch=10,
+                           stake_scale=((0, 2.0),)),
+                "beyond",
+            ),
+            (
+                WhatIfSpec(netuid=1, version=VERSION,
+                           weight_rows=((0, (1.0, 0.0)),)),
+                "miners",
+            ),
+        ]:
+            with pytest.raises(WhatIfError, match=match):
+                run_whatif(cache, meta, scenario, None, spec)
+
+
+@pytest.mark.parametrize("rung", ("xla", "fused_scan", "fused_scan_mxu"))
+def test_whatif_cached_equals_uncached_every_rung(tmp_path, rung):
+    """The acceptance pin: a what-if's cached suffix resume is bitwise
+    the uncached end-to-end run of the same perturbed world — per
+    engine rung (the fused pair in interpret mode off-TPU), with both
+    an array perturbation and a piecewise hparam delta in play."""
+    from tests.unit.test_suffix_resume import _scenario
+
+    from yuma_simulation_tpu.models.config import YumaConfig
+
+    scenario = _scenario(seed=6)
+    cache = StateCache(tmp_path / "cache")
+    meta = cache.build_baseline(
+        scenario,
+        VERSION,
+        scenario_fingerprint=f"rung-{rung}",
+        stride=4,
+        engine=rung,
+    )
+    spec = WhatIfSpec(
+        netuid=1,
+        version=VERSION,
+        from_epoch=9,
+        stake_scale=((1, 2.0),),
+        hparams=(("bond_alpha", 0.15),),
+    )
+    cached = run_whatif(
+        cache, meta, scenario, YumaConfig(), spec, use_cache=True
+    )
+    uncached = run_whatif(
+        cache, meta, scenario, YumaConfig(), spec, use_cache=False
+    )
+    assert cached.cache_hit and cached.resume_epoch == 8
+    assert cached.epochs_simulated == 2 and uncached.epochs_simulated == 10
+    np.testing.assert_array_equal(cached.dividends, uncached.dividends)
+    np.testing.assert_array_equal(cached.incentives, uncached.incentives)
+
+
+class TestReplayService:
+    def test_miss_then_hit_bitwise_and_counters(self, tmp_path):
+        from yuma_simulation_tpu.telemetry.metrics import get_registry
+
+        _archive(tmp_path)
+        svc = ReplayService(
+            tmp_path / "archive", tmp_path / "cache",
+            epochs_per_snapshot=4, stride=4,
+        )
+        spec = WhatIfSpec(
+            netuid=NETUID, version=VERSION, from_epoch=9,
+            weight_rows=((0, (1.0, 0.0, 0.0, 0.0)),),
+        )
+        reg = get_registry()
+        hits0 = reg.counter("state_cache_hits").value
+        misses0 = reg.counter("state_cache_misses").value
+        saved0 = reg.counter("replay_suffix_epochs_saved").value
+        first = svc.whatif(spec)
+        assert not first.cache_hit and first.epochs_simulated == 12
+        second = svc.whatif(spec)
+        assert second.cache_hit and second.resume_epoch == 8
+        assert second.epochs_simulated == 4 and second.epochs_saved == 8
+        np.testing.assert_array_equal(first.dividends, second.dividends)
+        np.testing.assert_array_equal(
+            first.dividend_delta, second.dividend_delta
+        )
+        # the perturbation is causal: zero delta before from_epoch
+        assert np.abs(second.dividend_delta[:9]).max() == 0
+        assert np.abs(second.dividend_delta[9:]).max() > 0
+        assert reg.counter("state_cache_hits").value == hits0 + 1
+        assert reg.counter("state_cache_misses").value == misses0 + 1
+        assert (
+            reg.counter("replay_suffix_epochs_saved").value == saved0 + 8
+        )
+
+    def test_describe_prices_suffix_sized(self, tmp_path):
+        _archive(tmp_path)
+        svc = ReplayService(
+            tmp_path / "archive", tmp_path / "cache",
+            epochs_per_snapshot=4, stride=4,
+        )
+        spec = WhatIfSpec(
+            netuid=NETUID, version=VERSION, from_epoch=9,
+            stake_scale=((1, 2.0),),
+        )
+        before = svc.describe(spec)
+        assert before["cached"] is False and before["suffix_epochs"] == 12
+        svc.whatif(spec)
+        after = svc.describe(spec)
+        assert after["cached"] is True
+        assert after["resume_epoch"] == 8 and after["suffix_epochs"] == 4
+
+
+# ------------------------------------------------------------- serve tier
+
+
+@pytest.fixture
+def replay_server(tmp_path):
+    from yuma_simulation_tpu.serve.server import (
+        SimulationServer,
+        wait_until_ready,
+    )
+    from yuma_simulation_tpu.serve.service import ServeConfig
+
+    _archive(tmp_path)
+    server = SimulationServer(
+        ServeConfig(
+            bundle_dir=str(tmp_path / "serve"),
+            replay_archive_dir=str(tmp_path / "archive"),
+            replay_cache_dir=str(tmp_path / "cache"),
+            replay_epochs_per_snapshot=4,
+            replay_stride=4,
+        )
+    ).start()
+    assert wait_until_ready(server.url)
+    try:
+        yield server, tmp_path
+    finally:
+        server.close()
+
+
+class TestServeWhatIf:
+    def test_endpoints_end_to_end(self, replay_server):
+        from yuma_simulation_tpu.serve.server import SimulationClient
+
+        server, tmp_path = replay_server
+        client = SimulationClient(server.url, tenant="t-replay")
+        idx = client.replay()
+        assert idx.status == 200
+        assert [s["netuid"] for s in idx.body["subnets"]] == [NETUID]
+        tl = client.replay(NETUID)
+        assert tl.status == 200 and tl.body["epochs"] == 12
+        assert client.replay(777).status == 404
+
+        spec = {
+            "netuid": NETUID,
+            "version": VERSION,
+            "from_epoch": 9,
+            "stake_scale": [[1, 2.0]],
+        }
+        first = client.whatif(spec)
+        assert first.status == 200 and first.body["cache_hit"] is False
+        second = client.whatif(spec)
+        assert second.status == 200 and second.body["cache_hit"] is True
+        assert second.body["epochs_simulated"] == 4
+        assert second.body["epochs_saved"] == 8
+        assert (
+            second.body["total_dividend_delta"]
+            == first.body["total_dividend_delta"]
+        )
+        assert second.request_id is not None
+
+    def test_admission_rejections_are_typed(self, replay_server):
+        from yuma_simulation_tpu.serve.server import SimulationClient
+
+        server, _ = replay_server
+        client = SimulationClient(server.url)
+        r = client.whatif(
+            {"netuid": 404, "version": VERSION, "stake_scale": [[0, 2.0]]}
+        )
+        assert r.status == 400 and r.body["reason"] == "unknown_subnet"
+        r = client.whatif({"netuid": NETUID, "version": VERSION})
+        assert r.status == 400 and "perturb" in r.body["message"]
+        r = client.whatif(
+            {"netuid": NETUID, "version": "Yuma nonesuch",
+             "stake_scale": [[0, 2.0]]}
+        )
+        assert r.status == 400
+
+    def test_bundle_ledger_and_obsreport_section(self, replay_server):
+        from yuma_simulation_tpu.serve.server import SimulationClient
+
+        server, tmp_path = replay_server
+        client = SimulationClient(server.url, tenant="render-me")
+        spec = {
+            "netuid": NETUID,
+            "version": VERSION,
+            "from_epoch": 9,
+            "stake_scale": [[0, 3.0]],
+        }
+        assert client.whatif(spec).status == 200
+        assert client.whatif(spec).status == 200
+        server.close()
+        from yuma_simulation_tpu.telemetry.flight import (
+            check_bundle,
+            load_bundle,
+        )
+
+        bundle = load_bundle(tmp_path / "serve")
+        assert check_bundle(bundle) == []
+        served = [
+            r for r in bundle.ledger if r.get("event") == "whatif_served"
+        ]
+        assert len(served) == 2
+        assert served[1]["cache_hit"] is True
+        assert served[1]["suffix_epochs"] == 4
+        assert served[1]["full_epochs"] == 12
+        from tools.obsreport import render_replay
+
+        lines = "\n".join(render_replay(bundle))
+        assert "tenant render-me" in lines and "suffix resume" in lines
+
+    def test_unconfigured_replay_rejects(self):
+        from yuma_simulation_tpu.serve.service import (
+            ServeConfig,
+            SimulationService,
+        )
+
+        svc = SimulationService(ServeConfig(start_dispatcher=False))
+        try:
+            status, body, _ = svc.handle(
+                "whatif",
+                {
+                    "whatif": {
+                        "netuid": 1,
+                        "version": VERSION,
+                        "stake_scale": [[0, 2.0]],
+                    }
+                },
+            )
+            assert status == 400
+            assert body["reason"] == "replay_unconfigured"
+            assert svc.replay_get("/v1/replay")[0] == 404
+        finally:
+            svc.close()
+
+
+# ----------------------------------------------------------- perfgate gate
+
+
+class TestPerfgateWhatIf:
+    def _record(self, **whatif):
+        return {
+            "whatif": {
+                "full_seconds": 0.5,
+                "suffix_seconds": 0.1,
+                "speedup": 5.0,
+                "epoch_ratio": 5.0,
+                **whatif,
+            }
+        }
+
+    def test_structural_requires_whatif_fields(self):
+        from tools.perfgate import check_structure
+
+        problems = check_structure({"whatif": {}, "value": 1.0})
+        assert any("whatif.speedup" in p for p in problems)
+        problems = check_structure(
+            {"whatif": {"error": "boom"}, "value": 1.0}
+        )
+        assert any("boom" in p for p in problems)
+
+    def test_speedup_floor_derives_from_epoch_ratio(self):
+        from tools.perfgate import check_whatif
+
+        assert check_whatif(self._record()) == []
+        failures = check_whatif(self._record(speedup=1.2))
+        assert failures and "epoch ratio" in failures[0]
+        # a barely-saving resume (ratio ~1) is vacuously fine at >= 1x
+        assert check_whatif(
+            self._record(speedup=1.01, epoch_ratio=1.05)
+        ) == []

@@ -1,0 +1,227 @@
+"""Suffix-resume correctness (ISSUE 14): `simulate(initial_state=
+cache[k])` over epochs [k, E) must be BITWISE the tail of the
+monolithic run — dividends, incentives, AND the per-epoch
+NumericsSketch fingerprints — on every engine rung (XLA scan, fused
+Pallas VPU, fused Pallas MXU — the fused rungs in interpret mode off-
+TPU, exactly like the streaming pins) and under chunked streaming.
+Randomized checkpoint epochs k make this a property, not a spot check:
+the carry hand-off must be exact at EVERY epoch boundary, because the
+chain-replay state cache (replay/statecache.py) checkpoints at
+arbitrary strides and the what-if API resumes at whichever checkpoint
+precedes the perturbation.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.scenarios.base import Scenario
+from yuma_simulation_tpu.simulation.engine import (
+    simulate,
+    validate_initial_state,
+)
+
+E, V, M = 10, 3, 4
+
+#: Every engine rung of the planner ladder; the fused pair runs in
+#: interpret mode on CPU (correct but slow — shapes here are tiny).
+ALL_RUNGS = ("xla", "fused_scan", "fused_scan_mxu")
+
+#: Carry-structure coverage: plain EMA, the EMA_PREV w_prev carry leg,
+#: and a reset-mode variant (the reset fires at a GLOBAL epoch, so a
+#: resumed suffix must honor the offset, not its local index).
+VERSIONS = ("Yuma 1 (paper)", "Yuma 2 (Adrian-Fish)", "Yuma 3.1 (Rhef+reset)")
+
+
+def _scenario(seed: int = 0, reset: bool = False) -> Scenario:
+    rng = np.random.default_rng(seed)
+    W = rng.random((E, V, M)).astype(np.float32)
+    W /= W.sum(axis=2, keepdims=True)
+    S = (rng.random((E, V)) + 0.1).astype(np.float32)
+    validators = [f"v{i}" for i in range(V)]
+    return Scenario(
+        name=f"suffix_resume_{seed}",
+        validators=validators,
+        base_validator=validators[0],
+        weights=W,
+        stakes=S,
+        num_epochs=E,
+        reset_bonds_index=1 if reset else None,
+        reset_bonds_epoch=6 if reset else None,
+    )
+
+
+def _suffix(scenario: Scenario, k: int) -> Scenario:
+    return dataclasses.replace(
+        scenario,
+        weights=scenario.weights[k:],
+        stakes=scenario.stakes[k:],
+        num_epochs=E - k,
+    )
+
+
+def _assert_tail_bitwise(full, suffix, k: int, label: str) -> None:
+    np.testing.assert_array_equal(
+        suffix.dividends, full.dividends[k:], err_msg=f"{label}: dividends"
+    )
+    np.testing.assert_array_equal(
+        suffix.incentives,
+        full.incentives[k:],
+        err_msg=f"{label}: incentives",
+    )
+    if full.numerics is not None and suffix.numerics is not None:
+        for stream, sketch in full.numerics.items():
+            np.testing.assert_array_equal(
+                suffix.numerics[stream].fingerprint,
+                sketch.fingerprint[k:],
+                err_msg=f"{label}: {stream} fingerprints",
+            )
+
+
+@pytest.mark.parametrize("rung", ALL_RUNGS)
+@pytest.mark.parametrize("version", VERSIONS)
+def test_suffix_resume_bitwise_every_rung(rung, version):
+    """Property: for randomized k, prefix-run state at k feeds a suffix
+    run that is bitwise the monolithic tail — per rung, per carry
+    structure, reset rules included."""
+    scenario = _scenario(seed=7, reset="reset" in version)
+    full = simulate(
+        scenario, version, save_incentives=True, epoch_impl=rung
+    )
+    rng = np.random.default_rng(hash((rung, version)) % (2**32))
+    for k in sorted(rng.choice(np.arange(1, E), size=3, replace=False)):
+        k = int(k)
+        prefix = simulate(
+            dataclasses.replace(
+                scenario,
+                weights=scenario.weights[:k],
+                stakes=scenario.stakes[:k],
+                num_epochs=k,
+            ),
+            version,
+            save_incentives=True,
+            epoch_impl=rung,
+            return_state=True,
+        )
+        # The prefix itself must be the monolithic head.
+        np.testing.assert_array_equal(
+            prefix.dividends, full.dividends[:k], err_msg=f"prefix k={k}"
+        )
+        suffix = simulate(
+            _suffix(scenario, k),
+            version,
+            save_incentives=True,
+            epoch_impl=rung,
+            initial_state=prefix.final_state,
+            epoch_offset=k,
+        )
+        _assert_tail_bitwise(full, suffix, k, f"{rung}/{version} k={k}")
+
+
+@pytest.mark.parametrize("version", ("Yuma 2 (Adrian-Fish)",))
+def test_suffix_resume_bitwise_under_streaming(version):
+    """The streamed path accepts the same initial_state/epoch_offset
+    and stays bitwise — resumed chunked runs are how a beyond-HBM
+    what-if would dispatch."""
+    scenario = _scenario(seed=11)
+    full = simulate(scenario, version, save_incentives=True, epoch_impl="xla")
+    for k in (3, 7):
+        prefix = simulate(
+            dataclasses.replace(
+                scenario,
+                weights=scenario.weights[:k],
+                stakes=scenario.stakes[:k],
+                num_epochs=k,
+            ),
+            version,
+            save_incentives=True,
+            epoch_impl="xla",
+            return_state=True,
+        )
+        suffix = simulate(
+            _suffix(scenario, k),
+            version,
+            save_incentives=True,
+            epoch_impl="xla",
+            initial_state=prefix.final_state,
+            epoch_offset=k,
+            max_resident_epochs=2,  # forces the chunked streaming driver
+        )
+        _assert_tail_bitwise(full, suffix, k, f"streamed k={k}")
+
+
+@pytest.mark.parametrize("rung", ("xla", "fused_scan"))
+def test_statecache_checkpoints_resume_bitwise(tmp_path, rung):
+    """The satellite's exact claim: `simulate(initial_state=cache[k])`
+    over [k, E) is bitwise the monolithic tail for EVERY checkpoint the
+    state cache stored — through the real build/load path (serialize ->
+    publish_atomic -> deserialize), randomized stride."""
+    from yuma_simulation_tpu.replay.statecache import StateCache
+
+    version = "Yuma 2 (Adrian-Fish)"
+    scenario = _scenario(seed=23)
+    full = simulate(
+        scenario, version, save_incentives=True, epoch_impl=rung
+    )
+    rng = np.random.default_rng(23)
+    stride = int(rng.integers(2, 5))
+    cache = StateCache(tmp_path / f"cache-{rung}")
+    meta = cache.build_baseline(
+        scenario,
+        version,
+        scenario_fingerprint=f"prop-{rung}",
+        stride=stride,
+        engine=rung,
+    )
+    assert meta.checkpoints, "stride < E must checkpoint at least once"
+    baseline = cache.load_baseline(meta.key)
+    np.testing.assert_array_equal(baseline["dividends"], full.dividends)
+    np.testing.assert_array_equal(baseline["incentives"], full.incentives)
+    for k in meta.checkpoints:
+        state = cache.load_state(meta.key, k)
+        suffix = simulate(
+            _suffix(scenario, k),
+            version,
+            save_incentives=True,
+            epoch_impl=rung,
+            initial_state=state,
+            epoch_offset=k,
+        )
+        _assert_tail_bitwise(
+            full, suffix, k, f"cache[{k}] stride={stride} {rung}"
+        )
+
+
+def test_return_state_roundtrips_and_validates():
+    """The carry contract: final_state round-trips as initial_state,
+    and shape/key mistakes are typed ValueErrors, not XLA crashes."""
+    scenario = _scenario(seed=3)
+    version = "Yuma 2 (Adrian-Fish)"
+    res = simulate(scenario, version, return_state=True)
+    state = res.final_state
+    assert set(state) == {"bonds", "consensus", "w_prev"}
+    assert state["bonds"].shape == (V, M)
+    from yuma_simulation_tpu.models.variants import variant_for_version
+
+    spec = variant_for_version(version)
+    validate_initial_state(state, spec, V, M)
+    with pytest.raises(ValueError, match="lacks 'w_prev'"):
+        validate_initial_state(
+            {"bonds": state["bonds"], "consensus": state["consensus"]},
+            spec,
+            V,
+            M,
+        )
+    with pytest.raises(ValueError, match="shape"):
+        validate_initial_state(
+            {**state, "bonds": state["bonds"][:-1]}, spec, V, M
+        )
+    with pytest.raises(ValueError, match="unknown keys"):
+        validate_initial_state({**state, "extra": state["bonds"]}, spec, V, M)
+    with pytest.raises(ValueError, match="epoch_offset"):
+        simulate(scenario, version, epoch_offset=-1)
+    # A variant that does NOT carry w_prev rejects a carry that has it.
+    spec1 = variant_for_version("Yuma 1 (paper)")
+    with pytest.raises(ValueError, match="unknown keys"):
+        validate_initial_state(state, spec1, V, M)

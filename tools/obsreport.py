@@ -169,7 +169,57 @@ def render(bundle, run_id: str | None) -> str:
     if foundry:
         lines.append("")
         lines.extend(foundry)
+    replay = render_replay(bundle)
+    if replay:
+        lines.append("")
+        lines.extend(replay)
     return "\n".join(lines)
+
+
+def render_replay(bundle) -> list[str]:
+    """The chain-replay section: cache effectiveness and suffix-vs-full
+    epoch savings, per tenant, aggregated from the serve ledger's
+    ``whatif_served`` records, cross-read against the process counters
+    (``state_cache_hits`` / ``state_cache_misses`` /
+    ``replay_suffix_epochs_saved``) of the last metrics snapshot."""
+    served = [
+        r for r in bundle.ledger if r.get("event") == "whatif_served"
+    ]
+    counters = (
+        bundle.metrics[-1].get("counters", {}) if bundle.metrics else {}
+    )
+    hits = counters.get("state_cache_hits", 0)
+    misses = counters.get("state_cache_misses", 0)
+    saved = counters.get("replay_suffix_epochs_saved", 0)
+    if not served and not (hits or misses):
+        return []
+    lines = ["chain replay (what-ifs & state cache):"]
+    total = (hits or 0) + (misses or 0)
+    ratio = f"{hits / total:.0%}" if total else "n/a"
+    lines.append(
+        f"  cache: hits={_num(hits)} misses={_num(misses)} "
+        f"(hit ratio {ratio}), suffix epochs saved={_num(saved)}"
+    )
+    tenants: dict[str, dict] = {}
+    for rec in served:
+        t = tenants.setdefault(
+            str(rec.get("tenant", "?")),
+            {"whatifs": 0, "hits": 0, "suffix": 0, "full": 0},
+        )
+        t["whatifs"] += 1
+        t["hits"] += 1 if rec.get("cache_hit") else 0
+        t["suffix"] += int(rec.get("suffix_epochs", 0))
+        t["full"] += int(rec.get("full_epochs", 0))
+    for tenant, t in sorted(tenants.items()):
+        pct = (
+            f"{1 - t['suffix'] / t['full']:.0%}" if t["full"] else "n/a"
+        )
+        lines.append(
+            f"  tenant {tenant}: whatifs={t['whatifs']} "
+            f"cache_hits={t['hits']} simulated {t['suffix']} of "
+            f"{t['full']} epochs ({pct} saved by suffix resume)"
+        )
+    return lines
 
 
 def render_foundry(bundle, run_id: str) -> list[str]:

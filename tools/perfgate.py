@@ -51,7 +51,7 @@ DEFAULT_NOISE_MULT = 3.0
 REQUIRED_FIELDS = (
     "t", "backend", "smoke", "metric", "value", "unit", "secondary",
     "cv", "costs", "rooflines", "attained_floor", "numerics",
-    "cold_start",
+    "cold_start", "whatif",
 )
 
 #: Fields the ``cold_start`` object must carry as numbers (0.17.0:
@@ -63,6 +63,26 @@ COLD_START_FIELDS = (
     "first_dispatch_seconds_cold",
     "first_dispatch_seconds_warm",
 )
+
+#: Fields the ``whatif`` object must carry as numbers (0.18.0: one
+#: cached suffix-resume what-if vs the same perturbed world end to end
+#: — bench.py `_measure_whatif`). A record without them is schema rot:
+#: the chain-replay economics ISSUE 14 gates on cannot silently drop
+#: out of the history.
+WHATIF_FIELDS = (
+    "full_seconds",
+    "suffix_seconds",
+    "speedup",
+    "epoch_ratio",
+)
+
+#: The what-if speedup floor as a fraction of the record's own epoch
+#: ratio: resuming at epoch k of E gives an ideal speedup of
+#: ``E / (E - k)`` (the epoch ratio); fixed per-request costs (baseline
+#: load, delta computation, dispatch) eat into it, so the gate demands
+#: at least this fraction — a what-if that re-simulates only 20% of the
+#: epochs must be measurably, not just theoretically, faster.
+WHATIF_SPEEDUP_FLOOR_FRAC = 0.4
 
 #: The numerics-capture overhead ceiling (ISSUE 10 acceptance: the
 #: in-scan per-epoch sketch capture must cost < 5% epochs/s on the
@@ -161,6 +181,28 @@ def check_structure(record: dict) -> list[str]:
                         + (
                             f" (measurement error: {cold['error']!r})"
                             if "error" in cold
+                            else ""
+                        )
+                    )
+    whatif = record.get("whatif")
+    if "whatif" in record:
+        if not isinstance(whatif, dict):
+            problems.append("whatif must be an object")
+        else:
+            for field in WHATIF_FIELDS:
+                if not isinstance(whatif.get(field), (int, float)):
+                    problems.append(
+                        f"whatif.{field} is "
+                        + (
+                            "missing"
+                            if whatif.get(field) is None
+                            else f"invalid ({whatif.get(field)!r})"
+                        )
+                        + " — the what-if suffix-resume speedup is a "
+                        "first-class gated metric"
+                        + (
+                            f" (measurement error: {whatif['error']!r})"
+                            if "error" in whatif
                             else ""
                         )
                     )
@@ -277,6 +319,39 @@ def check_cold_start(
             f"cache-warm first dispatch took {warm:.3f}s, above the "
             f"--cold-start-ceiling of {ceiling:.3f}s (cold run: "
             f"{cold.get('first_dispatch_seconds_cold')}s)"
+        ]
+    return []
+
+
+def check_whatif(
+    record: dict, floor_frac: float = WHATIF_SPEEDUP_FLOOR_FRAC
+) -> list[str]:
+    """The what-if suffix-resume gate: the record's measured speedup
+    (full re-simulation seconds / cached suffix seconds) must reach at
+    least ``floor_frac`` of the record's own epoch ratio — the floor is
+    derived from the SAME record (resuming at epoch k of E bounds the
+    ideal speedup at ``E / (E - k)``), so no cross-run baseline is
+    needed and the gate is active in ``--structural`` too. Vacuous when
+    the record carries no usable whatif object — the STRUCTURAL gate
+    already fails that."""
+    whatif = record.get("whatif")
+    if not isinstance(whatif, dict):
+        return []
+    speedup = whatif.get("speedup")
+    ratio = whatif.get("epoch_ratio")
+    if not isinstance(speedup, (int, float)) or not isinstance(
+        ratio, (int, float)
+    ):
+        return []
+    floor = max(1.0, floor_frac * float(ratio))
+    if speedup < floor:
+        return [
+            f"what-if suffix resume sped up only {speedup:.2f}x against "
+            f"an epoch ratio of {ratio:.2f} (floor "
+            f"{floor_frac:.0%} of ratio = {floor:.2f}x; full "
+            f"{whatif.get('full_seconds')}s vs suffix "
+            f"{whatif.get('suffix_seconds')}s) — the cached carry is "
+            "not paying for itself"
         ]
     return []
 
@@ -434,6 +509,14 @@ def main(argv=None) -> int:
         "--structural too: the cold_start pair is an in-record "
         "measurement, no baseline needed)",
     )
+    parser.add_argument(
+        "--whatif-floor-frac", type=float,
+        default=WHATIF_SPEEDUP_FLOOR_FRAC, metavar="FRAC",
+        help="fail --check when the what-if suffix-resume speedup falls "
+        "below this fraction of the record's own epoch ratio (default "
+        f"{WHATIF_SPEEDUP_FLOOR_FRAC}; active in --structural too: the "
+        "pair is one in-record measurement)",
+    )
     parser.add_argument("--json", action="store_true")
     parser.add_argument(
         "--report", default=None,
@@ -462,6 +545,7 @@ def main(argv=None) -> int:
     cold_start_failures = check_cold_start(
         latest, args.cold_start_ceiling
     )
+    whatif_failures = check_whatif(latest, args.whatif_floor_frac)
     result: dict = {
         "history": args.history,
         "records": len(history),
@@ -469,6 +553,7 @@ def main(argv=None) -> int:
         "attained_failures": attained_failures,
         "numerics_failures": numerics_failures,
         "cold_start_failures": cold_start_failures,
+        "whatif_failures": whatif_failures,
     }
     if not args.structural:
         result.update(
@@ -516,6 +601,14 @@ def main(argv=None) -> int:
             print(f"perfgate: COLD-START: {f}", file=sys.stderr)
         if args.check:
             return 1
+    if whatif_failures:
+        # Also active in --structural: the speedup-vs-epoch-ratio pair
+        # is one in-record measurement, the floor derived from the
+        # record itself.
+        for f in whatif_failures:
+            print(f"perfgate: WHATIF-SPEEDUP: {f}", file=sys.stderr)
+        if args.check:
+            return 1
     regressions = [
         k
         for k, v in result.get("verdicts", {}).items()
@@ -558,6 +651,18 @@ def _render(result: dict, latest: dict) -> None:
             f"  cold-start: cold "
             f"{cold.get('first_dispatch_seconds_cold')}s -> warm "
             f"{cold.get('first_dispatch_seconds_warm')}s"
+        )
+    whatif = latest.get("whatif") or {}
+    if result.get("whatif_failures"):
+        print(
+            f"  whatif-speedup: BELOW FLOOR "
+            f"({whatif.get('speedup')}x vs ratio "
+            f"{whatif.get('epoch_ratio')})"
+        )
+    elif isinstance(whatif.get("speedup"), (int, float)):
+        print(
+            f"  whatif-speedup: {whatif.get('speedup')}x suffix resume "
+            f"(epoch ratio {whatif.get('epoch_ratio')})"
         )
     numerics = result.get("numerics_failures", [])
     overhead = (latest.get("numerics") or {}).get("overhead_frac")

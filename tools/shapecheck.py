@@ -285,6 +285,53 @@ def _run_streamed(b: ShapeBucket, spec, cfg, *, fused: bool) -> str:
     return "; ".join(p for p in (problems, problems2) if p)
 
 
+def _run_suffix_resume(b: ShapeBucket, spec, cfg, *, rung: str) -> str:
+    """The suffix-resume entry point (0.18.0 — the chain-replay state
+    cache's engine seam): the PLAIN engines called with a supplied
+    carry, a traced epoch offset, and ``return_carry=True``. The
+    carry-out must round-trip structurally identical to the carry-in
+    (a ``state_<k>.npz`` from one segment must feed the next segment's
+    ``initial_state=`` for any k), and the ys contract must be the
+    ordinary per-epoch one — checked per engine rung across every
+    planner bucket, still zero compiles."""
+    W, S, ri, re_ = _engine_inputs(b)
+    carry_in = _carry_struct(b, spec)
+    offset = _sds((), jnp.int32)
+    if rung == "xla":
+
+        def call(W, S, ri, re_, cfg, c, off):
+            return engine._simulate_scan(
+                W, S, ri, re_, cfg, spec,
+                save_bonds=False, save_incentives=True,
+                consensus_impl="bisect",
+                carry=c, epoch_offset=off, return_carry=True,
+            )
+    else:
+
+        def call(W, S, ri, re_, cfg, c, off):
+            return engine._simulate_case_fused(
+                W, S, ri, re_, cfg, spec,
+                save_bonds=False, save_incentives=True,
+                mxu=rung == "fused_scan_mxu",
+                carry=c, epoch_offset=off, return_carry=True,
+            )
+
+    ys, carry_out = jax.eval_shape(
+        call, W, S, ri, re_, cfg, carry_in, offset
+    )
+    E, V, M = max(1, b.epochs), b.padded_V, b.padded_M
+    problems = _tree_mismatches(carry_out, carry_in, "carry")
+    problems2 = _tree_mismatches(
+        ys,
+        {
+            "dividends": _sds((E, V), jnp.float32),
+            "incentives": _sds((E, M), jnp.float32),
+        },
+        "ys",
+    )
+    return "; ".join(p for p in (problems, problems2) if p)
+
+
 def _run_batched(b: ShapeBucket, spec, cfg) -> str:
     E, V, M = max(1, b.epochs), b.padded_V, b.padded_M
     B = max(1, b.batch)
@@ -482,6 +529,12 @@ def run_shapecheck(cfg: Optional[YumaConfig] = None) -> list[CheckResult]:
                 record("engine-mxu", tag, _run_fused(b, spec, cfg, mxu=True))
                 record("streamed-xla", tag, _run_streamed(b, spec, cfg, fused=False))
                 record("streamed-fused", tag, _run_streamed(b, spec, cfg, fused=True))
+                for rung in COVERED_RUNGS:
+                    record(
+                        f"suffix-resume-{rung}",
+                        tag,
+                        _run_suffix_resume(b, spec, cfg, rung=rung),
+                    )
             except Exception as exc:  # abstract trace itself failed
                 record(
                     "engine", tag, f"abstract trace raised {type(exc).__name__}: {exc}"

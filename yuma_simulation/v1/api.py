@@ -11,6 +11,9 @@ from yuma_simulation_tpu.v1.api import (  # noqa: F401
     Scenario,
     SimulationClient,
     SimulationHyperparameters,
+    SnapshotArchive,
+    StateCache,
+    WhatIfSpec,
     YumaConfig,
     YumaParams,
     YumaSimulationNames,
@@ -22,6 +25,7 @@ from yuma_simulation_tpu.v1.api import (  # noqa: F401
     run_simulation,
     serve,
     stake_churn_scenario,
+    sweep_trailing_window,
     takeover_scenario,
     weight_copier_scenario,
 )
@@ -31,6 +35,9 @@ __all__ = [
     "Scenario",
     "SimulationClient",
     "SimulationHyperparameters",
+    "SnapshotArchive",
+    "StateCache",
+    "WhatIfSpec",
     "YumaConfig",
     "YumaParams",
     "YumaSimulationNames",
@@ -42,6 +49,7 @@ __all__ = [
     "run_simulation",
     "serve",
     "stake_churn_scenario",
+    "sweep_trailing_window",
     "takeover_scenario",
     "weight_copier_scenario",
 ]

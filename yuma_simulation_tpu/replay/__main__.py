@@ -1,0 +1,278 @@
+"""The chain-replay drill: the CI ``replay`` lane's engine.
+
+``python -m yuma_simulation_tpu.replay --drill --bundle-dir DIR`` runs
+the whole product loop end to end on CPU, deterministically:
+
+1. seed a synthetic 3-snapshot timeline into ``DIR/archive`` (the
+   foundry generator — no network, no fixtures);
+2. run the trailing-window fleet sweep over it (every requested variant
+   as lease-claimed, 100%-canaried fleet units) into ``DIR/store`` —
+   the driftreport-gated bundles — refreshing the epoch-state cache at
+   ``DIR/cache``;
+3. serve two identical what-ifs through a real HTTP server mounted on
+   the archive with a FRESH state cache (flight bundle at
+   ``DIR/serve``): the first is the typed **state_cache_miss** that
+   builds and checkpoints the baseline, the second a **state_cache
+   hit** that re-simulates only the suffix, adds **zero AOT builds**,
+   and returns bitwise the first's deltas.
+
+CI then gates the artifacts with ``obsreport --check`` (serve bundle +
+fleet stores) and ``driftreport --check --require`` (fleet stores),
+the same gates every other drill bundle passes. Exit 0 only when every
+expectation held and the sweep saw no drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+DRILL_VERSIONS = ("Yuma 1 (paper)", "Yuma 2 (Adrian-Fish)")
+
+
+def run_drill(args) -> int:
+    import pathlib
+
+    from yuma_simulation_tpu.replay import (
+        SnapshotArchive,
+        StateCache,
+        synthetic_timeline,
+        sweep_trailing_window,
+    )
+    from yuma_simulation_tpu.serve.server import (
+        SimulationClient,
+        SimulationServer,
+        wait_until_ready,
+    )
+    from yuma_simulation_tpu.serve.service import ServeConfig
+    from yuma_simulation_tpu.simulation.aot import process_stats
+    from yuma_simulation_tpu.telemetry.metrics import get_registry
+    from yuma_simulation_tpu.utils import setup_logging
+    from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+
+    setup_logging()
+    target = pathlib.Path(args.bundle_dir)
+    failures: list[str] = []
+
+    def expect(cond: bool, what: str) -> None:
+        print(("ok   " if cond else "FAIL ") + what)
+        if not cond:
+            failures.append(what)
+
+    # 1. the synthetic timeline (deterministic: same seed -> same bits).
+    archive = SnapshotArchive(target / "archive")
+    entries = synthetic_timeline(
+        archive,
+        args.netuid,
+        snapshots=3,
+        seed=args.seed,
+        num_validators=args.validators,
+        num_miners=args.miners,
+    )
+    expect(
+        len(entries) == 3
+        and [e.block for e in entries]
+        == sorted(e.block for e in entries),
+        f"timeline seeded: 3 snapshots at blocks "
+        f"{[e.block for e in entries]}",
+    )
+
+    # 2. the trailing-window fleet sweep (canaries on, stores gated by
+    # CI's driftreport pass).
+    cache = StateCache(target / "cache")
+    summary = sweep_trailing_window(
+        archive,
+        cache,
+        store_root=target / "store",
+        versions=list(args.versions),
+        epochs_per_snapshot=args.epochs_per_snapshot,
+        stride=args.stride,
+        canary_fraction=1.0,
+        unit_size=1,
+    )
+    expect(
+        summary["units_completed"] == len(args.versions),
+        f"fleet sweep published {summary['units_completed']} unit(s) "
+        f"across {len(args.versions)} variant(s)",
+    )
+    expect(
+        summary["canaries_run"] >= len(args.versions),
+        f"every sweep unit ran its numerics canary "
+        f"({summary['canaries_run']} run)",
+    )
+    expect(
+        summary["drift_events"] == 0,
+        f"sweep drift-clean (drift_events={summary['drift_events']})",
+    )
+
+    # 3. two what-ifs through a real server mounted on the swept state.
+    E = 3 * args.epochs_per_snapshot
+    perturb_epoch = E - args.epochs_per_snapshot + 1
+    spec = {
+        "netuid": args.netuid,
+        "version": args.versions[0],
+        "from_epoch": perturb_epoch,
+        "stake_scale": [[1, 2.0]],
+        "weight_rows": [[0, [1.0] + [0.0] * (args.miners - 1)]],
+    }
+    # The serve tier gets its OWN state cache (not the sweep's), so
+    # what-if #1 exercises the full miss path end to end — typed
+    # state_cache_miss, baseline build, checkpoints published — and
+    # what-if #2 proves the hit path returns bitwise the same deltas.
+    server = SimulationServer(
+        ServeConfig(
+            bundle_dir=str(target / "serve"),
+            replay_archive_dir=str(target / "archive"),
+            replay_cache_dir=str(target / "serve-cache"),
+            replay_epochs_per_snapshot=args.epochs_per_snapshot,
+            replay_stride=args.stride,
+            executable_cache_dir=str(target / "aot"),
+        )
+    ).start()
+    try:
+        expect(wait_until_ready(server.url), "server answers /healthz")
+        client = SimulationClient(server.url, tenant="replay-drill")
+        r = client.replay(args.netuid)
+        expect(
+            r.status == 200 and r.body.get("epochs") == E,
+            f"GET /v1/replay/{args.netuid} -> {E}-epoch window "
+            f"(got {r.status} {r.body.get('epochs')})",
+        )
+        first = client.whatif(spec)
+        expect(
+            first.status == 200 and first.body.get("status") == "ok",
+            f"what-if #1 -> 200 ok (got {first.status} "
+            f"{first.body.get('error')})",
+        )
+        expect(
+            first.body.get("cache_hit") is False
+            and first.body.get("epochs_simulated") == E,
+            f"what-if #1 is the typed miss that builds the baseline "
+            f"(got cache_hit={first.body.get('cache_hit')} "
+            f"epochs={first.body.get('epochs_simulated')})",
+        )
+        hits_before = get_registry().counter("state_cache_hits").value
+        builds_before = process_stats().builds
+        second = client.whatif(spec)
+        hits_after = get_registry().counter("state_cache_hits").value
+        builds_after = process_stats().builds
+        expect(
+            second.status == 200 and second.body.get("cache_hit") is True,
+            f"what-if #2 is a state_cache_hit (got "
+            f"{second.body.get('cache_hit')})",
+        )
+        expect(
+            hits_after == hits_before + 1,
+            f"state_cache_hits counted the hit "
+            f"({hits_before} -> {hits_after})",
+        )
+        expect(
+            builds_after == builds_before,
+            f"what-if #2 added zero AOT builds "
+            f"({builds_before} -> {builds_after})",
+        )
+        suffix = second.body.get("epochs_simulated")
+        saved = second.body.get("epochs_saved")
+        expect(
+            isinstance(suffix, int)
+            and isinstance(saved, int)
+            and suffix + saved == E
+            and suffix <= E - args.stride + args.epochs_per_snapshot
+            and saved > 0,
+            f"suffix-sized re-simulation: {suffix} of {E} epochs "
+            f"({saved} saved)",
+        )
+        expect(
+            first.body.get("total_dividend_delta")
+            == second.body.get("total_dividend_delta"),
+            "hit-path deltas bitwise the miss-path build's",
+        )
+    finally:
+        server.close()
+
+    publish_atomic(
+        target / "drill_summary.json",
+        json.dumps(
+            {
+                "netuid": args.netuid,
+                "versions": list(args.versions),
+                "stores": summary["stores"],
+                "serve_bundle": str(target / "serve"),
+                "failures": failures,
+            },
+            indent=2,
+            sort_keys=True,
+        ).encode(),
+    )
+    print(
+        f"\nreplay drill {'FAILED' if failures else 'passed'}: "
+        f"{len(entries)} snapshots -> {summary['units_completed']} fleet "
+        f"unit(s) -> 2 what-ifs (stores: {', '.join(summary['stores'])})"
+    )
+    return 1 if failures else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m yuma_simulation_tpu.replay",
+        description=__doc__.split("\n\n")[0],
+    )
+    parser.add_argument(
+        "--drill",
+        action="store_true",
+        help="run the chain-replay drill (CI smoke; forces the CPU "
+        "backend)",
+    )
+    parser.add_argument(
+        "--bundle-dir",
+        default="replay-bundle",
+        help="drill output root (archive/, cache/, store/, serve/)",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--netuid", type=int, default=0)
+    parser.add_argument(
+        "--validators", type=int, default=3,
+        help="synthetic subnet validator count",
+    )
+    parser.add_argument(
+        "--miners", type=int, default=4,
+        help="synthetic subnet miner count",
+    )
+    parser.add_argument("--epochs-per-snapshot", type=int, default=4)
+    parser.add_argument(
+        "--stride", type=int, default=4,
+        help="carry-checkpoint stride of the cached baselines",
+    )
+    parser.add_argument(
+        "--versions",
+        nargs="+",
+        default=list(DRILL_VERSIONS),
+        help="Yuma variants the trailing-window sweep runs",
+    )
+    args = parser.parse_args(argv)
+    if not args.drill:
+        parser.print_help()
+        return 2
+
+    import pathlib
+
+    target = pathlib.Path(args.bundle_dir)
+    if target.exists() and any(target.iterdir()):
+        # A resumed drill satisfies sweep units from the prior run's
+        # store and hits a pre-warmed cache — refuse, like the other
+        # drills do.
+        print(
+            f"--bundle-dir {args.bundle_dir!r} exists and is not empty; "
+            "point the drill at a fresh directory",
+            file=sys.stderr,
+        )
+        return 2
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    return run_drill(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -35,6 +35,10 @@ def _build_config(args, **overrides) -> "ServeConfig":  # noqa: F821
         ),
         canary_interval_seconds=args.canary_interval,
         executable_cache_dir=args.executable_cache,
+        replay_archive_dir=args.replay_archive,
+        replay_cache_dir=args.replay_cache,
+        replay_epochs_per_snapshot=args.replay_epochs_per_snapshot,
+        replay_stride=args.replay_stride,
     )
 
 
@@ -230,6 +234,29 @@ def main(argv=None) -> int:
         "preloads published executables, misses publish for the next "
         "worker, and JAX's persistent compilation cache is enabled "
         "beside it — the cold-start knob (README 'Cold start')",
+    )
+    parser.add_argument(
+        "--replay-archive",
+        default=None,
+        metavar="DIR",
+        help="snapshot-timeline archive root (replay/): mounts "
+        "POST /v1/whatif and GET /v1/replay when --replay-cache is "
+        "also set",
+    )
+    parser.add_argument(
+        "--replay-cache",
+        default=None,
+        metavar="DIR",
+        help="epoch-state cache root for what-if suffix resume",
+    )
+    parser.add_argument(
+        "--replay-epochs-per-snapshot", type=int, default=4,
+        help="epochs each archived snapshot contributes to the replay "
+        "scenario",
+    )
+    parser.add_argument(
+        "--replay-stride", type=int, default=8,
+        help="carry-checkpoint stride (epochs) of cached baselines",
     )
     parser.add_argument(
         "--smoke",

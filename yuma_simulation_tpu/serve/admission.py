@@ -54,7 +54,7 @@ class AdmissionTicket:
 
     request_id: str
     tenant: str
-    kind: str  # "simulate" | "sweep" | "table"
+    kind: str  # "simulate" | "sweep" | "table" | "whatif"
     version: str
     scenario: Optional[object]  # Scenario for simulate/sweep
     config: object  # YumaConfig
@@ -73,6 +73,12 @@ class AdmissionTicket:
     #: shedding, requests below the configured floor are dropped first
     #: (0 = normal traffic; negotiated tenants send higher).
     priority: int = 0
+    #: The parsed :class:`..replay.whatif.WhatIfSpec` for
+    #: ``kind="whatif"`` requests (None otherwise). The plan above is
+    #: SUFFIX-sized for these: admission prices the epochs the dispatch
+    #: will actually re-simulate from the cached checkpoint, not the
+    #: full baseline length.
+    whatif: Optional[object] = None
 
     def remaining_seconds(self) -> float:
         return self.deadline_seconds - (time.monotonic() - self.admitted_t)
@@ -248,9 +254,18 @@ def admit(
     default_deadline_seconds: float,
     max_unit_lanes: int = 64,
     tenant_priority: Optional[dict] = None,
+    replay=None,
 ) -> AdmissionTicket:
     """Validate and price one request; returns the ticket or raises a
-    typed :class:`AdmissionRejected`. Zero compiles by construction."""
+    typed :class:`AdmissionRejected`. Zero compiles by construction.
+
+    `replay` (a :class:`..replay.ReplayService`, None when the
+    deployment mounts no replay tier) admits ``kind="whatif"``: the
+    spec parses/validates from the payload's ``whatif`` object, the
+    subnet resolves against the archive index, and the plan prices the
+    SUFFIX the dispatch will actually simulate — ``describe()`` is
+    index/meta reads plus the planner's host arithmetic, so a what-if
+    admission stays as compile-free as every other kind."""
     from yuma_simulation_tpu.models.variants import variant_for_version
 
     if not isinstance(payload, dict):
@@ -259,9 +274,14 @@ def admit(
     if not isinstance(tenant, str) or not tenant:
         _reject("field 'tenant' must be a non-empty string")
     version = payload.get("version", "Yuma 1 (paper)")
+    if kind == "whatif" and "whatif" in payload:
+        raw_spec = payload["whatif"]
+        if isinstance(raw_spec, dict) and "version" in raw_spec:
+            # The what-if's variant rides the spec, not the envelope.
+            version = raw_spec["version"]
     try:
         variant_for_version(version)
-    except (ValueError, KeyError) as exc:
+    except (ValueError, KeyError, TypeError) as exc:
         _reject(f"unknown version {version!r}: {exc}")
     engine = payload.get("engine", "auto")
     if engine not in _ENGINES:
@@ -292,7 +312,43 @@ def admit(
     axes = None
     versions = None
     coalesce_key = None
-    if kind == "simulate":
+    whatif_spec = None
+    if kind == "whatif":
+        if replay is None:
+            _reject(
+                "this deployment mounts no replay tier (configure "
+                "replay_archive_dir/replay_cache_dir to serve what-ifs)",
+                reason="replay_unconfigured",
+            )
+        from yuma_simulation_tpu.replay import ArchiveError, WhatIfError
+        from yuma_simulation_tpu.replay.whatif import WhatIfSpec
+
+        try:
+            whatif_spec = WhatIfSpec.from_json(_require(payload, "whatif"))
+        except WhatIfError as exc:
+            _reject(str(exc))
+        try:
+            desc = replay.describe(whatif_spec)
+        except ArchiveError as exc:
+            _reject(str(exc), reason="unknown_subnet")
+        except WhatIfError as exc:
+            _reject(str(exc))
+        # Suffix-sized pricing: the dispatch re-simulates only
+        # [resume_epoch, E) from the cached checkpoint; that is the
+        # footprint admission charges (and the preflight bounds).
+        plan = _plan_or_reject(
+            f"serve:whatif:{request_id}",
+            (
+                max(1, desc["suffix_epochs"]),
+                desc["validators"],
+                desc["miners"],
+            ),
+            whatif_spec.version,
+            config,
+            engine="auto",
+            quarantine=False,
+        )
+    elif kind == "simulate":
         scenario = _build_scenario(payload, request_id)
         E, V, M = scenario.weights.shape
         plan = _plan_or_reject(
@@ -406,4 +462,5 @@ def admit(
         admitted_t=time.monotonic(),
         coalesce_key=coalesce_key,
         priority=priority,
+        whatif=whatif_spec,
     )

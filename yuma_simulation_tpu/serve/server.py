@@ -49,6 +49,7 @@ _ROUTES = {
     "/v1/simulate": "simulate",
     "/v1/sweep": "sweep",
     "/v1/table": "table",
+    "/v1/whatif": "whatif",
 }
 
 #: Largest accepted request body (bytes): bounds a hostile
@@ -88,6 +89,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/healthz":
                 self._send_json(200, self.service.healthz())
+            elif self.path == "/v1/replay" or self.path.startswith(
+                "/v1/replay/"
+            ):
+                status, body = self.service.replay_get(self.path)
+                self._send_json(status, body)
             elif self.path == "/metrics":
                 text = self.service.metrics_text().encode()
                 self.send_response(200)
@@ -372,6 +378,20 @@ class SimulationClient:
     def table(self, **payload) -> ServeResponse:
         """POST /v1/table — the total-dividends CSV across versions."""
         return self._post("/v1/table", payload)
+
+    def whatif(self, spec: dict, **payload) -> ServeResponse:
+        """POST /v1/whatif — `spec` is the
+        :class:`..replay.whatif.WhatIfSpec` JSON object (``netuid``,
+        ``version``, ``from_epoch``, ``hparams``/``weight_rows``/
+        ``stake_scale``); returns per-validator/per-miner dividend
+        deltas plus the suffix-resume accounting."""
+        return self._post("/v1/whatif", {**payload, "whatif": spec})
+
+    def replay(self, netuid: Optional[int] = None) -> ServeResponse:
+        """GET /v1/replay (the archive index) or /v1/replay/NETUID
+        (one subnet's timeline + cached baselines)."""
+        path = "/v1/replay" if netuid is None else f"/v1/replay/{netuid}"
+        return self._request("GET", path)
 
     def healthz(self) -> ServeResponse:
         return self._request("GET", "/healthz")

@@ -121,6 +121,22 @@ class ServeConfig:
     #: bits diverge from its own fallback. 0 disables (the default:
     #: a canary re-pays a bucket's compute).
     canary_interval_seconds: float = 0.0
+    #: Chain-replay mount (:mod:`..replay`): when BOTH directories are
+    #: set, the service answers ``POST /v1/whatif`` (admitted and
+    #: priced suffix-sized through the planner like every other
+    #: request) and ``GET /v1/replay[/NETUID]`` index reads. None
+    #: (default) leaves the replay tier unmounted — what-ifs reject
+    #: with a typed ``replay_unconfigured``.
+    replay_archive_dir: Optional[str] = None
+    replay_cache_dir: Optional[str] = None
+    #: trailing window (snapshots) a what-if replays; None = the whole
+    #: timeline.
+    replay_window: Optional[int] = None
+    replay_epochs_per_snapshot: int = 4
+    #: carry-checkpoint stride (epochs) of cached baselines.
+    replay_stride: int = 8
+    #: LRU bound on cached baseline trajectories.
+    replay_max_baselines: int = 64
     #: Test-only: construct the service without its dispatcher thread
     #: (so queue-bound behavior can be observed deterministically).
     start_dispatcher: bool = True
@@ -300,6 +316,22 @@ class SimulationService:
         )
         for shape in self.config.warmup_shapes:
             self._remember_canary_bucket(shape, "Yuma 1 (paper)")
+        # The chain-replay mount (ISSUE 14): archive + state cache
+        # behind one facade; what-ifs dispatch through the ordinary
+        # admission -> queue -> dispatcher pipeline, so quotas, SLO
+        # shedding, deadlines, and the flight bundle cover them too.
+        self.replay = None
+        if self.config.replay_archive_dir and self.config.replay_cache_dir:
+            from yuma_simulation_tpu.replay import ReplayService
+
+            self.replay = ReplayService(
+                self.config.replay_archive_dir,
+                self.config.replay_cache_dir,
+                window=self.config.replay_window,
+                epochs_per_snapshot=self.config.replay_epochs_per_snapshot,
+                stride=self.config.replay_stride,
+                max_baselines=self.config.replay_max_baselines,
+            )
         self._counter = itertools.count(1)
         self._stopping = False
         self._closed = False
@@ -537,6 +569,7 @@ class SimulationService:
                 # Price sweeps at the unit size _execute_sweep dispatches.
                 max_unit_lanes=self.config.max_batch * 8,
                 tenant_priority=self.config.tenant_priority,
+                replay=self.replay,
             )
         except AdmissionRejected as exc:
             self._admission_rejected.inc()
@@ -945,6 +978,8 @@ class SimulationService:
                     self._execute_simulate(group)
                 elif first.kind == "sweep":
                     self._execute_sweep(group[0])
+                elif first.kind == "whatif":
+                    self._execute_whatif(group[0])
                 else:
                     self._execute_table(group[0])
             except BaseException as exc:  # noqa: BLE001 — typed below
@@ -1134,6 +1169,98 @@ class SimulationService:
         if quarantined_points:
             body["quarantined_points"] = [int(i) for i in quarantined_points]
         pending.resolve(200, body)
+
+    def _execute_whatif(self, pending: _Pending) -> None:
+        remaining = self._remaining_or_fail([pending])
+        if remaining is None:
+            return
+        t = pending.ticket
+        assert self.replay is not None and t.whatif is not None
+        from yuma_simulation_tpu.resilience.watchdog import (
+            Deadline,
+            run_with_deadline,
+        )
+
+        result = run_with_deadline(
+            lambda: self.replay.whatif(t.whatif),
+            Deadline(budget_seconds=max(0.1, remaining)),
+            label=f"serve:whatif:{t.request_id}",
+        )
+        full_epochs = result.epochs_simulated + result.epochs_saved
+        # The per-request replay ledger record obsreport's replay
+        # section aggregates: cache effectiveness and the suffix-vs-full
+        # epoch savings, per tenant.
+        self._append_ledger(
+            "whatif_served",
+            request=t.request_id,
+            tenant=t.tenant,
+            netuid=t.whatif.netuid,
+            version=t.whatif.version,
+            cache_hit=result.cache_hit,
+            resume_epoch=result.resume_epoch,
+            suffix_epochs=result.epochs_simulated,
+            full_epochs=full_epochs,
+            epochs_saved=result.epochs_saved,
+        )
+        delta = result.dividend_delta
+        pending.resolve(
+            200,
+            {
+                "status": "ok",
+                "request_id": t.request_id,
+                "tenant": t.tenant,
+                "netuid": t.whatif.netuid,
+                "version": t.whatif.version,
+                "spec_key": t.whatif.spec_key(),
+                "from_epoch": t.whatif.from_epoch,
+                "cache_hit": result.cache_hit,
+                "resume_epoch": result.resume_epoch,
+                "epochs_simulated": result.epochs_simulated,
+                "epochs_saved": result.epochs_saved,
+                "total_dividend_delta": [
+                    float(x) for x in result.total_dividend_delta
+                ],
+                "total_incentive_delta": [
+                    float(x) for x in result.total_incentive_delta
+                ],
+                "max_abs_dividend_delta": float(np.abs(delta).max()),
+                "baseline_key": result.baseline_key,
+            },
+        )
+
+    def replay_get(self, path: str) -> tuple[int, dict]:
+        """The read-only replay surface (``GET /v1/replay`` index,
+        ``GET /v1/replay/NETUID`` one timeline + its cached baselines)
+        — index/meta reads only, served inline by the HTTP thread."""
+        from yuma_simulation_tpu.replay import ArchiveError
+
+        if self.replay is None:
+            return 404, {
+                "status": "rejected",
+                "error": "ReplayUnconfigured",
+                "message": "this deployment mounts no replay tier",
+            }
+        tail = path[len("/v1/replay"):].strip("/")
+        try:
+            if not tail:
+                return 200, {"status": "ok", **self.replay.index()}
+            if not tail.isdigit():
+                return 404, {
+                    "status": "rejected",
+                    "error": "NotFound",
+                    "message": f"no replay route {path!r} (want "
+                    "/v1/replay or /v1/replay/NETUID)",
+                }
+            return 200, {
+                "status": "ok",
+                **self.replay.timeline_info(int(tail)),
+            }
+        except ArchiveError as exc:
+            return 404, {
+                "status": "rejected",
+                "error": "UnknownSubnet",
+                "message": str(exc),
+            }
 
     def _execute_table(self, pending: _Pending) -> None:
         remaining = self._remaining_or_fail([pending])

@@ -71,6 +71,15 @@ class SimulationResult:
     #: (`{stream: ..carry.NumericsSketch of [E] numpy arrays}`, see
     #: telemetry.numerics) — None when YUMA_NUMERICS=0 disabled capture.
     numerics: Optional[dict] = None
+    #: The consensus carry AFTER the last simulated epoch, as host
+    #: arrays (``{"bonds" [V, M], "consensus" [M][, "w_prev" [V, M]]}``)
+    #: — populated only when :func:`simulate` was called with
+    #: ``return_state=True``. Feeding it back as ``initial_state=`` (+
+    #: the matching ``epoch_offset=``) continues the trajectory
+    #: bitwise-identically to an uninterrupted run — the suffix-resume
+    #: contract the chain-replay state cache (:mod:`..replay.statecache`)
+    #: is built on.
+    final_state: Optional[dict] = None
 
 
 def _miner_shardings(mesh: Mesh, num_miners: int):
@@ -601,6 +610,45 @@ def _resolve_save(flag, nbytes: int, name: str) -> bool:
     return flag
 
 
+def validate_initial_state(
+    initial_state, spec: VariantSpec, V: int, M: int
+) -> dict:
+    """The suffix-resume input contract: ``initial_state`` must be the
+    carry dict a ``return_state=True`` run emitted — ``bonds [V, M]``,
+    ``consensus [M]``, and ``w_prev [V, M]`` exactly when the variant
+    carries previous weights. Shape mistakes fail HERE as a typed
+    ValueError (a caller error the retry ladder must never burn
+    attempts on), not as an XLA shape crash three layers down. Returns
+    the validated dict of host/device arrays unchanged."""
+    if not isinstance(initial_state, dict):
+        raise ValueError(
+            "initial_state must be the carry dict of a return_state=True "
+            f"run, got {type(initial_state).__name__}"
+        )
+    want = {"bonds": (V, M), "consensus": (M,)}
+    if spec.carries_prev_weights:
+        want["w_prev"] = (V, M)
+    extra = set(initial_state) - set(want)
+    if extra:
+        raise ValueError(
+            f"initial_state carries unknown keys {sorted(extra)} "
+            f"(this variant's carry is {sorted(want)})"
+        )
+    for key, shape in want.items():
+        if key not in initial_state:
+            raise ValueError(
+                f"initial_state lacks {key!r} (this variant's carry is "
+                f"{sorted(want)})"
+            )
+        got = np.shape(initial_state[key])
+        if tuple(got) != shape:
+            raise ValueError(
+                f"initial_state[{key!r}] has shape {tuple(got)}, "
+                f"expected {shape}"
+            )
+    return initial_state
+
+
 def simulate(
     scenario: Scenario,
     yuma_version: str,
@@ -616,8 +664,28 @@ def simulate(
     max_resident_epochs: Optional[int] = None,
     retry_policy=None,
     deadline=None,
+    initial_state: Optional[dict] = None,
+    epoch_offset: int = 0,
+    return_state: bool = False,
 ) -> SimulationResult:
     """Simulate one scenario under one named version; returns host arrays.
+
+    `initial_state` / `epoch_offset` / `return_state` (0.18.0, additive
+    — the suffix-resume contract of the chain-replay service): pass the
+    ``final_state`` dict of a prior ``return_state=True`` run as
+    ``initial_state=`` with ``epoch_offset=`` set to that run's epoch
+    count, and this call continues the trajectory over the scenario's
+    epochs as global epochs ``[offset, offset + E)`` — bitwise identical
+    to the corresponding tail of one uninterrupted run, on every engine
+    rung (the same carry-threading contract chunked streaming is pinned
+    on, tests/unit/test_suffix_resume.py). The offset is a traced
+    operand, so resuming at different epochs reuses one compiled
+    program per suffix length. ``return_state=True`` additionally
+    returns the post-final-epoch carry on
+    :attr:`SimulationResult.final_state` (host arrays, serializable).
+    The AOT executable-cache seam covers only offset-0 stateless
+    dispatches; resume dispatches ride the ordinary jit cache (plus the
+    persistent compilation cache when configured).
 
     `retry_policy` (a :class:`..resilience.retry.RetryPolicy`, default
     None = fail fast exactly as before): arm the engine-degradation
@@ -691,6 +759,10 @@ def simulate(
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
     E_, V_, M_ = np.shape(scenario.weights)
+    if initial_state is not None:
+        validate_initial_state(initial_state, spec, V_, M_)
+    if epoch_offset < 0:
+        raise ValueError(f"epoch_offset must be >= 0, got {epoch_offset}")
     itemsize = jnp.dtype(dtype).itemsize
     save_bonds = _resolve_save(
         save_bonds, E_ * V_ * M_ * itemsize, "save_bonds"
@@ -760,6 +832,9 @@ def simulate(
             epoch_impl=epoch_impl,
             dtype=dtype,
             retry_policy=retry_policy,
+            initial_state=initial_state,
+            epoch_offset=epoch_offset,
+            return_state=return_state,
         )
     from yuma_simulation_tpu.resilience import faults
 
@@ -795,15 +870,35 @@ def simulate(
     from yuma_simulation_tpu.telemetry.numerics import numerics_enabled
 
     capture = numerics_enabled()
+    # Suffix-resume operands: the carry is data (fresh device arrays per
+    # dispatch — the streamed twins DONATE carries, these engines don't,
+    # but a ladder retry must still see untouched inputs) and the offset
+    # is traced, so every resume epoch reuses one compiled program per
+    # suffix length.
+    resuming = (
+        initial_state is not None or return_state or epoch_offset != 0
+    )
+    resume_kwargs: dict = {}
+    if resuming:
+        if initial_state is not None:
+            resume_kwargs["carry"] = {
+                k: jnp.asarray(np.asarray(v), dtype)
+                for k, v in initial_state.items()
+            }
+        resume_kwargs["epoch_offset"] = jnp.asarray(
+            epoch_offset, jnp.int32
+        )
+        resume_kwargs["return_carry"] = return_state
 
     def _dispatch_engine(rung: str):
         # The AOT executable-cache seam (simulation.aot): when a cache
-        # is active and the dispatch carries no dynamic fault operands
-        # or sharding, resolve the rung's program by content — a hit
-        # dispatches the deserialized executable directly (bitwise the
-        # JIT path, pinned by tests/unit/test_aot.py); a miss JITs as
-        # today and publishes the artifact. Inactive cache = None fast
-        # path, so the legacy pipeline is untouched by default.
+        # is active and the dispatch carries no dynamic fault operands,
+        # sharding, or suffix-resume carry, resolve the rung's program
+        # by content — a hit dispatches the deserialized executable
+        # directly (bitwise the JIT path, pinned by
+        # tests/unit/test_aot.py); a miss JITs as today and publishes
+        # the artifact. Inactive cache = None fast path, so the legacy
+        # pipeline is untouched by default.
         from yuma_simulation_tpu.simulation.aot import dispatch_via_cache
 
         if rung in ("fused_scan", "fused_scan_mxu"):
@@ -816,12 +911,16 @@ def simulate(
                 mxu=rung == "fused_scan_mxu",
                 capture_numerics=capture,
             )
-            out = dispatch_via_cache(
-                _simulate_case_fused,
-                (weights, stakes, reset_index, reset_epoch, config),
-                fused_kwargs,
-                static_names=tuple(fused_kwargs),
-                label=f"simulate:{rung}",
+            out = (
+                dispatch_via_cache(
+                    _simulate_case_fused,
+                    (weights, stakes, reset_index, reset_epoch, config),
+                    fused_kwargs,
+                    static_names=tuple(fused_kwargs),
+                    label=f"simulate:{rung}",
+                )
+                if not resuming
+                else None
             )
             if out is None:
                 out = _simulate_case_fused(
@@ -831,6 +930,7 @@ def simulate(
                     reset_epoch,
                     config,
                     **fused_kwargs,
+                    **resume_kwargs,
                 )
         else:
             # Demoted off a fused rung: the plan pre-resolved the
@@ -863,7 +963,7 @@ def simulate(
                     static_names=tuple(xla_kwargs),
                     label=f"simulate:{rung}",
                 )
-                if mesh is None and nf is None
+                if mesh is None and nf is None and not resuming
                 else None
             )
             if out is None:
@@ -880,6 +980,7 @@ def simulate(
                         else jnp.asarray(nf.epoch, jnp.int32)
                     ),
                     **xla_kwargs,
+                    **resume_kwargs,
                 )
         if retry_policy is not None or deadline is not None:
             # Surface async dispatch failures (device OOM) inside the
@@ -913,6 +1014,10 @@ def simulate(
                 label=yuma_version, deadline=deadline,
             )
             demotions = tuple(records) or None
+        state_out = None
+        if return_state:
+            ys, state_out = ys
+            state_out = jax.device_get(state_out)
         ys = jax.device_get(ys)
     return SimulationResult(
         dividends=ys["dividends"],
@@ -921,6 +1026,7 @@ def simulate(
         consensus=ys.get("consensus"),
         demotions=demotions,
         numerics=ys.get("numerics"),
+        final_state=state_out,
     )
 
 
@@ -996,8 +1102,21 @@ def simulate_streamed(
     epoch_impl: str = "auto",
     dtype=jnp.float32,
     retry_policy=None,
+    initial_state: Optional[dict] = None,
+    epoch_offset: int = 0,
+    return_state: bool = False,
 ) -> SimulationResult:
     """Chunked epoch streaming: true-per-epoch-weights runs beyond HBM.
+
+    `initial_state` / `epoch_offset` / `return_state`: the same
+    suffix-resume contract as :func:`simulate` — the stream's chunk 0
+    starts from the supplied carry at global epoch ``epoch_offset``
+    instead of the zero carry at epoch 0, and ``return_state=True``
+    returns the post-final-chunk carry on
+    :attr:`SimulationResult.final_state`. A fresh device copy of the
+    initial carry is staged per ladder attempt (the streamed engine
+    twins DONATE their carry buffers, so a demotion restart must never
+    hand the consumed buffers back in).
 
     The reference's real workload shape is genuinely different `W[e]` /
     `S[e]` every epoch (reference simulation_utils.py:44-46 feeding
@@ -1055,6 +1174,8 @@ def simulate_streamed(
             )
     config = config if config is not None else YumaConfig()
     spec = variant_for_version(yuma_version)
+    if epoch_offset < 0:
+        raise ValueError(f"epoch_offset must be >= 0, got {epoch_offset}")
     if retry_policy is not None:
         return _simulate_streamed_ladder(
             chunks,
@@ -1069,6 +1190,9 @@ def simulate_streamed(
             epoch_impl=epoch_impl,
             dtype=dtype,
             retry_policy=retry_policy,
+            initial_state=initial_state,
+            epoch_offset=epoch_offset,
+            return_state=return_state,
         )
     return _simulate_streamed_attempt(
         iter(chunks),
@@ -1083,6 +1207,9 @@ def simulate_streamed(
         consensus_impl=consensus_impl,
         epoch_impl=epoch_impl,
         dtype=dtype,
+        initial_state=initial_state,
+        epoch_offset=epoch_offset,
+        return_state=return_state,
     )
 
 
@@ -1136,6 +1263,9 @@ def _simulate_streamed_ladder(
     epoch_impl: str,
     dtype,
     retry_policy,
+    initial_state=None,
+    epoch_offset: int = 0,
+    return_state: bool = False,
 ):
     """The degradation ladder around a whole chunk stream (see
     :func:`simulate_streamed`): peek the first chunk to resolve the
@@ -1199,6 +1329,9 @@ def _simulate_streamed_ladder(
                 epoch_impl=rung,
                 dtype=dtype,
                 block_per_chunk=True,
+                initial_state=initial_state,
+                epoch_offset=epoch_offset,
+                return_state=return_state,
             )
         except BaseException as exc:
             from yuma_simulation_tpu.resilience.errors import classify_failure
@@ -1250,6 +1383,9 @@ def _simulate_streamed_attempt(
     epoch_impl: str,
     dtype,
     block_per_chunk: bool = False,
+    initial_state=None,
+    epoch_offset: int = 0,
+    return_state: bool = False,
 ) -> SimulationResult:
     """One engine-pinned, DOUBLE-BUFFERED pass over the stream — the
     pre-resilience body of :func:`simulate_streamed`.
@@ -1399,12 +1535,24 @@ def _simulate_streamed_attempt(
     if cur is None:
         raise ValueError("simulate_streamed received no chunks")
     cur = stage(cur)
-    # A zeros carry is bitwise the kernels' own epoch-0 init, and keeps
-    # chunk 0 on the SAME compiled program as every later chunk (a
-    # carry=None first dispatch would compile a second kernel variant
-    # for no numerical difference).
-    carry = zero_carry(spec, cur[0].shape[-2], cur[0].shape[-1], dtype)
-    offset = 0
+    if initial_state is not None:
+        validate_initial_state(
+            initial_state, spec, cur[0].shape[-2], cur[0].shape[-1]
+        )
+        # Fresh device buffers per attempt: the streamed engines DONATE
+        # the carry, so handing the caller's (or a prior attempt's)
+        # arrays in directly would consume them.
+        carry = {
+            k: jnp.asarray(np.asarray(v), dtype)
+            for k, v in initial_state.items()
+        }
+    else:
+        # A zeros carry is bitwise the kernels' own epoch-0 init, and
+        # keeps chunk 0 on the SAME compiled program as every later
+        # chunk (a carry=None first dispatch would compile a second
+        # kernel variant for no numerical difference).
+        carry = zero_carry(spec, cur[0].shape[-2], cur[0].shape[-1], dtype)
+    offset = epoch_offset
     pending: Optional[dict] = None
     while cur is not None:
         Wc, Sc = cur
@@ -1439,6 +1587,7 @@ def _simulate_streamed_attempt(
         incentives=cat.get("incentives"),
         consensus=cat.get("consensus"),
         numerics=numerics,
+        final_state=jax.device_get(carry) if return_state else None,
     )
 
 

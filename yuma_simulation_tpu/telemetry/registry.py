@@ -293,6 +293,26 @@ EVENTS = {
         operator_reason="ingestion audit trail on the log stream: which "
         "snapshot file fed which generated suite (grep event=)",
     ),
+    # -- chain replay (replay/) ------------------------------------------
+    "whatif_served": EventSpec(
+        "one what-if executed against a cached baseline (ledger record "
+        "carries tenant, cache hit, resume epoch, suffix vs full epochs)",
+        consumers=("obsreport",),
+    ),
+    "state_cache_hit": EventSpec(
+        "a what-if resumed from a cached epoch-state checkpoint "
+        "(suffix-sized re-simulation)",
+        operator_reason="per-resolve forensics on the log stream; the "
+        "state_cache_hits counter is the reconciled aggregate the "
+        "replay drill and obsreport's replay section read",
+    ),
+    "state_cache_miss": EventSpec(
+        "a what-if found no usable cached epoch state (reason: baseline "
+        "not built / no checkpoint before the perturb epoch / state "
+        "unreadable) — full-trajectory re-simulation",
+        operator_reason="typed miss taxonomy on the log stream; the "
+        "state_cache_misses counter is the reconciled aggregate",
+    ),
 }
 
 
@@ -411,6 +431,22 @@ METRICS = {
     "scenarios_generated": MetricSpec(
         "counter", "foundry-generated scenarios (DSL compiles + "
         "metagraph ingestions + adversarial builds)",
+        consumers=("obsreport",),
+    ),
+    # -- chain replay (replay/) ------------------------------------------
+    "state_cache_hits": MetricSpec(
+        "counter", "what-if suffix resumes served from a cached epoch "
+        "state",
+        consumers=("obsreport",),
+    ),
+    "state_cache_misses": MetricSpec(
+        "counter", "what-if requests with no usable cached epoch state "
+        "(full re-simulation)",
+        consumers=("obsreport",),
+    ),
+    "replay_suffix_epochs_saved": MetricSpec(
+        "counter", "epochs cached carries let what-ifs skip "
+        "re-simulating (suffix-vs-full savings)",
         consumers=("obsreport",),
     ),
     # -- SLO engine ------------------------------------------------------

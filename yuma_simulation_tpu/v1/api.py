@@ -47,6 +47,12 @@ from yuma_simulation_tpu.foundry import (  # noqa: F401  (promoted, 0.16.0)
     takeover_scenario,
     weight_copier_scenario,
 )
+from yuma_simulation_tpu.replay import (  # noqa: F401  (promoted, 0.18.0)
+    SnapshotArchive,
+    StateCache,
+    WhatIfSpec,
+    sweep_trailing_window,
+)
 from yuma_simulation_tpu.scenarios.base import Scenario
 from yuma_simulation_tpu.serve.server import (  # noqa: F401  (promoted)
     SimulationClient,
@@ -62,12 +68,18 @@ from yuma_simulation_tpu.simulation.sweep import (
 #: detail that may change without notice. 0.12.0 grows it ADDITIVELY
 #: with the serving tier's entry point + client; 0.16.0 with the
 #: scenario foundry — the DSL compiler, metagraph snapshot ingestion,
-#: and the four adversarial family builders (MIGRATION.md).
+#: and the four adversarial family builders; 0.18.0 with the
+#: chain-replay service — the snapshot-timeline archive, the epoch-
+#: state cache, what-if specs, and the trailing-window fleet sweep
+#: (MIGRATION.md).
 __all__ = [
     "HTML",
     "Scenario",
     "SimulationClient",
     "SimulationHyperparameters",
+    "SnapshotArchive",
+    "StateCache",
+    "WhatIfSpec",
     "YumaConfig",
     "YumaParams",
     "YumaSimulationNames",
@@ -79,6 +91,7 @@ __all__ = [
     "run_simulation",
     "serve",
     "stake_churn_scenario",
+    "sweep_trailing_window",
     "takeover_scenario",
     "weight_copier_scenario",
 ]
