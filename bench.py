@@ -95,6 +95,15 @@ TRUE_E = 1024  # [TRUE_E, V, M] f32 = 4 GiB of genuinely per-epoch weights
 TRUE_E_CPU = 64
 BATCH = 4  # largest scenario batch the VMEM-resident fused scan admits here
 MC_B = 8  # per-epoch Monte-Carlo scenario batch (the *_x8 continuity line)
+#: Scenario batch for the montecarlo_per_epoch_fused line: the largest
+#: batch at which the epoch-tiled varying scan admits a >= 2 epoch tile
+#: at 256 x 4096 under the measured VMEM model (`_varying_scan_mats`:
+#: streaming EMA needs (4T + 2 + temps) * B * 4 MiB <= 126 MiB — B = 2
+#: fits T = 2, B = 3 fits nothing). The rung exists for workloads whose
+#: per-epoch block underfills the chip, so the fused MC line measures
+#: it where it is actually admissible; the MC_B=8 line above keeps
+#: measuring the planner-auto path at the continuity batch.
+MC_FUSED_B = 2
 
 #: Per-rung attained-fraction floors declared into every history record
 #: (tools/perfgate.py `check_attained`). The roofline prediction is an
@@ -106,10 +115,19 @@ MC_B = 8  # per-epoch Monte-Carlo scenario batch (the *_x8 continuity line)
 #: while the `attained:{rung}` rolling-baseline diff in perfgate
 #: catches finer distance-to-ceiling drift commit-to-commit. Tighten as
 #: on-chip history accumulates.
+#: Ratcheted for r06 (ISSUE 15): the r05 on-chip capture put the fused
+#: line at ~0.5 of its amortization-optimistic ceiling and the XLA scan
+#: well above 1% of its, so the collapse backstops double — a rung that
+#: falls below these is broken, not merely slow. The new epoch-tiled
+#: varying rungs start at the fused backstop. tools/perfgate.py keeps
+#: its own DEFAULT_ATTAINED_FLOORS at these values as a floor-of-floors,
+#: so a future bench edit cannot silently loosen the gate.
 ATTAINED_FLOORS = {
-    "fused_scan_mxu": 0.01,
-    "fused_scan": 0.01,
-    "xla": 0.001,
+    "fused_varying_mxu": 0.02,
+    "fused_varying": 0.02,
+    "fused_scan_mxu": 0.02,
+    "fused_scan": 0.02,
+    "xla": 0.002,
 }
 
 
@@ -157,20 +175,31 @@ def _true_weights_reps(
     through a `* 0.0` (f32 `x * 0` is not foldable — NaN/Inf
     semantics — so XLA cannot dead-code-eliminate the capture while
     the measured value stays bit-identical)."""
-    from yuma_simulation_tpu.ops.pallas_epoch import fused_case_scan
+    from yuma_simulation_tpu.ops.pallas_epoch import (
+        fused_case_scan,
+        fused_varying_scan,
+    )
     from yuma_simulation_tpu.simulation.engine import fused_hparams
+    from yuma_simulation_tpu.simulation.planner import (
+        FUSED_CASE_RUNGS,
+        rung_flags,
+    )
 
     ri = jnp.asarray(-1, jnp.int32)
 
     def body(r, carry):
         acc, scale = carry
         S_r = S_e * scale
-        if epoch_impl in ("fused_scan", "fused_scan_mxu"):
-            out = fused_case_scan(
+        if epoch_impl in FUSED_CASE_RUNGS:
+            flags = rung_flags(epoch_impl)
+            kernel = (
+                fused_varying_scan if flags["varying"] else fused_case_scan
+            )
+            out = kernel(
                 W_e,
                 S_r,
                 mode=spec.bonds_mode,
-                mxu=epoch_impl == "fused_scan_mxu",
+                mxu=flags["mxu"],
                 save_bonds=False,
                 save_incentives=False,
                 **fused_hparams(config),
@@ -597,6 +626,25 @@ def _bench(args) -> None:
         1,
     )
 
+    # The varying-weights FUSED rung (ISSUE 15, perfgate-tracked on
+    # every backend): the same true-per-epoch-weights workload through
+    # the engine `plan_dispatch(auto)` ships for it — the epoch-tiled
+    # `fused_varying_scan` on TPU; on CPU auto resolves to the XLA rung,
+    # so the line re-uses the measured XLA rate (one workload, one
+    # number — the CPU lane gates CPU-vs-CPU drift only, exactly like
+    # the other per-epoch-weights lines).
+    if on_tpu:
+        secondary["true_weights_fused"] = round(
+            _time_best(
+                true_weights("fused_varying_mxu"), 4 * TRUE_E,
+                granularity=TRUE_E, label="true_weights_fused",
+            ),
+            1,
+        )
+    else:
+        secondary["true_weights_fused"] = secondary["true_weights_xla"]
+        _CVS["true_weights_fused"] = _CVS["true_weights_xla"]
+
     # Numerics-capture overhead (0.14.0): the SAME true-weights XLA
     # workload with the in-scan per-epoch sketch capture ON — finite
     # fraction, min/max/absmax, bit-cast-u32 fingerprint per epoch
@@ -703,6 +751,47 @@ def _bench(args) -> None:
         1,
     )
 
+    # The per-epoch Monte-Carlo pinned to the FUSED varying rung
+    # (ISSUE 15, perfgate-tracked on every backend): device-RNG weight
+    # slabs streamed through the epoch-tiled scan on TPU; on CPU the
+    # planner's auto path IS the batched XLA oracle already measured
+    # above, so the line re-uses that rate (same aliasing rule as
+    # true_weights_fused).
+    if on_tpu:
+
+        def mc_fused(n):
+            return montecarlo_per_epoch_batched(
+                jax.random.PRNGKey(5),
+                MC_FUSED_B,
+                max(1, n // MC_FUSED_B),
+                V,
+                M,
+                "Yuma 1 (paper)",
+                consensus_impl="bisect",
+                epoch_impl="fused_varying_mxu",
+            )
+
+        # mc_fused(n) advances n // B epochs x B scenarios = n
+        # scenario-epochs, so the rate is scenario-epochs/s directly
+        # (the same convention as montecarlo_per_epoch_weights).
+        secondary["montecarlo_per_epoch_fused"] = round(
+            _time_best(
+                mc_fused,
+                4096,
+                max_n=MAX_EPOCHS,
+                granularity=MC_FUSED_B,
+                label="montecarlo_per_epoch_fused",
+            ),
+            1,
+        )
+    else:
+        secondary["montecarlo_per_epoch_fused"] = secondary[
+            "montecarlo_per_epoch_weights"
+        ]
+        _CVS["montecarlo_per_epoch_fused"] = _CVS[
+            "montecarlo_per_epoch_weights"
+        ]
+
     if on_tpu:
         # Epoch-VARYING Monte-Carlo through the shard_map tier (r4
         # verdict item 4), unchanged for continuity with the r4/r5
@@ -717,6 +806,10 @@ def _bench(args) -> None:
         mesh1 = make_mesh()
 
         def mc_varying(n):
+            # epoch_impl="xla" pins the shard_map tier explicitly: the
+            # single-device "auto" path now routes through the planned
+            # batched driver (the montecarlo_per_epoch_fused line), and
+            # this continuity line must keep measuring the shard tier.
             return montecarlo_total_dividends(
                 jax.random.PRNGKey(5),
                 MC_B,
@@ -727,6 +820,7 @@ def _bench(args) -> None:
                 mesh=mesh1,
                 weights_mode="per_epoch",
                 consensus_impl="bisect",
+                epoch_impl="xla",
             )
 
         secondary["montecarlo_per_epoch_weights_x8"] = round(
@@ -815,6 +909,10 @@ def _append_history(
         measured = {
             "xla": line["secondary"].get("full_epoch_xla"),
             "fused_scan": line["secondary"].get("fused_scan_vpu"),
+            # The varying rungs' measured line is the true-weights
+            # workload itself (they exist for it); off-TPU the cost
+            # record is a null-with-reason, so the fraction stays null.
+            "fused_varying_mxu": line["secondary"].get("true_weights_fused"),
         }
         measured[primary_impl] = primary  # the headline's rung wins
         for engine, rec in records.items():

@@ -106,6 +106,7 @@ TEST_CHUNKS = [
     [
         "tests/unit/test_fused_case_scan.py",
         "tests/unit/test_fused_epoch.py",
+        "tests/unit/test_varying_scan.py",
         "tests/unit/test_hoisted.py",
         "tests/unit/test_kernels.py",
         "tests/unit/test_resilience.py",

@@ -312,13 +312,15 @@ def _history_record(value, cv=0.02, smoke=False, backend="cpu", t=0.0,
         }
         for engine in ENGINE_RUNGS
     }
-    # The 0.10.0 schema: the three per-epoch-weights lines are
-    # first-class tracked metrics, and every record declares its
-    # attained-fraction floors.
+    # The 0.10.0 schema (grown 0.19.0): the per-epoch-weights lines —
+    # XLA and fused-varying — are first-class tracked metrics, and
+    # every record declares its attained-fraction floors.
     tracked = {
         "true_weights_xla": value / 10,
+        "true_weights_fused": value / 10,
         "streamed_true_weights": value / 8,
         "montecarlo_per_epoch_weights": value / 9,
+        "montecarlo_per_epoch_fused": value / 9,
     }
     tracked.update(secondary or {})
     record = {
@@ -326,7 +328,7 @@ def _history_record(value, cv=0.02, smoke=False, backend="cpu", t=0.0,
         "metric": "epochs/sec", "value": value, "unit": "epochs/s",
         "secondary": tracked,
         "cv": {"primary": cv}, "costs": costs, "rooflines": {},
-        "attained_floor": {"xla": 0.001},
+        "attained_floor": {"xla": 0.002},
         # The 0.14.0 schema: the numerics-capture overhead is a
         # first-class gated metric (structural + ceiling gates).
         "numerics": {

@@ -154,7 +154,10 @@ def test_ladder_ownership_shared_with_resilience():
 
     assert retry.ENGINE_LADDER is ENGINE_LADDER
     assert retry.ladder_from is ladder_from
-    assert ladder_from("fused_scan_mxu") == ENGINE_LADDER
+    assert ladder_from("fused_varying_mxu") == ENGINE_LADDER
+    assert ladder_from("fused_scan_mxu") == (
+        "fused_scan_mxu", "fused_scan", "xla"
+    )
     assert ladder_from("hoisted") == ("hoisted",)
 
 

@@ -169,7 +169,13 @@ def test_lease_expired_is_not_an_engine_failure():
 
 
 def test_ladder_from_rungs():
-    assert ladder_from("fused_scan_mxu") == ENGINE_LADDER
+    assert ladder_from("fused_varying_mxu") == ENGINE_LADDER
+    assert ladder_from("fused_varying") == (
+        "fused_varying", "fused_scan_mxu", "fused_scan", "xla"
+    )
+    assert ladder_from("fused_scan_mxu") == (
+        "fused_scan_mxu", "fused_scan", "xla"
+    )
     assert ladder_from("fused_scan") == ("fused_scan", "xla")
     assert ladder_from("xla") == ("xla",)
     # unknown engines retry in place, never demote across semantics

@@ -1,0 +1,694 @@
+"""The epoch-tiled varying-weights fused engine (ISSUE 15).
+
+`fused_varying_scan` is `fused_case_scan`'s twin for workloads whose
+single-epoch `[Vp, Mp]` block underfills the chip: each grid step
+advances a whole epoch tile, with the bond-independent math
+(`_consensus_phase` / `_clip_rank_rate`) batched over the tile and only
+the bond recurrence sequential. These tests pin its numeric contract on
+every bond model in interpret mode (the same program compiles via
+Mosaic on chip; on-chip parity rides tools/tpu_parity.py like the other
+fused kernels):
+
+- the consensus / incentive surface is BITWISE the per-epoch case scan
+  for every tile length (the cross-engine consensus contract);
+- dividends/bonds match the case scan and the XLA rung to
+  reduction-order rounding (the same class as the existing fused rung's
+  XLA contract — tests/unit/test_fused_case_scan.py's tolerances);
+- runs sharing one program are bitwise each other: MXU == VPU (the
+  default numerics-canary pairing), chunked carry composition at a
+  fixed tile, batched == solo lanes, repeated suffix resumes;
+- the planner admits, validates, demotes and ladders the new rungs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yuma_simulation_tpu.models.config import YumaConfig, YumaParams
+from yuma_simulation_tpu.models.epoch import BondsMode
+from yuma_simulation_tpu.models.variants import variant_for_version
+from yuma_simulation_tpu.ops.pallas_epoch import (
+    VARYING_EPOCH_TILE_MAX,
+    _varying_scan_mats,
+    fused_case_scan,
+    fused_varying_scan,
+    fused_varying_scan_eligible,
+    varying_scan_epoch_tile,
+)
+from yuma_simulation_tpu.scenarios.base import Scenario
+from yuma_simulation_tpu.simulation.engine import (
+    _simulate_case_fused,
+    _simulate_scan,
+    simulate,
+    simulate_streamed,
+)
+from yuma_simulation_tpu.simulation.planner import (
+    ENGINE_LADDER,
+    FUSED_CASE_RUNGS,
+    ladder_from,
+    plan_dispatch,
+    rung_flags,
+)
+
+VERSION = "Yuma 1 (paper)"
+CFG = YumaConfig()
+ON_TPU = jax.default_backend() == "tpu"
+
+ALL_VERSIONS = [
+    ("Yuma 0 (subtensor)", {}),
+    ("Yuma 1 (paper)", {}),
+    ("Yuma 1 (paper) - liquid alpha on", dict(liquid_alpha=True)),
+    ("Yuma 2 (Adrian-Fish)", {}),
+    ("Yuma 3 (Rhef)", {}),
+    ("Yuma 3.1 (Rhef+reset)", {}),
+    ("Yuma 3.2 (Rhef+conditional)", {}),
+    ("Yuma 4 (Rhef+relative bonds)", {}),
+]
+
+ALL_MODES = (
+    BondsMode.EMA,
+    BondsMode.EMA_PREV,
+    BondsMode.EMA_RUST,
+    BondsMode.CAPACITY,
+    BondsMode.RELATIVE,
+)
+
+
+def _workload(seed=0, E=12, V=6, M=18):
+    rng = np.random.default_rng(seed)
+    W = jnp.asarray(rng.random((E, V, M)), jnp.float32)
+    S = jnp.asarray(rng.random((E, V)) + 0.01, jnp.float32)
+    return W, S
+
+
+def _zero_carry(mode, V, M, lead=()):
+    carry = {
+        "bonds": jnp.zeros(lead + (V, M), jnp.float32),
+        "consensus": jnp.zeros(lead + (M,), jnp.float32),
+    }
+    if mode is BondsMode.EMA_PREV:
+        carry["w_prev"] = jnp.zeros(lead + (V, M), jnp.float32)
+    return carry
+
+
+# ---------------------------------------------------------------------------
+# kernel-level parity
+
+
+@pytest.mark.parametrize(
+    "version,params", ALL_VERSIONS, ids=[v for v, _ in ALL_VERSIONS]
+)
+def test_varying_scan_matches_xla_scan(version, params):
+    """Full-save parity vs the XLA engine on every variant, with reset
+    metadata armed — the same tolerance contract as the per-epoch fused
+    rung's."""
+    W, S = _workload()
+    ri = jnp.asarray(2, jnp.int32)
+    re = jnp.asarray(4, jnp.int32)
+    cfg = YumaConfig(yuma_params=YumaParams(**params))
+    spec = variant_for_version(version)
+    ys_x = _simulate_scan(W, S, ri, re, cfg, spec, save_consensus=True)
+    ys_v = _simulate_case_fused(
+        W, S, ri, re, cfg, spec, save_consensus=True, varying=True
+    )
+    assert ys_x.keys() == ys_v.keys()
+    for k in ys_x:
+        np.testing.assert_allclose(
+            np.asarray(ys_v[k]),
+            np.asarray(ys_x[k]),
+            atol=2e-6,
+            rtol=1e-5,
+            err_msg=f"{version}: {k}",
+        )
+
+
+@pytest.mark.parametrize("mode", ALL_MODES, ids=[m.name for m in ALL_MODES])
+def test_varying_tile_invariance(mode):
+    """The tile groups epochs, it must not change the model: the
+    consensus/incentive surface is bitwise the per-epoch case scan for
+    EVERY tile length; dividends/bonds stay within reduction-order
+    rounding of it."""
+    W, S = _workload(seed=1)
+    ref = fused_case_scan(W, S, mode=mode, save_consensus=True)
+    for et in (1, 2, 3, 4, 6, 12):
+        got = fused_varying_scan(
+            W, S, mode=mode, save_consensus=True, epoch_tile=et
+        )
+        assert got.keys() == ref.keys()
+        for k in ("consensus", "incentives"):
+            assert np.array_equal(
+                np.asarray(got[k]), np.asarray(ref[k])
+            ), (mode, et, k)
+        for k in ("dividends_normalized", "bonds", "final_bonds"):
+            np.testing.assert_allclose(
+                np.asarray(got[k]),
+                np.asarray(ref[k]),
+                atol=1e-6,
+                rtol=1e-5,
+                err_msg=f"{mode} tile={et}: {k}",
+            )
+
+
+def test_varying_scan_rejects_non_divisor_tile():
+    W, S = _workload(E=10)
+    with pytest.raises(ValueError, match="divide"):
+        fused_varying_scan(W, S, epoch_tile=4)
+    with pytest.raises(ValueError, match=">= 1"):
+        fused_varying_scan(W, S, epoch_tile=0)
+
+
+@pytest.mark.parametrize("mode", ALL_MODES, ids=[m.name for m in ALL_MODES])
+def test_varying_mxu_bitwise_vpu(mode):
+    """The MXU twin must be BITWISE the VPU twin at the same program —
+    this is the pair the default numerics canary compares (one rung
+    below the primary on the ladder), so any divergence here would be a
+    standing false drift alarm."""
+    W, S = _workload(seed=2)
+    kw = dict(mode=mode, save_consensus=True, epoch_tile=4)
+    vpu = fused_varying_scan(W, S, mxu=False, **kw)
+    mxu = fused_varying_scan(W, S, mxu=True, **kw)
+    for k in vpu:
+        assert np.array_equal(np.asarray(vpu[k]), np.asarray(mxu[k])), (
+            mode,
+            k,
+        )
+
+
+def test_varying_mxu_bitwise_vpu_liquid():
+    W, S = _workload(seed=3)
+    cfg_kw = dict(liquid_alpha=True)
+    vpu = fused_varying_scan(W, S, epoch_tile=4, mxu=False, **cfg_kw)
+    mxu = fused_varying_scan(W, S, epoch_tile=4, mxu=True, **cfg_kw)
+    for k in vpu:
+        assert np.array_equal(np.asarray(vpu[k]), np.asarray(mxu[k])), k
+
+
+@pytest.mark.parametrize("mode", ALL_MODES, ids=[m.name for m in ALL_MODES])
+def test_varying_chunked_carry_composition(mode):
+    """Fixed-tile chunk composition over the carry contract is bitwise
+    a single carry-threaded run: the invariance the streaming and
+    Monte-Carlo drivers thread slabs on (all chunks share ONE compiled
+    program, so there is no cross-program rounding surface)."""
+    W, S = _workload(seed=4)
+    V, M = 6, 18
+    kw = dict(mode=mode, save_bonds=False, save_incentives=False, epoch_tile=4)
+    mono = fused_varying_scan(
+        W, S, carry=_zero_carry(mode, V, M), epoch_offset=0,
+        return_carry=True, **kw,
+    )
+
+    def compose(chunks):
+        carry = _zero_carry(mode, V, M)
+        lo, dn = 0, []
+        for c in chunks:
+            out = fused_varying_scan(
+                W[lo : lo + c], S[lo : lo + c], carry=carry,
+                epoch_offset=lo, return_carry=True, **kw,
+            )
+            carry = {
+                "bonds": out["final_bonds"],
+                "consensus": out["final_consensus"],
+            }
+            if mode is BondsMode.EMA_PREV:
+                carry["w_prev"] = out["final_w_prev"]
+            dn.append(out["dividends_normalized"])
+            lo += c
+        return np.concatenate(dn), np.asarray(carry["bonds"])
+
+    # Uniform chunking runs ONE compiled program for every chunk:
+    # repeated composition is bitwise-identical (what the streaming and
+    # Monte-Carlo slab drivers rely on).
+    dn_a, bonds_a = compose([4, 4, 4])
+    dn_b, bonds_b = compose([4, 4, 4])
+    assert np.array_equal(dn_a, dn_b), mode
+    assert np.array_equal(bonds_a, bonds_b), mode
+    # Across program classes (different chunk lengths, the monolithic
+    # dispatch) the bound is reduction-order rounding — the same class
+    # as the fused-vs-XLA contract; the consensus surface stays bitwise
+    # (pinned by the tile-invariance test).
+    for chunks in ([8, 4], [4, 8]):
+        dn_c, bonds_c = compose(chunks)
+        np.testing.assert_allclose(
+            dn_c, np.asarray(mono["dividends_normalized"]),
+            atol=1e-6, rtol=1e-5, err_msg=f"{mode} {chunks}",
+        )
+        np.testing.assert_allclose(
+            bonds_c, np.asarray(mono["final_bonds"]),
+            atol=1e-6, rtol=1e-5, err_msg=f"{mode} {chunks}",
+        )
+    np.testing.assert_allclose(
+        dn_a, np.asarray(mono["dividends_normalized"]),
+        atol=1e-6, rtol=1e-5, err_msg=str(mode),
+    )
+
+
+def test_varying_batched_lanes_bitwise_solo():
+    W, S = _workload(seed=5)
+    Wb = jnp.stack([W, W[::-1]])
+    Sb = jnp.stack([S, S[::-1]])
+    batched = fused_varying_scan(
+        Wb, Sb, save_consensus=True, epoch_tile=4
+    )
+    for lane, (Wl, Sl) in enumerate(((W, S), (W[::-1], S[::-1]))):
+        solo = fused_varying_scan(Wl, Sl, save_consensus=True, epoch_tile=4)
+        for k in ("consensus", "incentives"):
+            assert np.array_equal(
+                np.asarray(batched[k])[lane], np.asarray(solo[k])
+            ), (lane, k)
+        for k in ("dividends_normalized", "bonds", "final_bonds"):
+            np.testing.assert_allclose(
+                np.asarray(batched[k])[lane],
+                np.asarray(solo[k]),
+                atol=1e-6,
+                rtol=1e-5,
+            )
+
+
+@pytest.mark.parametrize(
+    "version",
+    ["Yuma 3.1 (Rhef+reset)", "Yuma 3.2 (Rhef+conditional)"],
+)
+def test_varying_reset_fires_like_xla(version):
+    """Reset injection across a tile boundary: the rule keys off the
+    GLOBAL epoch and the previous epoch's consensus (carried across
+    tiles), exactly as the per-epoch engines."""
+    W, S = _workload(seed=3)
+    W = W.at[3:, :, 3].set(0.0)
+    ri = jnp.asarray(3, jnp.int32)
+    re = jnp.asarray(5, jnp.int32)
+    spec = variant_for_version(version)
+    ys_x = _simulate_scan(W, S, ri, re, CFG, spec)
+    ys_v = _simulate_case_fused(
+        W, S, ri, re, CFG, spec, varying=True
+    )
+    for k in ys_x:
+        np.testing.assert_allclose(
+            np.asarray(ys_v[k]), np.asarray(ys_x[k]), atol=2e-6, rtol=1e-5
+        )
+    ys_off = _simulate_case_fused(
+        W, S, jnp.asarray(-1, jnp.int32), jnp.asarray(-1, jnp.int32),
+        CFG, spec, varying=True,
+    )
+    assert not np.allclose(
+        np.asarray(ys_v["bonds"][5]), np.asarray(ys_off["bonds"][5])
+    )
+
+
+def test_varying_suffix_resume_randomized():
+    """The PR 14 suffix-resume contract on the new rung: resuming from
+    a returned carry at randomized checkpoint epochs reproduces the
+    same-structured composition bitwise (repeat determinism) and the
+    monolithic run to reduction-order rounding."""
+    rng = np.random.default_rng(7)
+    W, S = _workload(seed=8, E=16)
+    mono = fused_varying_scan(
+        W, S, save_bonds=False, save_incentives=False, epoch_tile=4,
+        carry=_zero_carry(BondsMode.EMA, 6, 18), epoch_offset=0,
+        return_carry=True,
+    )
+    for k in sorted(rng.choice(np.arange(1, 16), size=4, replace=False)):
+        k = int(k)
+
+        def run_split():
+            pre = fused_varying_scan(
+                W[:k], S[:k], save_bonds=False, save_incentives=False,
+                carry=_zero_carry(BondsMode.EMA, 6, 18), epoch_offset=0,
+                return_carry=True,
+            )
+            carry = {
+                "bonds": pre["final_bonds"],
+                "consensus": pre["final_consensus"],
+            }
+            suf = fused_varying_scan(
+                W[k:], S[k:], save_bonds=False, save_incentives=False,
+                carry=carry, epoch_offset=k, return_carry=True,
+            )
+            return np.concatenate(
+                [pre["dividends_normalized"], suf["dividends_normalized"]]
+            )
+
+        a, b = run_split(), run_split()
+        assert np.array_equal(a, b), f"resume at {k} nondeterministic"
+        np.testing.assert_allclose(
+            a,
+            np.asarray(mono["dividends_normalized"]),
+            atol=1e-6,
+            rtol=1e-5,
+            err_msg=f"resume at {k}",
+        )
+
+
+# ---------------------------------------------------------------------------
+# admission model + planner
+
+
+def test_varying_tile_chooser_divisor_and_vmem():
+    mode = BondsMode.EMA
+    # Small shape: the deepest tile that divides E wins.
+    assert varying_scan_epoch_tile((12, 3, 2), mode) == 12
+    assert varying_scan_epoch_tile((40, 3, 2), mode) == 10
+    assert (
+        varying_scan_epoch_tile((1024, 3, 2), mode)
+        == VARYING_EPOCH_TILE_MAX
+    )
+    # Prime epoch counts beyond the cap cannot tile.
+    assert varying_scan_epoch_tile((17, 3, 2), mode) == 1
+    # The bench flagship: VMEM shrinks the tile below the cap but the
+    # divisor structure (2^10) keeps a deep one.
+    t = varying_scan_epoch_tile((1024, 256, 4096), mode)
+    assert 2 <= t < VARYING_EPOCH_TILE_MAX
+    # A shape too large for even a single-epoch tile reports 0.
+    assert varying_scan_epoch_tile((4, 2048, 16384), mode) == 0
+    # The admission model is monotone in the tile.
+    mats = [
+        _varying_scan_mats(et, mode, save_bonds=False) for et in (1, 2, 4)
+    ]
+    assert mats == sorted(mats)
+    assert _varying_scan_mats(2, mode, save_bonds=True) > _varying_scan_mats(
+        2, mode, save_bonds=False
+    )
+
+
+def test_varying_eligibility_gates():
+    spec = variant_for_version(VERSION)
+    shape = (12, 6, 18)
+    if not ON_TPU:
+        # Interpret mode would be slower than XLA, not faster: the
+        # auto predicate refuses off-TPU exactly like the case scan's.
+        assert not fused_varying_scan_eligible(
+            shape, spec.bonds_mode, CFG, jnp.float32
+        )
+    assert not fused_varying_scan_eligible(
+        shape, spec.bonds_mode, CFG, jnp.float64
+    )
+
+
+def test_planner_ladder_and_rungs():
+    assert ENGINE_LADDER == (
+        "fused_varying_mxu",
+        "fused_varying",
+        "fused_scan_mxu",
+        "fused_scan",
+        "xla",
+    )
+    assert FUSED_CASE_RUNGS == ENGINE_LADDER[:-1]
+    assert rung_flags("fused_varying_mxu") == {
+        "mxu": True,
+        "varying": True,
+    }
+    assert rung_flags("fused_scan") == {"mxu": False, "varying": False}
+    assert ladder_from("fused_varying") == (
+        "fused_varying",
+        "fused_scan_mxu",
+        "fused_scan",
+        "xla",
+    )
+
+
+def test_planner_explicit_varying_preconditions():
+    from yuma_simulation_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="bisection"):
+        plan_dispatch(
+            "t", (12, 6, 18), VERSION, CFG, jnp.float32,
+            epoch_impl="fused_varying", consensus_impl="sorted",
+        )
+    with pytest.raises(ValueError, match="single-core"):
+        plan_dispatch(
+            "t", (12, 6, 18), VERSION, CFG, jnp.float32,
+            epoch_impl="fused_varying_mxu", mesh=make_mesh(),
+        )
+    with pytest.raises(ValueError, match="quarantine"):
+        plan_dispatch(
+            "t", (12, 6, 18), VERSION, CFG, jnp.float32,
+            epoch_impl="fused_varying", quarantine=True,
+        )
+    with pytest.raises(ValueError, match="miner"):
+        plan_dispatch(
+            "t", (2, 12, 6, 18), VERSION, CFG, jnp.float32,
+            epoch_impl="fused_varying", has_miner_mask=True,
+        )
+
+
+def test_planner_explicit_varying_rejects_inadmissible_shape():
+    """An explicit varying-rung request for a shape no epoch tile can
+    fit must fail at PLAN time (the serving tier admits through
+    plan_dispatch — a typed 400, not a mid-dispatch kernel error)."""
+    with pytest.raises(ValueError, match="any tile"):
+        plan_dispatch(
+            "t", (8, 2, 2048, 16384), VERSION, CFG, jnp.float32,
+            epoch_impl="fused_varying", check_memory=False,
+        )
+
+
+def test_supervisor_canary_rung_stays_in_family():
+    """A varying-rung primary must canary against its bitwise partner
+    (the VPU twin / itself), never the case-scan family — cross-kernel
+    dividends agree only to reduction-order rounding, which the
+    fingerprint comparison would flag as drift."""
+    from yuma_simulation_tpu.resilience.supervisor import SweepSupervisor
+
+    sup = SweepSupervisor.__new__(SweepSupervisor)
+    sup.canary_engine = None
+    assert sup._canary_rung("fused_varying_mxu") == "fused_varying"
+    assert sup._canary_rung("fused_varying") == "fused_varying"
+    # pre-existing pairings unchanged
+    assert sup._canary_rung("fused_scan_mxu") == "fused_scan"
+    assert sup._canary_rung("xla") == "xla"
+    sup.canary_engine = "xla"
+    assert sup._canary_rung("fused_varying_mxu") == "xla"
+
+
+def test_planner_varying_plan_demotes_down_the_ladder():
+    plan = plan_dispatch(
+        "t", (12, 6, 18), VERSION, CFG, jnp.float32,
+        epoch_impl="fused_varying_mxu",
+    )
+    assert plan.engine == "fused_varying_mxu"
+    assert plan.ladder == ENGINE_LADDER
+    demoted = plan.demoted("fused_scan")
+    assert demoted.engine == "fused_scan"
+    assert demoted.ladder == ("fused_scan", "xla")
+    with pytest.raises(ValueError, match="walks DOWN"):
+        demoted.demoted("fused_varying_mxu")
+    # fallback consensus is pre-resolved for the XLA rung.
+    assert plan.demoted("xla").consensus_impl == plan.fallback_consensus
+
+
+def test_planner_ladder_drops_mxu_rungs_beyond_limb_split():
+    """Demotion must never land on a rung that raises a caller error:
+    beyond V = 2^14 the exact MXU limb split does not cover the shape,
+    so `_mxu` rungs are dropped from the demotion walk."""
+    plan = plan_dispatch(
+        "t", (4, 2**14 + 8, 16), VERSION, CFG, jnp.float32,
+        epoch_impl="fused_varying", check_memory=False,
+    )
+    assert plan.engine == "fused_varying"
+    assert plan.ladder == ("fused_varying", "fused_scan", "xla")
+
+
+def test_planner_auto_stays_xla_off_tpu():
+    if ON_TPU:
+        pytest.skip("auto resolves to a fused rung on TPU")
+    plan = plan_dispatch("t", (12, 6, 18), VERSION, CFG, jnp.float32)
+    assert plan.engine == "xla"
+
+
+# ---------------------------------------------------------------------------
+# engine + streaming + numerics integration
+
+
+def _scenario(E=12, V=6, M=18, seed=0):
+    rng = np.random.default_rng(seed)
+    return Scenario(
+        name="varying",
+        validators=[f"v{i}" for i in range(V)],
+        base_validator="v0",
+        weights=rng.random((E, V, M)).astype(np.float32),
+        stakes=(rng.random((E, V)) + 0.01).astype(np.float32),
+        num_epochs=E,
+    )
+
+
+def test_simulate_varying_rung_end_to_end():
+    sc = _scenario()
+    rx = simulate(sc, VERSION, epoch_impl="xla")
+    rv = simulate(sc, VERSION, epoch_impl="fused_varying")
+    rvm = simulate(sc, VERSION, epoch_impl="fused_varying_mxu")
+    np.testing.assert_allclose(
+        rv.dividends, rx.dividends, atol=2e-6, rtol=1e-5
+    )
+    # MXU == VPU at the engine level too (the canary pairing).
+    assert np.array_equal(rvm.dividends, rv.dividends)
+
+
+def test_simulate_varying_suffix_resume_state_contract():
+    sc = _scenario(E=12)
+    full = simulate(
+        sc, VERSION, epoch_impl="fused_varying", return_state=True
+    )
+    pre_sc = _scenario(E=12)
+    pre_sc.weights, pre_sc.stakes, pre_sc.num_epochs = (
+        sc.weights[:6],
+        sc.stakes[:6],
+        6,
+    )
+    pre = simulate(
+        pre_sc, VERSION, epoch_impl="fused_varying", return_state=True
+    )
+    suf_sc = _scenario(E=12)
+    suf_sc.weights, suf_sc.stakes, suf_sc.num_epochs = (
+        sc.weights[6:],
+        sc.stakes[6:],
+        6,
+    )
+    suf = simulate(
+        suf_sc, VERSION, epoch_impl="fused_varying",
+        initial_state=pre.final_state, epoch_offset=6,
+    )
+    np.testing.assert_allclose(
+        np.concatenate([pre.dividends, suf.dividends]),
+        full.dividends,
+        atol=1e-6,
+        rtol=1e-5,
+    )
+    assert set(full.final_state) == {"bonds", "consensus"}
+
+
+def test_simulate_streamed_varying_rung():
+    sc = _scenario(E=16)
+    mono = simulate(sc, VERSION, epoch_impl="fused_varying")
+    chunks = [
+        (sc.weights[lo : lo + 4], sc.stakes[lo : lo + 4])
+        for lo in range(0, 16, 4)
+    ]
+    streamed = simulate_streamed(
+        chunks, VERSION, save_bonds=False, save_incentives=False,
+        epoch_impl="fused_varying",
+    )
+    rep = simulate_streamed(
+        list(chunks), VERSION, save_bonds=False, save_incentives=False,
+        epoch_impl="fused_varying",
+    )
+    # Streamed runs are deterministic (bitwise repeatable) and agree
+    # with the monolithic dispatch to reduction-order rounding.
+    assert np.array_equal(streamed.dividends, rep.dividends)
+    np.testing.assert_allclose(
+        streamed.dividends, mono.dividends, atol=1e-6, rtol=1e-5
+    )
+
+
+def test_varying_numerics_capture_streams():
+    """The in-scan NumericsSketch capture rides the varying rung with
+    the SAME sketch spelling; the consensus stream (phase-1 surface) is
+    bitwise the case scan's, so cross-tile canaries on that stream can
+    never false-alarm."""
+    W, S = _workload(seed=9)
+    ri = jnp.asarray(-1, jnp.int32)
+    spec = variant_for_version(VERSION)
+    ys_v = _simulate_case_fused(
+        W, S, ri, ri, CFG, spec, save_consensus=True, varying=True,
+        capture_numerics=True,
+    )
+    ys_c = _simulate_case_fused(
+        W, S, ri, ri, CFG, spec, save_consensus=True, varying=False,
+        capture_numerics=True,
+    )
+    assert set(ys_v["numerics"]) == {"dividends", "consensus"}
+    cons_v = ys_v["numerics"]["consensus"]
+    cons_c = ys_c["numerics"]["consensus"]
+    assert np.array_equal(
+        np.asarray(cons_v.fingerprint), np.asarray(cons_c.fingerprint)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Monte-Carlo integration
+
+
+def test_mc_batched_varying_rung_matches_oracle():
+    from yuma_simulation_tpu.parallel.sharded import (
+        montecarlo_per_epoch_batched,
+    )
+
+    key = jax.random.PRNGKey(5)
+    args = (key, 3, 8, 6, 18, VERSION)
+    oracle = montecarlo_per_epoch_batched(
+        *args, consensus_impl="bisect", epoch_impl="xla"
+    )
+    for impl in ("fused_varying", "fused_varying_mxu"):
+        got = montecarlo_per_epoch_batched(
+            *args, consensus_impl="bisect", epoch_impl=impl
+        )
+        np.testing.assert_allclose(
+            got, oracle, atol=2e-6, rtol=1e-5, err_msg=impl
+        )
+    # chunk-length invariance on the varying rung: reduction-order
+    # rounding across slab programs (epoch-ordered accumulation).
+    a = montecarlo_per_epoch_batched(
+        *args, consensus_impl="bisect", epoch_impl="fused_varying",
+        chunk_epochs=4,
+    )
+    b = montecarlo_per_epoch_batched(
+        *args, consensus_impl="bisect", epoch_impl="fused_varying",
+        chunk_epochs=8,
+    )
+    np.testing.assert_allclose(a, b, atol=2e-6, rtol=1e-5)
+
+
+def test_mc_total_dividends_single_device_delegates():
+    """montecarlo_total_dividends(auto, per_epoch) on a one-device mesh
+    routes through the planned batched driver — bitwise the shard_map
+    tier on the XLA rung (shared step function, shared key scheme)."""
+    from yuma_simulation_tpu.parallel import make_mesh
+    from yuma_simulation_tpu.parallel.sharded import (
+        montecarlo_per_epoch_batched,
+        montecarlo_total_dividends,
+    )
+
+    mesh = make_mesh()
+    if int(mesh.devices.size) != 1:
+        pytest.skip("single-device delegation path")
+    key = jax.random.PRNGKey(11)
+    auto = montecarlo_total_dividends(
+        key, 3, 6, 6, 18, VERSION, mesh=mesh,
+        weights_mode="per_epoch", consensus_impl="bisect",
+    )
+    shard_tier = montecarlo_total_dividends(
+        key, 3, 6, 6, 18, VERSION, mesh=mesh,
+        weights_mode="per_epoch", consensus_impl="bisect",
+        epoch_impl="xla",
+    )
+    batched = montecarlo_per_epoch_batched(
+        key, 3, 6, 6, 18, VERSION, consensus_impl="bisect"
+    )
+    assert np.array_equal(auto, batched)
+    if not ON_TPU:
+        # Off-TPU the delegated path runs the batched XLA oracle,
+        # which is pinned bitwise against the shard body.
+        assert np.array_equal(auto, shard_tier)
+
+
+# ---------------------------------------------------------------------------
+# on-chip variants (gated like every other fused-kernel battery)
+
+
+@pytest.mark.skipif(not ON_TPU, reason="real-TPU Mosaic compile only")
+def test_varying_scan_compiles_on_chip():
+    W, S = _workload(seed=10, E=16, V=16, M=256)
+    out = fused_varying_scan(W, S, epoch_tile=4, save_bonds=False)
+    assert np.isfinite(np.asarray(out["dividends_normalized"])).all()
+    mx = fused_varying_scan(W, S, epoch_tile=4, save_bonds=False, mxu=True)
+    assert np.array_equal(
+        np.asarray(out["dividends_normalized"]),
+        np.asarray(mx["dividends_normalized"]),
+    )
+
+
+@pytest.mark.skipif(not ON_TPU, reason="real-TPU planner auto only")
+def test_planner_auto_prefers_varying_rung_on_chip():
+    plan = plan_dispatch("t", (1024, 256, 4096), VERSION, CFG, jnp.float32)
+    assert plan.engine == "fused_varying_mxu"
+    assert any("epoch-tiled" in r for r in plan.reasons)

@@ -105,9 +105,26 @@ COST_FIELDS = ("flops", "bytes_accessed", "peak_bytes")
 #: same backend+smoke class).
 TRACKED_SECONDARY = (
     "true_weights_xla",
+    "true_weights_fused",
     "streamed_true_weights",
     "montecarlo_per_epoch_weights",
+    "montecarlo_per_epoch_fused",
 )
+
+#: Floor-of-floors for the attained-fraction gate (ISSUE 15 ratchet):
+#: the effective floor per rung is ``max(record's declaration, this)``
+#: — so a bench-side edit (or a hand-crafted history record) can only
+#: TIGHTEN the roofline-distance backstop, never silently loosen it.
+#: Values mirror bench.py's r06 ATTAINED_FLOORS; the CLI
+#: ``--attained-floor`` override still wins outright (explicit operator
+#: intent).
+DEFAULT_ATTAINED_FLOORS = {
+    "fused_varying_mxu": 0.02,
+    "fused_varying": 0.02,
+    "fused_scan_mxu": 0.02,
+    "fused_scan": 0.02,
+    "xla": 0.002,
+}
 
 
 def load_history(path: str) -> list[dict]:
@@ -272,12 +289,21 @@ def check_attained(record: dict, floors: Optional[dict] = None) -> list[str]:
     (bench.py writes conservative per-rung backstops — the roofline is
     an amortization-optimistic CEILING, so floors catch collapses, and
     the rolling-baseline diff on the ``attained:*`` metrics catches
-    finer drift), overridden per rung by ``floors`` (the
+    finer drift), RAISED to :data:`DEFAULT_ATTAINED_FLOORS` where the
+    declaration sits below it (the ratchet: a record cannot loosen the
+    backstop), overridden per rung by ``floors`` (the
     ``--attained-floor`` CLI). Rungs whose attained fraction is null
     (no measured rate, unknown device spec — every CPU build) are
     vacuously fine: the STRUCTURAL gate already demands the nulls be
     explicable, and inventing a fraction would gate noise."""
     declared = dict(record.get("attained_floor") or {})
+    for engine, floor in DEFAULT_ATTAINED_FLOORS.items():
+        prior = declared.get(engine)
+        declared[engine] = (
+            max(float(prior), floor)
+            if isinstance(prior, (int, float))
+            else floor
+        )
     declared.update(floors or {})
     failures: list[str] = []
     for engine, rl in (record.get("rooflines") or {}).items():

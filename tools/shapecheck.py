@@ -2,7 +2,8 @@
 
 `python -m tools.shapecheck --check` abstractly traces (``jax.eval_shape``)
 every jitted entry point of the package — the three engine rungs
-(`_simulate_scan`, `_simulate_case_fused` VPU and MXU), their
+(`_simulate_scan`, `_simulate_case_fused` VPU/MXU, per-epoch and
+epoch-tiled varying), their
 donated-carry streamed twins, the batched sweep body, the Monte-Carlo
 helpers, and the throughput paths — over the planner's shape-bucket
 grid, built from ``ShapeDtypeStruct``s only. It verifies, without a
@@ -89,7 +90,13 @@ SPEC_VERSIONS = (
 
 #: Engine rungs the contract table covers; the planner-coupling check
 #: fails if plan_dispatch ever resolves a rung outside this set.
-COVERED_RUNGS = ("fused_scan_mxu", "fused_scan", "xla")
+COVERED_RUNGS = (
+    "fused_varying_mxu",
+    "fused_varying",
+    "fused_scan_mxu",
+    "fused_scan",
+    "xla",
+)
 
 
 def _sds(shape, dtype) -> jax.ShapeDtypeStruct:
@@ -213,13 +220,15 @@ def _run_xla(b: ShapeBucket, spec, cfg) -> str:
     return _tree_mismatches(got, _engine_expect(b), "ys")
 
 
-def _run_fused(b: ShapeBucket, spec, cfg, *, mxu: bool) -> str:
+def _run_fused(
+    b: ShapeBucket, spec, cfg, *, mxu: bool, varying: bool = False
+) -> str:
     W, S, ri, re_ = _engine_inputs(b)
     got = jax.eval_shape(
         lambda W, S, ri, re_, cfg: engine._simulate_case_fused(
             W, S, ri, re_, cfg, spec,
             save_bonds=True, save_incentives=True, save_consensus=True,
-            mxu=mxu,
+            mxu=mxu, varying=varying,
         ),
         W, S, ri, re_, cfg,
     )
@@ -249,7 +258,9 @@ def _run_numerics(b: ShapeBucket, spec, cfg) -> str:
     return _tree_mismatches(got, want, "ys")
 
 
-def _run_streamed(b: ShapeBucket, spec, cfg, *, fused: bool) -> str:
+def _run_streamed(
+    b: ShapeBucket, spec, cfg, *, fused: bool, varying: bool = False
+) -> str:
     """Donation validity: the donated chunk carry must round-trip to a
     structurally identical carry-out, or donation would be unsound (the
     donated buffer could not back the next chunk's carry)."""
@@ -262,7 +273,7 @@ def _run_streamed(b: ShapeBucket, spec, cfg, *, fused: bool) -> str:
             return fn(
                 W, S, ri, re_, cfg, spec,
                 save_bonds=False, save_incentives=False,
-                carry=c, return_carry=True,
+                carry=c, return_carry=True, varying=varying,
             )
     else:
         fn = engine._simulate_scan_streamed
@@ -307,13 +318,14 @@ def _run_suffix_resume(b: ShapeBucket, spec, cfg, *, rung: str) -> str:
                 carry=c, epoch_offset=off, return_carry=True,
             )
     else:
+        from yuma_simulation_tpu.simulation.planner import rung_flags
 
         def call(W, S, ri, re_, cfg, c, off):
             return engine._simulate_case_fused(
                 W, S, ri, re_, cfg, spec,
                 save_bonds=False, save_incentives=True,
-                mxu=rung == "fused_scan_mxu",
                 carry=c, epoch_offset=off, return_carry=True,
+                **rung_flags(rung),
             )
 
     ys, carry_out = jax.eval_shape(
@@ -527,8 +539,23 @@ def run_shapecheck(cfg: Optional[YumaConfig] = None) -> list[CheckResult]:
                 record("engine-xla", tag, _run_xla(b, spec, cfg))
                 record("engine-fused", tag, _run_fused(b, spec, cfg, mxu=False))
                 record("engine-mxu", tag, _run_fused(b, spec, cfg, mxu=True))
+                record(
+                    "engine-varying",
+                    tag,
+                    _run_fused(b, spec, cfg, mxu=False, varying=True),
+                )
+                record(
+                    "engine-varying-mxu",
+                    tag,
+                    _run_fused(b, spec, cfg, mxu=True, varying=True),
+                )
                 record("streamed-xla", tag, _run_streamed(b, spec, cfg, fused=False))
                 record("streamed-fused", tag, _run_streamed(b, spec, cfg, fused=True))
+                record(
+                    "streamed-varying",
+                    tag,
+                    _run_streamed(b, spec, cfg, fused=True, varying=True),
+                )
                 for rung in COVERED_RUNGS:
                     record(
                         f"suffix-resume-{rung}",

@@ -434,48 +434,27 @@ def _liquid_rate_on_grid(
     return (1.0 - jnp.clip(sig, alpha_low, alpha_high)).astype(dtype)
 
 
-def _epoch_math(
+def _consensus_phase(
     W,
     S,
-    B_old,
-    clip_prev,
-    first,
     kappa,
-    beta,
-    alpha,
     *,
     iters: int,
-    mode: BondsMode,
     mxu: bool,
     m_real: int,
-    clip_fallback=None,
-    cap_alpha=None,
-    decay=None,
-    liquid: bool = False,
-    liquid_scal=None,  # (logit_low, logit_num, alpha_low, alpha_high)
-    liquid_overrides=(None, None),  # static (override_high, override_low)
-    rust64: bool = False,  # static: emulate Yuma-0's f64 quantize divide
+    rust64: bool = False,
 ):
-    """The one shared epoch pipeline all fused kernels trace:
-    row-normalize -> bisection -> u16 quantize -> clip -> incentive ->
-    bond update (EMA / capacity purchase / relative) -> normalized
-    dividends.
-
-    `clip_prev` is the EMA_PREV clip source (ignored by the other modes;
-    None means "clip against this epoch's W_n"). `first` is the traced
-    first-epoch predicate for the EMA blend. `clip_fallback` (kwarg)
-    additionally selects W_n over `clip_prev` when true — the scan kernel
-    uses it at grid step 0 where its scratch is not yet a previous epoch;
-    the per-epoch kernel resolves that fallback caller-side and passes
-    None. Returns `(B_ema, D_n [..., V, 1], incentive [..., 1, Mp], W_n,
-    C [..., 1, Mp])`.
-
-    All reductions use negative axes so leading batch dims (the batched
-    scan kernel: `[B, Vp, Mp]` arrays, one scenario per leading index)
-    flow through unchanged; `S` is then `[..., Vp, 1]` and every
-    normalization is per-scenario; the MXU support contraction treats
-    leading dims as dot batch dimensions.
-    """
+    """The bond-independent front half of the epoch pipeline:
+    row-normalize -> bisection consensus -> u16 quantize. Split out of
+    :func:`_epoch_math` (ops and order unchanged, so per-epoch values
+    stay bitwise the per-epoch kernels') because nothing here reads the
+    bond state — which is what lets :func:`fused_varying_scan` run it
+    for a whole EPOCH TILE at once: a `[T, ..., Vp, Mp]` call computes
+    T independent epochs' consensus in one vectorized pass, filling the
+    (8, 128) tile that a single small suite would waste. Leading batch
+    dims (scenario batch AND epoch tile) flow through every reduction;
+    the MXU support contraction treats them as dot batch dimensions.
+    Returns `(W_n, C [..., 1, Mp])`."""
     Mp = W.shape[-1]
 
     W_n = W / (jnp.sum(W, axis=-1, keepdims=True) + 1e-6)
@@ -546,20 +525,26 @@ def _epoch_math(
             denom = jnp.sum(c_hi, axis=-1, keepdims=True)
         C = c_hi / denom * 65535.0
         C = C.astype(jnp.int32).astype(W.dtype) / 65535.0
+    return W_n, C
 
-    if clip_prev is not None:
-        # Only the EMA_PREV callers pass this (both kernels guard it).
-        # Grid step 0 of the scan falls back to this epoch's normalized
-        # weights (reference yumas.py:299-300). A select, not an
-        # arithmetic blend — a blend would do 0 * clip_prev, which
-        # poisons on uninitialized scratch.
-        clip_base = (
-            clip_prev
-            if clip_fallback is None
-            else jnp.where(clip_fallback, W_n, clip_prev)
-        )
-    else:
-        clip_base = W_n
+
+def _clip_rank_rate(
+    S,
+    C,
+    clip_base,
+    alpha,
+    *,
+    mode: BondsMode,
+    m_real: int,
+    liquid: bool = False,
+    liquid_scal=None,
+    liquid_overrides=(None, None),
+):
+    """Consensus clip, rank/incentive and the per-miner EMA rate — still
+    bond-independent (split out of :func:`_epoch_math` unchanged for the
+    same epoch-tile batching as :func:`_consensus_phase`). Returns
+    `(W_clipped, incentive [..., 1, Mp], rate)`; `rate` is `alpha`
+    passed through when liquid alpha is off."""
     W_clipped = jnp.minimum(clip_base, C)
 
     # Rank: once per epoch (vs 17 support halvings), always VPU f32.
@@ -577,8 +562,29 @@ def _epoch_math(
             override_high=liquid_overrides[0],
             override_low=liquid_overrides[1],
         )
+    return W_clipped, incentive, rate
 
-    # Bond update, by model family.
+
+def _bond_phase(
+    S,
+    B_old,
+    W_n,
+    clip_base,
+    W_clipped,
+    incentive,
+    rate,
+    first,
+    beta,
+    *,
+    mode: BondsMode,
+    cap_alpha=None,
+    decay=None,
+):
+    """The bond-state back half of the epoch pipeline: the only part of
+    :func:`_epoch_math` that reads the carried bond state, so it is the
+    only part :func:`fused_varying_scan` runs sequentially per epoch
+    inside a tile. Ops and order are exactly `_epoch_math`'s. Returns
+    `(B_next, D_n [..., V, 1])`."""
     if mode in _EMA_MODES:
         if mode is BondsMode.EMA_RUST:
             B_t = S * W_clipped
@@ -603,7 +609,7 @@ def _epoch_math(
         # Stake-capacity purchase, mirroring
         # models.epoch.capacity_bonds_update (reference yumas.py:455-472):
         # the 2^64-1 constant enters f32 arithmetic deliberately.
-        cap_vec = S * jnp.asarray(MAXINT, W.dtype)  # [..., V, 1]
+        cap_vec = S * jnp.asarray(MAXINT, S.dtype)  # [..., V, 1]
         remaining = jnp.clip(cap_vec - B_old, min=0.0)
         purchase = jnp.minimum(cap_alpha * cap_vec, remaining) * W_n
         B_next = (1.0 - decay) * B_old + purchase
@@ -626,6 +632,97 @@ def _epoch_math(
     # the same (V-then-singleton) order.
     D_tot = jnp.sum(jnp.sum(D, axis=-1, keepdims=True), axis=-2, keepdims=True)
     D_n = D / (D_tot + 1e-6)
+    return B_next, D_n
+
+
+def _epoch_math(
+    W,
+    S,
+    B_old,
+    clip_prev,
+    first,
+    kappa,
+    beta,
+    alpha,
+    *,
+    iters: int,
+    mode: BondsMode,
+    mxu: bool,
+    m_real: int,
+    clip_fallback=None,
+    cap_alpha=None,
+    decay=None,
+    liquid: bool = False,
+    liquid_scal=None,  # (logit_low, logit_num, alpha_low, alpha_high)
+    liquid_overrides=(None, None),  # static (override_high, override_low)
+    rust64: bool = False,  # static: emulate Yuma-0's f64 quantize divide
+):
+    """The one shared epoch pipeline all fused kernels trace:
+    row-normalize -> bisection -> u16 quantize -> clip -> incentive ->
+    bond update (EMA / capacity purchase / relative) -> normalized
+    dividends — composed from :func:`_consensus_phase`,
+    :func:`_clip_rank_rate` and :func:`_bond_phase` (the split lets the
+    epoch-tiled :func:`fused_varying_scan` batch the bond-independent
+    phases over a whole tile; composition here is op-for-op the
+    pre-split spelling, so per-epoch values are unchanged bitwise).
+
+    `clip_prev` is the EMA_PREV clip source (ignored by the other modes;
+    None means "clip against this epoch's W_n"). `first` is the traced
+    first-epoch predicate for the EMA blend. `clip_fallback` (kwarg)
+    additionally selects W_n over `clip_prev` when true — the scan kernel
+    uses it at grid step 0 where its scratch is not yet a previous epoch;
+    the per-epoch kernel resolves that fallback caller-side and passes
+    None. Returns `(B_ema, D_n [..., V, 1], incentive [..., 1, Mp], W_n,
+    C [..., 1, Mp])`.
+
+    All reductions use negative axes so leading batch dims (the batched
+    scan kernel: `[B, Vp, Mp]` arrays, one scenario per leading index)
+    flow through unchanged; `S` is then `[..., Vp, 1]` and every
+    normalization is per-scenario; the MXU support contraction treats
+    leading dims as dot batch dimensions.
+    """
+    W_n, C = _consensus_phase(
+        W, S, kappa, iters=iters, mxu=mxu, m_real=m_real, rust64=rust64
+    )
+
+    if clip_prev is not None:
+        # Only the EMA_PREV callers pass this (both kernels guard it).
+        # Grid step 0 of the scan falls back to this epoch's normalized
+        # weights (reference yumas.py:299-300). A select, not an
+        # arithmetic blend — a blend would do 0 * clip_prev, which
+        # poisons on uninitialized scratch.
+        clip_base = (
+            clip_prev
+            if clip_fallback is None
+            else jnp.where(clip_fallback, W_n, clip_prev)
+        )
+    else:
+        clip_base = W_n
+    W_clipped, incentive, rate = _clip_rank_rate(
+        S,
+        C,
+        clip_base,
+        alpha,
+        mode=mode,
+        m_real=m_real,
+        liquid=liquid,
+        liquid_scal=liquid_scal,
+        liquid_overrides=liquid_overrides,
+    )
+    B_next, D_n = _bond_phase(
+        S,
+        B_old,
+        W_n,
+        clip_base,
+        W_clipped,
+        incentive,
+        rate,
+        first,
+        beta,
+        mode=mode,
+        cap_alpha=cap_alpha,
+        decay=decay,
+    )
     return B_next, D_n, incentive, W_n, C
 
 
@@ -1731,6 +1828,676 @@ def fused_case_scan(
     if save_consensus:
         c = res.pop(0)
         out["consensus"] = (jnp.moveaxis(c, 0, 1) if lead else c)[..., 0, :M]
+    if return_carry:
+        out["final_consensus"] = res.pop(0)[..., 0, :M]
+        if mode is BondsMode.EMA_PREV:
+            out["final_w_prev"] = res.pop(0)[..., :V, :M]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the epoch-tiled varying-weights scan (ISSUE 15): fused_case_scan's
+# twin for workloads where one epoch's [Vp, Mp] block cannot fill the
+# chip — T epochs' bond-independent math runs as ONE batched pass.
+
+#: Epoch-tile ceiling for :func:`fused_varying_scan`. Beyond ~16 the
+#: batched consensus phase is compute-bound anyway and the tile only
+#: inflates the double-buffered slab residency; the admission model
+#: (`_varying_scan_mats`) shrinks the tile below this wherever VMEM
+#: demands it.
+VARYING_EPOCH_TILE_MAX = 16
+
+
+def _varying_scan_mats(
+    epoch_tile: int, mode: BondsMode, save_bonds: bool,
+    streaming: bool = False,
+) -> int:
+    """EFFECTIVE resident [.., Vp, Mp]-unit mats of
+    :func:`fused_varying_scan` at a given epoch tile, for the shared
+    VMEM admission model (:func:`_fits_vmem`): the double-buffered
+    `[T, .., Vp, Mp]` weight slab (2T), the tile's batched `W_n` and
+    `W_clipped` intermediates live across the phase boundary (2T), the
+    bond scratch, for EMA_PREV the previous-weights scratch plus the
+    tile-shifted clip base (T), per-epoch bond output blocks (2T) when
+    saved, and the chunk-carry residency when streaming (same
+    accounting as `_case_scan_mats`)."""
+    mats = 4 * epoch_tile + 1
+    if mode is BondsMode.EMA_PREV:
+        mats += epoch_tile + 1
+    if save_bonds:
+        mats += 2 * epoch_tile
+    if streaming:
+        mats += 1
+        if mode is BondsMode.EMA_PREV:
+            mats += 2
+    return mats
+
+
+@functools.lru_cache(maxsize=1024)
+def varying_scan_epoch_tile(
+    shape,
+    mode: BondsMode,
+    save_bonds: bool = False,
+    streaming: bool = False,
+) -> int:
+    """Largest epoch tile (<= :data:`VARYING_EPOCH_TILE_MAX`) that
+    DIVIDES the workload's epoch count and whose resident set fits the
+    measured VMEM budget — the planner's deeper-batching signal
+    (`auto` prefers the varying scan only when the tile reaches 2,
+    i.e. when the tiling actually buys parallelism over the per-epoch
+    case scan). The divisibility requirement keeps the kernel free of
+    epoch padding and validity masking: every grid step advances
+    exactly `tile` real epochs, so drivers that control their own
+    chunk lengths (the Monte-Carlo slab loop, the streaming re-slicer)
+    pick tile-friendly chunks instead. Returns 0 when even a
+    single-epoch tile does not fit."""
+    E = shape[-3]
+    Bb = shape[0] if len(shape) == 4 else 1
+    unit = _unit_bytes(shape[-2:]) * Bb
+    for et in range(min(VARYING_EPOCH_TILE_MAX, max(1, E)), 0, -1):
+        if E % et == 0 and _fits_vmem(
+            unit, _varying_scan_mats(et, mode, save_bonds, streaming)
+        ):
+            return et
+    return 0
+
+
+def fused_varying_scan_eligible(
+    shape,
+    mode: BondsMode,
+    config,
+    dtype=None,
+    save_bonds: bool = True,
+    streaming: bool = False,
+) -> bool:
+    """Whether :func:`fused_varying_scan` can run this workload — the
+    `epoch_impl="auto"` predicate for the `fused_varying` /
+    `fused_varying_mxu` rungs. Same correctness gates as
+    :func:`fused_case_scan_eligible` (mode/dtype/x64 parity/dyadic
+    int32/TPU backend) plus the epoch-tile VMEM admission."""
+    if mode not in _SCAN_MODES:
+        return False
+    if dtype is not None and jnp.dtype(dtype) != jnp.float32:
+        return False
+    if (
+        mode is BondsMode.EMA_RUST
+        and jax.config.jax_enable_x64
+        and (shape[-1] << math.ceil(math.log2(config.consensus_precision)))
+        >= 2**23
+    ):
+        # Same parity-mode guard as the case scan (advisor r4).
+        return False
+    if not _dyadic_grid_fits_int32(
+        shape[-1], math.ceil(math.log2(config.consensus_precision))
+    ):
+        # Same fallback-pairing gate as fused_case_scan_eligible
+        # (advisor r5): auto must not pair the two u16 fallbacks.
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    return (
+        varying_scan_epoch_tile(shape, mode, save_bonds, streaming) >= 1
+    )
+
+
+def _fused_varying_scan_kernel(
+    *refs,
+    iters: int,
+    mode: BondsMode,
+    mxu: bool,
+    m_real: int,
+    epoch_tile: int,
+    num_tiles: int,
+    liquid: bool,
+    reset_mode,
+    save_bonds: bool,
+    save_incentives: bool,
+    save_consensus: bool,
+    liquid_overrides: tuple = (None, None),
+    rust64: bool = False,
+    per_scenario_hp: bool = False,
+    per_scenario_rst: bool = False,
+    has_carry: bool = False,
+    return_carry: bool = False,
+):
+    """One grid step = one EPOCH TILE of `epoch_tile` epochs: the
+    `[T, (Bb,) Vp, Mp]` weight slab and `[T, (Bb,) Vp, 1]` stake slab
+    stream from HBM per step (Pallas double-buffers the next tile
+    during this one's compute), the bond-independent epoch math —
+    row-normalize, the 17-halving bisection, u16 quantize, clip, rank
+    and the liquid rate — runs ONCE for the whole tile with the epoch
+    axis as a leading batch dim (`_consensus_phase` /
+    `_clip_rank_rate`: every reduction is per-epoch, so per-epoch
+    values are bitwise the per-epoch kernels'), and only the cheap
+    bond recurrence (`_bond_phase`) walks the tile sequentially in a
+    statically unrolled loop. Small (3v x 2m-class) suites whose padded
+    `[8, 128]` block wastes the tile thereby advance T epochs per
+    traversal instead of one.
+
+    The tile DIVIDES the epoch count by the wrapper's contract
+    (`varying_scan_epoch_tile`), so there is no epoch padding and no
+    validity masking: every grid step advances exactly `epoch_tile`
+    real epochs. The chunked-streaming / suffix-resume carry contract
+    (`has_carry` / `off` / `return_carry`) is the case-scan kernel's,
+    unchanged."""
+    refs = list(refs)
+    hp_or_scal_ref = refs.pop(0)
+    rst_ref = refs.pop(0)
+    off_ref = refs.pop(0)
+    if has_carry:
+        cb_ref = refs.pop(0)
+        cc_ref = refs.pop(0)
+        cwp_ref = refs.pop(0) if mode is BondsMode.EMA_PREV else None
+    s_ref, w_ref, dn_ref, bfin_ref = refs[:4]
+    outs = refs[4:]
+    bonds_ref = outs.pop(0) if save_bonds else None
+    inc_ref = outs.pop(0) if save_incentives else None
+    cons_ref = outs.pop(0) if save_consensus else None
+    cfin_ref = outs.pop(0) if return_carry else None
+    wpfin_ref = (
+        outs.pop(0)
+        if return_carry and mode is BondsMode.EMA_PREV
+        else None
+    )
+    b_scr = outs.pop(0)
+    cprev_scr = outs.pop(0)
+    wprev_scr = outs.pop(0) if mode is BondsMode.EMA_PREV else None
+
+    if per_scenario_hp:
+        hp = hp_or_scal_ref[...]  # [Bb, 1, LANES]
+
+        def sc(i):
+            return hp[..., i : i + 1]  # [Bb, 1, 1]
+
+    else:
+
+        def sc(i):
+            return hp_or_scal_ref[i]
+
+    e = pl.program_id(0)
+    T = epoch_tile
+
+    @pl.when(e == 0)
+    def _init():
+        if has_carry:
+            b_scr[...] = cb_ref[...]
+            cprev_scr[...] = cc_ref[...]
+            if wprev_scr is not None:
+                wprev_scr[...] = cwp_ref[...]
+        else:
+            b_scr[...] = jnp.zeros_like(b_scr)
+            cprev_scr[...] = jnp.zeros_like(cprev_scr)
+            if wprev_scr is not None:
+                wprev_scr[...] = jnp.zeros_like(wprev_scr)
+
+    state_shape = b_scr.shape  # (Bb,) + (Vp, Mp) or (Vp, Mp)
+    Mp = state_shape[-1]
+    W = w_ref[...].reshape((T,) + state_shape)
+    S = s_ref[...].reshape((T,) + state_shape[:-1] + (1,))
+    # normalize_stake (reference yumas.py:75), per epoch per scenario.
+    S_n = S / jnp.sum(S, axis=-2, keepdims=True)
+    off = off_ref[0]
+
+    # ---- phase 1: bond-independent math, ALL T epochs in one pass.
+    W_n, C = _consensus_phase(
+        W, S_n, sc(0), iters=iters, mxu=mxu, m_real=m_real, rust64=rust64
+    )
+    if mode is BondsMode.EMA_PREV:
+        # Per-epoch first-global-epoch flags, broadcastable over the
+        # tile (the clip fallback at global epoch 0).
+        tt = lax.broadcasted_iota(
+            jnp.int32, (T,) + (1,) * len(state_shape), 0
+        )
+        first_b = (e * T + tt + off) == 0
+        # Previous epoch's normalized weights: in-tile a shift of W_n,
+        # across the tile boundary the carried scratch mat. Valid
+        # epochs are a contiguous tile prefix, so shifted values for
+        # valid epochs always come from valid (or carried) epochs.
+        prev0 = wprev_scr[...][None]
+        clip_prev = (
+            jnp.concatenate([prev0, W_n[:-1]], axis=0) if T > 1 else prev0
+        )
+        clip_base = jnp.where(first_b, W_n, clip_prev)
+    else:
+        clip_base = W_n
+    W_clipped, incentive, rate = _clip_rank_rate(
+        S_n,
+        C,
+        clip_base,
+        sc(2),
+        mode=mode,
+        m_real=m_real,
+        liquid=liquid,
+        liquid_scal=(sc(5), sc(6), sc(7), sc(8)),
+        liquid_overrides=liquid_overrides,
+    )
+    per_epoch_rate = liquid and mode is not BondsMode.CAPACITY
+
+    if reset_mode is not ResetMode.NONE:
+        if per_scenario_rst:
+            rst = rst_ref[...]  # [Bb, 1, LANES] int32
+            ri = rst[..., 0:1]  # [Bb, 1, 1]
+            r_epoch = rst[..., 1:2]
+        else:
+            ri = rst_ref[0]
+            r_epoch = rst_ref[1]
+        colm = lax.broadcasted_iota(jnp.int32, (1, Mp), 1)
+
+    # ---- phase 2: the bond recurrence, unrolled over the tile.
+    B = b_scr[...]
+    c_before = cprev_scr[...]
+    dn_rows, bond_rows, inc_rows, cons_rows = [], [], [], []
+    for t in range(T):
+        eg = e * T + t + off  # global epoch index across chunks
+        first = eg == 0
+        if reset_mode is not ResetMode.NONE:
+            # Bond-reset injection, exactly the case-scan kernel's
+            # spelling (reference simulation_utils.py:62-88), against
+            # the previous epoch's consensus (across the tile/chunk
+            # boundary: the carried scratch row).
+            do = (eg == r_epoch) & (eg > 0) & (ri >= 0)
+            if reset_mode is ResetMode.CONDITIONAL:
+                idx = jnp.clip(ri, 0, m_real - 1)
+                prev_c = jnp.sum(
+                    jnp.where(
+                        colm == idx, c_before if t == 0 else C[t - 1], 0.0
+                    ),
+                    axis=-1,
+                    keepdims=True,
+                )
+                do = do & (prev_c == 0.0)
+            B = jnp.where((colm == ri) & do, jnp.zeros_like(B), B)
+        B, D_n = _bond_phase(
+            S_n[t],
+            B,
+            W_n[t],
+            clip_base[t] if mode is BondsMode.EMA_PREV else W_n[t],
+            W_clipped[t],
+            incentive[t],
+            rate[t] if per_epoch_rate else rate,
+            first,
+            sc(1),
+            mode=mode,
+            cap_alpha=sc(3),
+            decay=sc(4),
+        )
+        dn_rows.append(D_n)
+        if bonds_ref is not None:
+            bond_rows.append(B)
+        if inc_ref is not None:
+            inc_rows.append(incentive[t])
+        if cons_ref is not None:
+            cons_rows.append(C[t])
+
+    b_scr[...] = B
+    cprev_scr[...] = C[T - 1]
+    if wprev_scr is not None:
+        wprev_scr[...] = W_n[T - 1]
+    dn_ref[...] = jnp.stack(dn_rows, axis=0).reshape(dn_ref.shape)
+    if bonds_ref is not None:
+        bonds_ref[...] = jnp.stack(bond_rows, axis=0).reshape(bonds_ref.shape)
+    if inc_ref is not None:
+        inc_ref[...] = jnp.stack(inc_rows, axis=0).reshape(inc_ref.shape)
+    if cons_ref is not None:
+        cons_ref[...] = jnp.stack(cons_rows, axis=0).reshape(cons_ref.shape)
+
+    @pl.when(e == num_tiles - 1)
+    def _emit():
+        bfin_ref[...] = b_scr[...]
+        if cfin_ref is not None:
+            cfin_ref[...] = cprev_scr[...]
+        if wpfin_ref is not None:
+            wpfin_ref[...] = wprev_scr[...]
+
+
+@functools.lru_cache(maxsize=None)
+def _varying_scan_kernel_cached(**params):
+    """Memoized kernel closure — same rationale as
+    :func:`_case_scan_kernel_cached`: repeated call sites with equal
+    static params must share ONE kernel-function identity or the
+    lowering cache (and the minutes-scale remote Mosaic compile) is
+    defeated per call site."""
+    return functools.partial(_fused_varying_scan_kernel, **params)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mode",
+        "reset_mode",
+        "mxu",
+        "interpret",
+        "precision",
+        "liquid_alpha",
+        "override_consensus_high",
+        "override_consensus_low",
+        "save_bonds",
+        "save_incentives",
+        "save_consensus",
+        "return_carry",
+        "epoch_tile",
+    ),
+)
+def fused_varying_scan(
+    W: jnp.ndarray,  # [E, V, M] per-epoch raw weights
+    S: jnp.ndarray,  # [E, V] per-epoch raw stakes
+    *,
+    reset_index=-1,
+    reset_epoch=-1,
+    reset_mode=None,
+    kappa=0.5,
+    bond_penalty=1.0,
+    bond_alpha=0.1,
+    capacity_alpha=0.1,
+    decay_rate=0.1,
+    liquid_alpha: bool = False,
+    alpha_low=0.7,
+    alpha_high=0.9,
+    override_consensus_high: float | None = None,
+    override_consensus_low: float | None = None,
+    mode: BondsMode = BondsMode.EMA,
+    mxu: bool = False,
+    precision: int = 100_000,
+    save_bonds: bool = True,
+    save_incentives: bool = True,
+    save_consensus: bool = False,
+    carry: dict | None = None,
+    epoch_offset=0,
+    return_carry: bool = False,
+    epoch_tile: int | None = None,
+    interpret: bool | None = None,
+):
+    """:func:`fused_case_scan`'s EPOCH-TILED twin — the varying-weights
+    fused engine (ISSUE 15): same inputs, same outputs, same carry /
+    `epoch_offset` / `return_carry` streaming contract, but each grid
+    step advances `epoch_tile` epochs, running all bond-independent
+    math (the 17 bisection traversals, the quantize, the rank, the
+    liquid fit) as ONE `[T, (Bb,) Vp, Mp]` batched pass and only the
+    bond recurrence sequentially. For workloads whose single-epoch
+    block underfills the chip — the reference's 3v x 2m cases padded to
+    one (8, 128) tile, per-epoch Monte-Carlo at small V x M — this is
+    how the varying-weights rung stops paying one whole-chip traversal
+    per tiny epoch.
+
+    `epoch_tile=None` picks the largest tile (<=
+    :data:`VARYING_EPOCH_TILE_MAX`) that DIVIDES E and fits the VMEM
+    admission model; an explicit tile must divide E and fit. The tile
+    changes HOW epochs are grouped, never the per-epoch math: the
+    consensus / incentive surface is bitwise the per-epoch case scan
+    for every tile length, and dividends/bonds match it (and the XLA
+    rung) to reduction-order rounding — while runs sharing one
+    program (same tile, same chunk length) are bitwise each other,
+    which is the invariance the streaming / suffix-resume drivers
+    thread chunks on (pinned by tests/unit/test_varying_scan.py).
+    """
+    if reset_mode is None:
+        reset_mode = ResetMode.NONE
+    if mode not in _SCAN_MODES:
+        raise ValueError(f"fused scan does not implement bonds mode {mode}")
+    rust64 = mode is BondsMode.EMA_RUST and bool(jax.config.jax_enable_x64)
+    if W.ndim == 4:
+        Bb, E, V, M = W.shape
+        lead: tuple[int, ...] = (Bb,)
+    else:
+        E, V, M = W.shape
+        lead = ()
+    if mxu and not exact_mxu_support_covers(V):
+        raise ValueError(
+            f"the exact MXU stake split covers V <= 2^14 validators, got "
+            f"V={V}; use the VPU path (mxu=False)"
+        )
+    if E < 1:
+        raise ValueError("fused scan requires at least one epoch")
+    if S.shape != lead + (E, V):
+        raise ValueError(
+            f"stakes must be {lead + (E, V)}, got {S.shape}"
+        )
+    dtype = W.dtype
+    iters = int(math.ceil(math.log2(precision)))
+    if rust64 and (M << iters) >= 2**31:
+        raise ValueError(
+            "the double-single f64-quantize emulation needs M * 2^iters "
+            "< 2^31 for its exact int32 column sum "
+            f"(M={M}, precision={precision}); use the XLA epoch path"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    streaming = carry is not None or return_carry
+    if epoch_tile is None:
+        epoch_tile = varying_scan_epoch_tile(
+            W.shape, mode, save_bonds, streaming=streaming
+        )
+        if epoch_tile < 1:
+            raise ValueError(
+                f"{list(W.shape)} too large for the epoch-tiled varying "
+                "scan at any tile; use the per-epoch case scan or the "
+                "XLA path"
+            )
+    else:
+        epoch_tile = int(epoch_tile)
+        if epoch_tile < 1:
+            raise ValueError(f"epoch_tile must be >= 1, got {epoch_tile}")
+        if E % epoch_tile != 0:
+            raise ValueError(
+                f"epoch_tile={epoch_tile} must divide the epoch count "
+                f"(E={E}): the kernel pads no epochs — drivers pick "
+                "tile-friendly chunk lengths instead"
+            )
+        Bb_ = lead[0] if lead else 1
+        if not _fits_vmem(
+            _unit_bytes(W.shape[-2:]) * Bb_,
+            _varying_scan_mats(epoch_tile, mode, save_bonds, streaming),
+        ):
+            raise ValueError(
+                f"epoch_tile={epoch_tile} does not fit the VMEM budget "
+                f"for {list(W.shape)}; lower the tile or use the "
+                "per-epoch case scan"
+            )
+    num_tiles = E // epoch_tile
+
+    Vp, Mp = _round_up(V, _SUBLANES), _round_up(M, _LANES)
+    padded = (Vp, Mp) != (V, M)
+    # Epoch-major layout (batch between the tile index and the block),
+    # tile-aligned shapes skip the padded materialization exactly as
+    # the case scan does.
+    W_em = jnp.moveaxis(W, -3, 0) if lead else W  # [E, (Bb,) V, M]
+    S_em = (
+        jnp.moveaxis(jnp.asarray(S, dtype), -2, 0)
+        if lead
+        else jnp.asarray(S, dtype)
+    )
+    W_p = (
+        jnp.zeros((E,) + lead + (Vp, Mp), dtype)
+        .at[..., :V, :M]
+        .set(W_em)
+        if padded
+        else W_em
+    )
+    S_p = (
+        jnp.zeros((E,) + lead + (Vp, 1), dtype)
+        .at[..., :V, 0]
+        .set(S_em)
+        if Vp != V
+        else S_em[..., None]
+    )
+    if liquid_alpha:
+        al = jnp.asarray(alpha_low, dtype)
+        ah = jnp.asarray(alpha_high, dtype)
+        logit_low = jnp.log(1.0 / al - 1.0)
+        logit_num = jnp.log(1.0 / ah - 1.0) - logit_low
+    else:
+        al = ah = logit_low = logit_num = jnp.zeros((), dtype)
+    hp_vals = [
+        jnp.asarray(kappa, dtype),
+        jnp.asarray(bond_penalty, dtype),
+        jnp.asarray(bond_alpha, dtype),
+        jnp.asarray(capacity_alpha, dtype),
+        jnp.asarray(decay_rate, dtype),
+        logit_low,
+        logit_num,
+        al,
+        ah,
+    ]
+    hp_operand, per_hp = _pack_hp(hp_vals, lead, dtype)
+    ri_v = jnp.asarray(reset_index, jnp.int32)
+    re_v = jnp.asarray(reset_epoch, jnp.int32)
+    per_rst = bool(lead)
+    if per_rst:
+        rst = jnp.zeros(lead + (1, _LANES), jnp.int32)
+        rst = rst.at[:, 0, 0].set(jnp.broadcast_to(ri_v, lead))
+        rst = rst.at[:, 0, 1].set(jnp.broadcast_to(re_v, lead))
+    else:
+        rst = jnp.stack([ri_v, re_v])
+    off = jnp.asarray(epoch_offset, jnp.int32).reshape(1)
+
+    has_carry = carry is not None
+    carry_ops: list = []
+    if has_carry:
+        need = {"bonds", "consensus"} | (
+            {"w_prev"} if mode is BondsMode.EMA_PREV else set()
+        )
+        if set(carry) != need:
+            raise ValueError(
+                f"carry must have exactly keys {sorted(need)} for "
+                f"mode {mode}, got {sorted(carry)}"
+            )
+
+        def pad_vm(x):
+            x = jnp.asarray(x, dtype)
+            if x.shape != lead + (V, M):
+                raise ValueError(
+                    f"carry matrix must be {lead + (V, M)}, got {x.shape}"
+                )
+            if not padded:
+                return x
+            return jnp.zeros(lead + (Vp, Mp), dtype).at[..., :V, :M].set(x)
+
+        cc = jnp.asarray(carry["consensus"], dtype)
+        if cc.shape != lead + (M,):
+            raise ValueError(
+                f"carry consensus must be {lead + (M,)}, got {cc.shape}"
+            )
+        cc_p = (
+            jnp.zeros(lead + (1, Mp), dtype).at[..., 0, :M].set(cc)
+            if Mp != M
+            else cc[..., None, :]
+        )
+        carry_ops = [pad_vm(carry["bonds"]), cc_p]
+        if mode is BondsMode.EMA_PREV:
+            carry_ops.append(pad_vm(carry["w_prev"]))
+
+    T = epoch_tile
+    per_tile = lambda shape: pl.BlockSpec(  # noqa: E731
+        (T,) + shape,
+        lambda e: (e,) + tuple(0 for _ in shape),
+        memory_space=pltpu.VMEM,
+    )
+    fixed = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda e: tuple(0 for _ in shape), memory_space=pltpu.VMEM
+    )
+
+    out_specs = [per_tile(lead + (Vp, 1)), fixed(lead + (Vp, Mp))]
+    out_shape = [
+        jax.ShapeDtypeStruct((E,) + lead + (Vp, 1), dtype),
+        jax.ShapeDtypeStruct(lead + (Vp, Mp), dtype),
+    ]
+    if save_bonds:
+        out_specs.append(per_tile(lead + (Vp, Mp)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((E,) + lead + (Vp, Mp), dtype)
+        )
+    if save_incentives:
+        out_specs.append(per_tile(lead + (1, Mp)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((E,) + lead + (1, Mp), dtype)
+        )
+    if save_consensus:
+        out_specs.append(per_tile(lead + (1, Mp)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((E,) + lead + (1, Mp), dtype)
+        )
+    if return_carry:
+        out_specs.append(fixed(lead + (1, Mp)))
+        out_shape.append(jax.ShapeDtypeStruct(lead + (1, Mp), dtype))
+        if mode is BondsMode.EMA_PREV:
+            out_specs.append(fixed(lead + (Vp, Mp)))
+            out_shape.append(jax.ShapeDtypeStruct(lead + (Vp, Mp), dtype))
+
+    scratch = [
+        pltpu.VMEM(lead + (Vp, Mp), dtype),
+        pltpu.VMEM(lead + (1, Mp), dtype),
+    ]
+    if mode is BondsMode.EMA_PREV:
+        scratch.append(pltpu.VMEM(lead + (Vp, Mp), dtype))
+
+    res = pl.pallas_call(
+        _varying_scan_kernel_cached(
+            iters=iters,
+            mode=mode,
+            mxu=mxu,
+            m_real=M,
+            epoch_tile=T,
+            num_tiles=num_tiles,
+            liquid=liquid_alpha,
+            reset_mode=reset_mode,
+            save_bonds=save_bonds,
+            save_incentives=save_incentives,
+            save_consensus=save_consensus,
+            liquid_overrides=(
+                override_consensus_high,
+                override_consensus_low,
+            ),
+            rust64=rust64,
+            per_scenario_hp=per_hp,
+            per_scenario_rst=per_rst,
+            has_carry=has_carry,
+            return_carry=return_carry,
+        ),
+        grid=(num_tiles,),
+        in_specs=[
+            fixed(lead + (1, _LANES))
+            if per_hp
+            else pl.BlockSpec(memory_space=pltpu.SMEM),
+            fixed(lead + (1, _LANES))
+            if per_rst
+            else pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ]
+        + [fixed(op.shape) for op in carry_ops]
+        + [
+            per_tile(lead + (Vp, 1)),
+            per_tile(lead + (Vp, Mp)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+        compiler_params=None
+        if interpret
+        else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT,
+            dimension_semantics=("arbitrary",),
+        ),
+    )(hp_operand, rst, off, *carry_ops, S_p, W_p)
+
+    res = list(res)
+
+    def per_epoch_out(x):
+        """Move the batch axis out front of the epoch stream."""
+        return jnp.moveaxis(x, 0, 1) if lead else x
+
+    dn = per_epoch_out(res.pop(0))  # [(Bb,) E, Vp, 1]
+    out = {
+        "dividends_normalized": dn[..., :V, 0],
+        "final_bonds": res.pop(0)[..., :V, :M],
+    }
+    if save_bonds:
+        out["bonds"] = per_epoch_out(res.pop(0))[..., :V, :M]
+    if save_incentives:
+        out["incentives"] = per_epoch_out(res.pop(0))[..., 0, :M]
+    if save_consensus:
+        out["consensus"] = per_epoch_out(res.pop(0))[..., 0, :M]
     if return_carry:
         out["final_consensus"] = res.pop(0)[..., 0, :M]
         if mode is BondsMode.EMA_PREV:

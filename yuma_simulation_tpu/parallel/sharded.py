@@ -420,6 +420,33 @@ def montecarlo_total_dividends(
         resolve_montecarlo_engine,
     )
 
+    if varying and epoch_impl == "auto" and int(mesh.devices.size) == 1:
+        # Single-device per-epoch Monte-Carlo: route through the
+        # PLANNED batched driver instead of a one-shard `shard_map` —
+        # scenario keys match by construction (both spell them
+        # `split(split(key, 1)[0], B)`), the batched XLA rung is
+        # bitwise the shard body (shared `_mc_varying_step`, pinned by
+        # tests/unit/test_planner.py), and on TPU the planner admits
+        # the fused varying rung with device-generated weight slabs —
+        # so the public MC API reaches the epoch-tiled fused engine
+        # with no host->HBM weight feed and no collective machinery.
+        # An explicit epoch_impl="xla" keeps the shard_map tier (the
+        # bench continuity line pins that path deliberately).
+        return montecarlo_per_epoch_batched(
+            key,
+            num_scenarios,
+            num_epochs,
+            num_validators,
+            num_miners,
+            yuma_version,
+            config,
+            base_weights=base_weights,
+            base_stakes=base_stakes,
+            perturbation=perturbation,
+            consensus_impl=consensus_impl,
+            epoch_impl="auto",
+            dtype=dtype,
+        )
     epoch_impl = resolve_montecarlo_engine(epoch_impl, varying)
     shards = mesh.shape[DATA_AXIS]
     # Pad-and-trim, the same contract as simulate_batch_sharded (r4
@@ -736,14 +763,19 @@ def montecarlo_per_epoch_batched(
     :func:`..simulation.planner.plan_dispatch` on the `[B, CH, V, M]`
     slab shape):
 
-    - ``fused_scan`` / ``fused_scan_mxu`` (what "auto" picks on TPU
-      when VMEM admits the batch): each chunk's fresh weights are
+    - ``fused_varying`` / ``fused_varying_mxu`` (what "auto" picks on
+      TPU when the epoch-tiled scan's divisor tile reaches 2 — the
+      small-`V x M` Monte-Carlo shape is exactly the workload the tile
+      exists for) and ``fused_scan`` / ``fused_scan_mxu`` (the
+      per-epoch fused fallback): each chunk's fresh weights are
       generated on device as one `[B, CH, V, M]` slab
       (:func:`_montecarlo_weight_slab` — the SAME `fold_in(key,
       global_epoch)` draws as the in-scan generation) and streamed
-      through the batched single-Pallas-program case scan with the
-      bond carry threaded (donated) between chunks. Only one slab plus
-      the in-flight generation is resident — HBM stays flat in E.
+      through the batched single-Pallas-program scan with the bond
+      carry threaded (donated) between chunks; varying-rung slab
+      lengths are rounded to tile multiples (the epoch-tiled kernel
+      pads no epochs). Only one slab plus the in-flight generation is
+      resident — HBM stays flat in E.
     - ``xla`` (the CPU/ineligible fallback and the parity oracle): the
       batched in-scan generation with the `TotalsCarry` threaded per
       chunk — BITWISE the monolithic
@@ -752,7 +784,11 @@ def montecarlo_per_epoch_batched(
 
     `chunk_epochs` (default: the plan's memory-plan slab cap, or the
     whole run when capacity is unknown) trades dispatch count against
-    slab residency. Keys match ``montecarlo_total_dividends(...,
+    slab residency. Chunk-length invariance is bitwise on the XLA and
+    per-epoch fused rungs; on the epoch-tiled varying rungs different
+    chunk lengths compile different programs, so totals agree to
+    reduction-order rounding (the epoch-ordered accumulation keeps the
+    composition exact per program — tests/unit/test_varying_scan.py). Keys match ``montecarlo_total_dividends(...,
     mesh=<1 device>)``: scenario keys are
     ``split(split(key, 1)[0], B)``.
 
@@ -786,7 +822,13 @@ def montecarlo_per_epoch_batched(
         streaming=True,
     )
     plan.record()
-    fused = plan.engine in ("fused_scan", "fused_scan_mxu")
+    from yuma_simulation_tpu.simulation.planner import (
+        FUSED_CASE_RUNGS,
+        rung_flags,
+    )
+
+    fused = plan.engine in FUSED_CASE_RUNGS
+    varying_rung = plan.engine in ("fused_varying", "fused_varying_mxu")
     if chunk_epochs is None:
         # Only the fused rung materializes a slab; the XLA rung
         # generates in-scan (HBM flat in E) and defaults to one
@@ -795,6 +837,17 @@ def montecarlo_per_epoch_batched(
             plan.memory.chunk_epochs or num_epochs
         ) if fused else num_epochs
     chunk_epochs = max(1, min(int(chunk_epochs), num_epochs))
+    if varying_rung:
+        from yuma_simulation_tpu.ops.pallas_epoch import (
+            VARYING_EPOCH_TILE_MAX,
+        )
+
+        if chunk_epochs > VARYING_EPOCH_TILE_MAX:
+            # The epoch-tiled rung pads no epochs: round the slab
+            # length down to a tile multiple so every full chunk runs
+            # the deepest tile (the remainder chunk picks its own
+            # divisor tile).
+            chunk_epochs -= chunk_epochs % VARYING_EPOCH_TILE_MAX
     keys = jax.random.split(jax.random.split(key, 1)[0], B)
     perturbation = jnp.asarray(perturbation, dtype)
 
@@ -832,10 +885,10 @@ def montecarlo_per_epoch_batched(
                 spec,
                 save_bonds=False,
                 save_incentives=False,
-                mxu=plan.engine == "fused_scan_mxu",
                 carry=carry,
                 epoch_offset=lo,
                 return_carry=True,
+                **rung_flags(plan.engine),
             )
             if hi < num_epochs:
                 # Double-buffer: next slab's generation is queued while

@@ -368,6 +368,20 @@ class StateCache:
                 config,
                 dtype,
             ).engine
+            if engine in ("fused_varying_mxu", "fused_varying"):
+                # The epoch-tiled rungs are bitwise-reproducible only
+                # between runs sharing one program (one tile) — but the
+                # cache's whole point is composing stride segments,
+                # suffixes and full runs of DIFFERENT epoch counts,
+                # which pick different divisor tiles. Pin the per-epoch
+                # case-scan twin instead: same kernel family and speed
+                # class, and cross-epoch-count composition stays
+                # bitwise (the suffix-resume property pins).
+                engine = (
+                    "fused_scan_mxu"
+                    if engine == "fused_varying_mxu"
+                    else "fused_scan"
+                )
         key = baseline_key(
             scenario_fingerprint=scenario_fingerprint,
             version=version,

@@ -1031,6 +1031,16 @@ class SweepSupervisor:
 
         if primary_engine not in ENGINE_LADDER:
             return "xla"
+        if primary_engine in ("fused_varying_mxu", "fused_varying"):
+            # The epoch-tiled rungs' bitwise-comparable partner is the
+            # VPU twin (an MXU primary) or the rung itself (a
+            # determinism canary, like the xla bottom rung): the next
+            # ladder rung below is the CASE-scan family, which the
+            # varying kernel matches only to reduction-order rounding —
+            # pairing them would make every canary a false drift
+            # incident (and beyond V = 2^14 the `_mxu` case rung would
+            # reject the shape outright).
+            return "fused_varying"
         ladder = ladder_from(primary_engine)
         return ladder[1] if len(ladder) > 1 else ladder[0]
 
@@ -1135,7 +1145,9 @@ class SweepSupervisor:
                         reason="no numerics capture on canary rung",
                     )
                     return
-                fused = ("fused_scan", "fused_scan_mxu")
+                from yuma_simulation_tpu.simulation.planner import (
+                    FUSED_CASE_RUNGS as fused,
+                )
                 expected = (
                     canary_expected
                     if (primary_engine in fused) != (rung in fused)

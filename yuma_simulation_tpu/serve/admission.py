@@ -29,7 +29,14 @@ import numpy as np
 from yuma_simulation_tpu.resilience.errors import AdmissionRejected
 
 #: Engines a request may name; "auto" resolves through the planner.
-_ENGINES = ("auto", "xla", "fused_scan", "fused_scan_mxu")
+_ENGINES = (
+    "auto",
+    "xla",
+    "fused_scan",
+    "fused_scan_mxu",
+    "fused_varying",
+    "fused_varying_mxu",
+)
 
 #: Hard per-request shape ceilings — a parse-time sanity bound so a
 #: hostile payload cannot make the server materialize absurd host
@@ -302,7 +309,9 @@ def admit(
     quarantine = bool(
         payload.get("quarantine", engine in ("auto", "xla"))
     )
-    if quarantine and engine in ("fused_scan", "fused_scan_mxu"):
+    from yuma_simulation_tpu.simulation.planner import FUSED_CASE_RUNGS
+
+    if quarantine and engine in FUSED_CASE_RUNGS:
         _reject(
             "quarantine rides the XLA scan carry; a fused-engine "
             "request must pass quarantine=false"

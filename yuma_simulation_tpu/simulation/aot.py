@@ -737,7 +737,12 @@ def executable_for_plan(
     S = jnp.ones(((B,) if batched else ()) + (E, V), dtype)
     ri = jnp.full(ri_shape, -1, jnp.int32)
     re = jnp.full(ri_shape, -1, jnp.int32)
-    if plan.engine in ("fused_scan", "fused_scan_mxu"):
+    from yuma_simulation_tpu.simulation.planner import (
+        FUSED_CASE_RUNGS,
+        rung_flags,
+    )
+
+    if plan.engine in FUSED_CASE_RUNGS:
         from yuma_simulation_tpu.simulation.engine import (
             _simulate_case_fused,
         )
@@ -748,8 +753,8 @@ def executable_for_plan(
             save_bonds=save_bonds,
             save_incentives=save_incentives,
             save_consensus=False,
-            mxu=plan.engine == "fused_scan_mxu",
             capture_numerics=capture,
+            **rung_flags(plan.engine),
         )
         static_names = tuple(kwargs)
     elif batched:

@@ -50,8 +50,10 @@ from yuma_simulation_tpu.simulation.carry import (
     TotalsCarry,
 )
 from yuma_simulation_tpu.simulation.planner import (
+    FUSED_CASE_RUNGS,
     plan_dispatch,
     resolve_scaled_engine,
+    rung_flags,
 )
 
 
@@ -459,6 +461,7 @@ def _simulate_scan(
         "save_incentives",
         "save_consensus",
         "mxu",
+        "varying",
         "return_carry",
         "capture_numerics",
     ),
@@ -474,6 +477,7 @@ def _simulate_case_fused(
     save_incentives: bool = True,
     save_consensus: bool = False,
     mxu: bool = False,
+    varying: bool = False,
     carry: Optional[dict] = None,
     epoch_offset=0,
     return_carry: bool = False,
@@ -485,11 +489,22 @@ def _simulate_case_fused(
     (:func:`yuma_simulation_tpu.ops.pallas_epoch.fused_case_scan`); only
     the dividend-per-1000-tao conversion (linear, needs the raw per-epoch
     stakes) happens out here. Returns the same ys dict as
-    `_simulate_scan`."""
-    from yuma_simulation_tpu.ops.pallas_epoch import fused_case_scan
+    `_simulate_scan`.
+
+    `varying=True` (static) selects the EPOCH-TILED varying-weights
+    kernel instead (:func:`..ops.pallas_epoch.fused_varying_scan` — the
+    `fused_varying` / `fused_varying_mxu` planner rungs, ISSUE 15):
+    identical inputs, outputs and carry contract, but each grid step
+    advances a whole epoch tile with the bond-independent math batched
+    over it — the rung for workloads whose single-epoch block
+    underfills the chip."""
+    from yuma_simulation_tpu.ops.pallas_epoch import (
+        fused_case_scan,
+        fused_varying_scan,
+    )
 
     dtype = weights.dtype
-    res = fused_case_scan(
+    res = (fused_varying_scan if varying else fused_case_scan)(
         weights,
         stakes,
         reset_index=reset_index,
@@ -584,6 +599,7 @@ _simulate_case_fused_streamed = partial(
         "save_incentives",
         "save_consensus",
         "mxu",
+        "varying",
         "return_carry",
         "capture_numerics",
     ),
@@ -901,15 +917,15 @@ def simulate(
         # pipeline is untouched by default.
         from yuma_simulation_tpu.simulation.aot import dispatch_via_cache
 
-        if rung in ("fused_scan", "fused_scan_mxu"):
+        if rung in FUSED_CASE_RUNGS:
             faults.maybe_fail_fused_dispatch()
             fused_kwargs = dict(
                 spec=spec,
                 save_bonds=save_bonds,
                 save_incentives=save_incentives,
                 save_consensus=save_consensus,
-                mxu=rung == "fused_scan_mxu",
                 capture_numerics=capture,
+                **rung_flags(rung),
             )
             out = (
                 dispatch_via_cache(
@@ -1481,7 +1497,7 @@ def _simulate_streamed_attempt(
 
     def dispatch(Wc, Sc, carry, offset):
         impl = state["plan"].engine
-        if impl in ("fused_scan", "fused_scan_mxu"):
+        if impl in FUSED_CASE_RUNGS:
             faults.maybe_fail_fused_dispatch()
             return _simulate_case_fused_streamed(
                 Wc,
@@ -1493,11 +1509,11 @@ def _simulate_streamed_attempt(
                 save_bonds=save_bonds,
                 save_incentives=save_incentives,
                 save_consensus=save_consensus,
-                mxu=impl == "fused_scan_mxu",
                 carry=carry,
                 epoch_offset=offset,
                 return_carry=True,
                 capture_numerics=capture,
+                **rung_flags(impl),
             )
         return _simulate_scan_streamed(
             Wc,
@@ -1622,12 +1638,12 @@ def _simulate_generated_run(
         cin = {"bonds": B, "consensus": C}
         if prev:
             cin["w_prev"] = Wp
-        if impl in ("fused_scan", "fused_scan_mxu"):
+        if impl in FUSED_CASE_RUNGS:
             ys, cout = _simulate_case_fused(
                 W, S, ri, ri, config, spec,
                 save_bonds=False, save_incentives=False,
-                mxu=impl == "fused_scan_mxu",
                 carry=cin, epoch_offset=idx * CH, return_carry=True,
+                **rung_flags(impl),
             )
         else:
             ys, cout = _simulate_scan(

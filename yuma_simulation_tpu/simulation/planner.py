@@ -57,7 +57,40 @@ logger = logging.getLogger(__name__)
 #: demotion must never silently upgrade a run onto an engine the caller
 #: did not ask for. (Moved here from `resilience.retry`, which
 #: re-exports it: rung eligibility and rung ordering are one decision.)
-ENGINE_LADDER = ("fused_scan_mxu", "fused_scan", "xla")
+#: 0.19.0 adds the EPOCH-TILED varying-weights rungs at the top
+#: (`ops.pallas_epoch.fused_varying_scan`): they demand the most VMEM
+#: (a whole double-buffered epoch tile resident), so a VMEM-class
+#: failure demotes tile -> per-epoch case scan -> XLA; the MXU twin of
+#: each kernel family sits directly above its VPU twin so the default
+#: numerics canary (one rung below the primary) always pairs
+#: bitwise-identical programs.
+ENGINE_LADDER = (
+    "fused_varying_mxu",
+    "fused_varying",
+    "fused_scan_mxu",
+    "fused_scan",
+    "xla",
+)
+
+#: Every fused rung the case-scan entry points dispatch through
+#: `engine._simulate_case_fused` — the one membership test the dispatch
+#: stack shares (engine, sweep, sharded, serve, aot, cost).
+FUSED_CASE_RUNGS = (
+    "fused_varying_mxu",
+    "fused_varying",
+    "fused_scan_mxu",
+    "fused_scan",
+)
+
+
+def rung_flags(engine: str) -> dict:
+    """The static kernel-selection flags a fused rung name encodes —
+    the ONE name -> (mxu, varying) spelling, so a dispatch site cannot
+    pair the wrong kernel with a rung label."""
+    return {
+        "mxu": engine.endswith("_mxu"),
+        "varying": engine.startswith("fused_varying"),
+    }
 
 #: The ONE documented accepted-drift class (ADVICE r5): an EXPLICIT
 #: fused opt-in beyond the int32 dyadic-quantization bound pairs the
@@ -376,19 +409,51 @@ def _plan_engine(
         from yuma_simulation_tpu.ops.pallas_epoch import (
             exact_mxu_support_covers,
             fused_case_scan_eligible,
+            fused_varying_scan_eligible,
+            varying_scan_epoch_tile,
         )
 
         epochs = shape[1] if batched else shape[0]
-        if (
+        base_ok = (
             mesh is None
             and not quarantine
             and not has_miner_mask
             and consensus_impl in ("auto", "bisect")
             and epochs >= 1
-            and fused_case_scan_eligible(
+        )
+        # Eligibility first: it short-circuits on the cheap gates
+        # (mode/dtype/backend) before walking the divisor/VMEM tile
+        # admission, so a CPU plan never pays the tile search; on the
+        # eligible path the tile lookup below is a memo hit
+        # (varying_scan_epoch_tile is lru-cached).
+        tile = (
+            varying_scan_epoch_tile(
+                tuple(shape), spec.bonds_mode, save_bonds,
+                streaming=streaming,
+            )
+            if base_ok
+            and epochs >= 2
+            and fused_varying_scan_eligible(
                 tuple(shape), spec.bonds_mode, config, dtype, save_bonds,
                 streaming=streaming,
             )
+            else 0
+        )
+        if base_ok and tile >= 2:
+            # The epoch-tiled varying scan wins exactly when it can
+            # batch >= 2 epochs' bond-independent math per grid step —
+            # otherwise it degenerates to the per-epoch case scan and
+            # the battle-tested kernel keeps the dispatch.
+            mxu = exact_mxu_support_covers(shape[-2])
+            epoch_impl = "fused_varying_mxu" if mxu else "fused_varying"
+            reasons.append(
+                f"auto->{epoch_impl}: epoch-tiled varying scan eligible "
+                f"(tile={tile})"
+                + ("" if mxu else f" (limb split stops below V={shape[-2]})")
+            )
+        elif base_ok and fused_case_scan_eligible(
+            tuple(shape), spec.bonds_mode, config, dtype, save_bonds,
+            streaming=streaming,
         ):
             # Since r4 the MXU scan's consensus support is EXACT (the
             # limb-split integer contraction, ~1.6x the VPU scan) and
@@ -419,11 +484,11 @@ def _plan_engine(
                     "(backend/dtype/mode/VMEM)"
                 )
             )
-    if epoch_impl in ("fused_scan", "fused_scan_mxu"):
+    if epoch_impl in FUSED_CASE_RUNGS:
         if mesh is not None:
             raise ValueError(
-                "the fused case scan is a single-core Pallas program; "
-                "miner-axis sharding requires epoch_impl='xla'"
+                "the fused case/varying scans are single-core Pallas "
+                "programs; miner-axis sharding requires epoch_impl='xla'"
             )
         if quarantine:
             raise ValueError(
@@ -441,6 +506,27 @@ def _plan_engine(
                 "the fused case scan computes consensus by bisection; "
                 "consensus_impl='sorted' requires epoch_impl='xla'"
             )
+        if epoch_impl in ("fused_varying", "fused_varying_mxu"):
+            from yuma_simulation_tpu.ops.pallas_epoch import (
+                varying_scan_epoch_tile,
+            )
+
+            if (
+                varying_scan_epoch_tile(
+                    tuple(shape), spec.bonds_mode, save_bonds,
+                    streaming=streaming,
+                )
+                < 1
+            ):
+                # Fail the plan, not the dispatch: the serving tier
+                # admits requests through plan_dispatch, so a shape no
+                # epoch tile can fit must become a typed admission
+                # reject (a 400), not a mid-dispatch kernel error.
+                raise ValueError(
+                    f"{list(shape)} too large for the epoch-tiled "
+                    "varying scan at any tile (VMEM admission); use "
+                    "'fused_scan'/'fused_scan_mxu' or 'xla'"
+                )
         import math
 
         from yuma_simulation_tpu.ops.consensus import dyadic_grid_fits_int32
@@ -463,7 +549,8 @@ def _plan_engine(
     if epoch_impl != "xla":
         raise ValueError(
             f"unknown epoch_impl {epoch_impl!r}; "
-            "expected 'auto', 'xla', 'fused_scan' or 'fused_scan_mxu'"
+            "expected 'auto', 'xla', 'fused_scan', 'fused_scan_mxu', "
+            "'fused_varying' or 'fused_varying_mxu'"
         )
     from yuma_simulation_tpu.ops.consensus import resolve_consensus_impl
 
@@ -670,12 +757,24 @@ def plan_dispatch(
         reasons.append(
             f"caller caps residency at {max_resident_epochs} epochs"
         )
+    # Demotion rungs below the chosen engine must themselves be legal
+    # for this workload: beyond the exact MXU limb split's V bound the
+    # `_mxu` twins raise a caller error (which the retry ladder rightly
+    # never retries), so they are dropped from the walk — the chosen
+    # engine itself was already validated above.
+    ladder = ladder_from(engine)
+    from yuma_simulation_tpu.ops.pallas_epoch import exact_mxu_support_covers
+
+    if not exact_mxu_support_covers(V):
+        ladder = tuple(
+            r for r in ladder if r == engine or not r.endswith("_mxu")
+        )
     return DispatchPlan(
         label=label,
         engine=engine,
         consensus_impl=resolved_consensus,
         fallback_consensus=fallback_consensus,
-        ladder=ladder_from(engine),
+        ladder=ladder,
         bucket=bucket_shape(V, M, epochs=E, batch=batch),
         miner_shards=miner_shards,
         batch_lanes=batch,

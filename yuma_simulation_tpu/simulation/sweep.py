@@ -304,7 +304,12 @@ def simulate_batch(
         return jnp.asarray(lanes)
 
     def _dispatch_engine(rung: str):
-        if rung in ("fused_scan", "fused_scan_mxu"):
+        from yuma_simulation_tpu.simulation.planner import (
+            FUSED_CASE_RUNGS,
+            rung_flags,
+        )
+
+        if rung in FUSED_CASE_RUNGS:
             # Reviewed suppression: simulate_batch IS the host-level
             # dispatch wrapper; the sharded shard_map body re-enters it
             # at trace time, where the hook's is-tracing guard no-ops
@@ -325,8 +330,8 @@ def simulate_batch(
                 save_bonds=save_bonds,
                 save_incentives=save_incentives,
                 save_consensus=False,
-                mxu=rung == "fused_scan_mxu",
                 capture_numerics=capture,
+                **rung_flags(rung),
             )
         else:
             # The plan pre-resolved the XLA-rung consensus — both for a

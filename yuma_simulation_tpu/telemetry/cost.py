@@ -50,8 +50,15 @@ logger = logging.getLogger(__name__)
 
 #: The engine ladder, best rung first — mirrors
 #: :data:`..resilience.retry.ENGINE_LADDER` (kept literal here so cost
-#: capture does not import the resilience tier).
-ENGINE_RUNGS = ("fused_scan_mxu", "fused_scan", "xla")
+#: capture does not import the resilience tier). 0.19.0 adds the
+#: epoch-tiled varying-weights rungs.
+ENGINE_RUNGS = (
+    "fused_varying_mxu",
+    "fused_varying",
+    "fused_scan_mxu",
+    "fused_scan",
+    "xla",
+)
 
 #: Env var naming a JSON DeviceSpec override, e.g.
 #: ``{"name": "lab-v5e", "peak_flops": 1.97e14,
@@ -376,7 +383,7 @@ def capture_engine_cost(
     S = jax.ShapeDtypeStruct((epochs, V), dtype)
     scalar_i32 = jax.ShapeDtypeStruct((), jnp.int32)
 
-    if engine in ("fused_scan", "fused_scan_mxu"):
+    if engine != "xla":
         if backend != "tpu":
             return CostRecord(
                 engine=engine, backend=backend, V=V, M=M, epochs=epochs,
@@ -390,6 +397,7 @@ def capture_engine_cost(
             from yuma_simulation_tpu.simulation.engine import (
                 _simulate_case_fused,
             )
+            from yuma_simulation_tpu.simulation.planner import rung_flags
 
             fn = jax.jit(
                 functools.partial(
@@ -398,7 +406,7 @@ def capture_engine_cost(
                     spec=spec,
                     save_bonds=save_bonds,
                     save_incentives=save_incentives,
-                    mxu=engine == "fused_scan_mxu",
+                    **rung_flags(engine),
                 )
             )
             lowered = fn.lower(W, S, scalar_i32, scalar_i32)
