@@ -134,6 +134,7 @@ TEST_CHUNKS = [
         "tests/unit/test_fabric.py",
         "tests/unit/test_fleet_drill.py",
         "tests/unit/test_serve.py",
+        "tests/unit/test_serve_scaleout.py",
         "tests/unit/test_slo.py",
         "tests/unit/test_propagation.py",
         "tests/unit/test_numerics.py",
@@ -245,6 +246,37 @@ def serve(session: nox.Session) -> None:
     session.run(
         "python", "-m", "tools.driftreport", bundle, "--check", "--require"
     )
+
+
+@nox.session
+def serve_scaleout(session: nox.Session) -> None:
+    """Scale-out lane (mirrors the CI chaos-job drill step): the pure
+    claim-scoring/keyring/retry/autoscaler battery, then the
+    multi-process drill — three warm workers behind the stateless
+    router, affinity proven against a no-affinity control arm, one
+    worker SIGKILLed under concurrent load with every in-flight
+    request rerouted bitwise-invisibly, and the SLO-burn autoscaler
+    spawn/retire round trip — gated over the merged fleet bundle.
+    driftreport runs WITHOUT --require: the drill's numerics stream
+    rides the workers' bundles and may be sparse under coalesce=0."""
+    session.install("-e", ".[test]")
+    session.run(
+        "python", "-m", "pytest", "tests/unit/test_serve_scaleout.py", "-q"
+    )
+    import os
+    import shutil
+
+    bundle = os.path.join(session.create_tmp(), "scaleout-bundle")
+    shutil.rmtree(bundle, ignore_errors=True)
+    session.run(
+        "python", "-m", "yuma_simulation_tpu.serve", "--scaleout-drill",
+        "--bundle-dir", bundle,
+    )
+    session.run("python", "-m", "tools.obsreport", bundle, "--check")
+    session.run(
+        "python", "-m", "tools.sloreport", bundle, "--check", "--require"
+    )
+    session.run("python", "-m", "tools.driftreport", bundle, "--check")
 
 
 @nox.session

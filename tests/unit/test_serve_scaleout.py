@@ -1,0 +1,424 @@
+"""Horizontal serve scale-out: claim scoring, signed tenant identity,
+client retry budget, and the SLO-burn autoscaler.
+
+PR 16 acceptance surface, the PURE half: every placement decision the
+router makes is a tuple comparison over advertisements
+(:func:`~yuma_simulation_tpu.serve.router.claim_score`), so the
+affinity contract — suffix savings beat warm buckets beat idleness,
+dead workers never win, ties never flap — is unit-testable with
+dictionaries. The multi-process half (SIGKILL mid-request, lease
+expiry, bundle merge) lives in the ``--scaleout-drill`` chaos lane.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from yuma_simulation_tpu.fabric.lease import LeaseStore
+from yuma_simulation_tpu.resilience import ClientRetriesExhausted
+from yuma_simulation_tpu.serve import (
+    ApiKeyring,
+    Autoscaler,
+    SimulationClient,
+    WorkerPool,
+    claim_score,
+    mint_api_key,
+    rank_claims,
+)
+from yuma_simulation_tpu.serve.router import (
+    canonical_key,
+    stable_host_hash,
+    suffix_epochs_saved,
+)
+
+
+def _ad(worker_id, **over):
+    ad = {
+        "worker_id": worker_id,
+        "alive": True,
+        "retired": False,
+        "inflight": 0,
+        "held_prefixes": [],
+        "warm_buckets": [],
+        "url": f"http://127.0.0.1:0/{worker_id}",
+    }
+    ad.update(over)
+    return ad
+
+
+BASELINE = ["netuid-1", "Yuma 2 (Adrian-Fish)", ["hp", 0.5], "fp-abc"]
+
+
+def _held(key=None, checkpoints=(4, 8)):
+    return {"key": BASELINE if key is None else key, "checkpoints": list(checkpoints)}
+
+
+# ---------------------------------------------------------------------------
+# claim scoring (pure)
+
+
+def test_dead_worker_never_wins():
+    assert claim_score(_ad("w0", alive=False)) is None
+    assert claim_score(_ad("w1", retired=True)) is None
+    ranked = rank_claims(
+        [_ad("w0", alive=False), _ad("w1"), _ad("w2", retired=True)]
+    )
+    assert [a["worker_id"] for a in ranked] == ["w1"]
+
+
+def test_suffix_savings_beat_warm_bucket():
+    holder = _ad("holder", held_prefixes=[_held()], inflight=5)
+    warm = _ad("warm", warm_buckets=["12x3x4"], inflight=0)
+    ranked = rank_claims(
+        [warm, holder],
+        baseline_key=BASELINE,
+        perturb_epoch=10,
+        bucket="12x3x4",
+    )
+    # Skipping 8 baseline epochs outweighs a warm trace AND a busier
+    # queue: recompute costs more than a compile here by contract.
+    assert ranked[0]["worker_id"] == "holder"
+
+
+def test_warm_bucket_beats_idleness():
+    warm = _ad("warm", warm_buckets=["12x3x4"], inflight=3)
+    idle = _ad("idle", inflight=0)
+    ranked = rank_claims([idle, warm], bucket="12x3x4")
+    assert ranked[0]["worker_id"] == "warm"
+
+
+def test_least_loaded_wins_among_equals():
+    busy = _ad("busy", inflight=4)
+    calm = _ad("calm", inflight=1)
+    assert rank_claims([busy, calm])[0]["worker_id"] == "calm"
+
+
+def test_equal_workers_tiebreak_is_stable():
+    ads = [_ad("w0"), _ad("w1"), _ad("w2")]
+    winner = rank_claims(ads)[0]["worker_id"]
+    for _ in range(5):
+        assert rank_claims(list(reversed(ads)))[0]["worker_id"] == winner
+    expected = max(ads, key=lambda a: stable_host_hash(a["worker_id"]))
+    assert winner == expected["worker_id"]
+
+
+def test_checkpoints_beyond_perturb_epoch_do_not_count():
+    ad = _ad("w0", held_prefixes=[_held(checkpoints=[4, 8, 16])])
+    assert suffix_epochs_saved(ad, BASELINE, 10) == 8
+    assert suffix_epochs_saved(ad, BASELINE, 3) == 0
+    # No epoch bound: the deepest checkpoint counts.
+    assert suffix_epochs_saved(ad, BASELINE, None) == 16
+
+
+def test_wrong_baseline_key_saves_nothing():
+    ad = _ad("w0", held_prefixes=[_held(key=["other", "key"])])
+    assert suffix_epochs_saved(ad, BASELINE, 10) == 0
+    assert suffix_epochs_saved(ad, None, 10) == 0
+
+
+def test_canonical_key_survives_the_json_boundary():
+    # Heartbeat ads cross JSON: tuples become lists, nested ones too.
+    native = ("netuid-1", ("hp", 0.5), "fp")
+    wired = json.loads(json.dumps(native))
+    assert isinstance(wired, list)
+    assert canonical_key(native) == canonical_key(wired)
+    assert canonical_key(native) != canonical_key(("netuid-2", ("hp", 0.5), "fp"))
+
+
+def test_score_tuple_shape():
+    ad = _ad("w0", held_prefixes=[_held()], warm_buckets=["2x3x4"], inflight=2)
+    saved, warm, neg_inflight, tiebreak = claim_score(
+        ad, baseline_key=BASELINE, perturb_epoch=10, bucket="2x3x4"
+    )
+    assert (saved, warm, neg_inflight) == (8, 1, -2)
+    assert tiebreak == stable_host_hash("w0")
+
+
+# ---------------------------------------------------------------------------
+# pool discovery (lease dir is the source of truth)
+
+
+def test_pool_scan_verdicts(tmp_path):
+    pool = WorkerPool(tmp_path, max_slots=4, ttl_seconds=60.0)
+    assert pool.scan() == []
+    worker = LeaseStore(
+        tmp_path / "leases", "w0-abc123", ttl_seconds=60.0
+    )
+    assert worker.try_claim(0) is not None
+    worker.annotate(0, _ad("w0-abc123"))
+    [ad] = pool.scan()
+    assert ad["alive"] and ad["slot"] == 0
+    # An ad whose lease is held by SOMEONE ELSE is not alive: the ad is
+    # stale leftovers from a previous tenant of the slot.
+    worker.annotate(0, _ad("w0-imposter"))
+    [ad] = pool.scan()
+    assert not ad["alive"]
+    worker.annotate(0, _ad("w0-abc123", retired=True))
+    assert pool.live() == []
+
+
+def test_pool_stale_lease_is_dead(tmp_path):
+    pool = WorkerPool(tmp_path, max_slots=2, ttl_seconds=0.1)
+    worker = LeaseStore(tmp_path / "leases", "w1-dead", ttl_seconds=0.1)
+    worker.try_claim(1)
+    worker.annotate(1, _ad("w1-dead"))
+    assert pool.live()
+    time.sleep(0.3)  # past TTL with no heartbeat: SIGKILL semantics
+    assert pool.live() == []
+
+
+def test_mark_lost_reports_first_time_only(tmp_path):
+    pool = WorkerPool(tmp_path, max_slots=2, ttl_seconds=60.0)
+    worker = LeaseStore(tmp_path / "leases", "w0-x", ttl_seconds=60.0)
+    worker.try_claim(0)
+    worker.annotate(0, _ad("w0-x"))
+    assert pool.live()
+    assert pool.mark_lost("w0-x") is True
+    assert pool.mark_lost("w0-x") is False  # ledger worker_lost ONCE
+    assert pool.live() == []  # routing stops before the lease expires
+
+
+# ---------------------------------------------------------------------------
+# signed tenant identity
+
+
+def test_api_key_round_trip():
+    ring = ApiKeyring({"acme": "s3cret", "umbrella": "hushhush"})
+    assert ring.resolve(mint_api_key("acme", "s3cret")) == "acme"
+    assert ring.resolve(mint_api_key("umbrella", "hushhush")) == "umbrella"
+
+
+def test_api_key_rejections_are_uniform():
+    ring = ApiKeyring({"acme": "s3cret"})
+    assert ring.resolve(None) is None
+    assert ring.resolve("") is None
+    assert ring.resolve("no-dot-here") is None
+    assert ring.resolve("acme.deadbeef") is None  # forged signature
+    assert ring.resolve(mint_api_key("acme", "wrong")) is None
+    assert ring.resolve(mint_api_key("ghost", "s3cret")) is None
+
+
+def test_api_keyring_refuses_empty_or_garbled():
+    with pytest.raises(ValueError):
+        ApiKeyring({})
+    with pytest.raises(ValueError):
+        ApiKeyring({"acme": ""})
+    with pytest.raises(ValueError):
+        ApiKeyring({"": "secret"})
+
+
+def test_api_keyring_loads_a_keyfile(tmp_path):
+    path = tmp_path / "keys.json"
+    path.write_text(json.dumps({"acme": "s3cret"}))
+    ring = ApiKeyring.load(path)
+    assert len(ring) == 1
+    assert ring.resolve(mint_api_key("acme", "s3cret")) == "acme"
+
+
+# ---------------------------------------------------------------------------
+# client retry budget
+
+
+class _ScriptedHandler(BaseHTTPRequestHandler):
+    script: list  # [(status, headers, body), ...] consumed in order
+    seen: list
+
+    def do_POST(self):  # noqa: N802 — stdlib handler contract
+        length = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(length)
+        # urllib title-cases header names on the wire: normalize.
+        self.seen.append({k.lower(): v for k, v in self.headers.items()})
+        status, headers, body = (
+            self.script.pop(0) if self.script else (200, {}, {"status": "ok"})
+        )
+        raw = json.dumps(body).encode()
+        self.send_response(status)
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+def _scripted_server(script):
+    handler = type(
+        "Scripted", (_ScriptedHandler,), {"script": list(script), "seen": []}
+    )
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, handler
+
+
+def test_client_retries_transient_statuses_with_one_trace():
+    server, handler = _scripted_server(
+        [
+            (503, {"Retry-After": "0.01"}, {"status": "unavailable"}),
+            (429, {"Retry-After": "0.01"}, {"status": "shed"}),
+            (200, {}, {"status": "ok"}),
+        ]
+    )
+    try:
+        client = SimulationClient(
+            f"http://127.0.0.1:{server.server_address[1]}",
+            retries=3,
+            backoff_base=0.01,
+        )
+        resp = client._request("POST", "/v1/simulate", {"tenant": "t"})
+        assert resp.status == 200 and resp.body["status"] == "ok"
+        assert len(handler.seen) == 3
+        # All attempts stitch into ONE caller trace.
+        traceparents = {h.get("traceparent") for h in handler.seen}
+        assert len(traceparents) == 1 and None not in traceparents
+    finally:
+        server.shutdown()
+
+
+def test_client_returns_last_transient_body_when_budget_spent():
+    server, _ = _scripted_server(
+        [(429, {"Retry-After": "0.01"}, {"status": "shed"})] * 3
+    )
+    try:
+        client = SimulationClient(
+            f"http://127.0.0.1:{server.server_address[1]}",
+            retries=2,
+            backoff_base=0.01,
+        )
+        resp = client._request("POST", "/v1/simulate", {"tenant": "t"})
+        # The typed 429 body is the contract: returned, never raised.
+        assert resp.status == 429 and resp.body["status"] == "shed"
+    finally:
+        server.shutdown()
+
+
+def test_client_raises_typed_exhaustion_on_dead_endpoint():
+    # Bind-then-close: the port is real but nobody listens.
+    probe = ThreadingHTTPServer(("127.0.0.1", 0), _ScriptedHandler)
+    port = probe.server_address[1]
+    probe.server_close()
+    client = SimulationClient(
+        f"http://127.0.0.1:{port}", retries=2, backoff_base=0.01
+    )
+    with pytest.raises(ClientRetriesExhausted) as err:
+        client._request("POST", "/v1/simulate", {"tenant": "t"})
+    assert err.value.attempts == 3
+    assert err.value.last_error is not None
+
+
+def test_client_rejects_negative_retries():
+    with pytest.raises(ValueError):
+        SimulationClient("http://127.0.0.1:1", retries=-1)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler (fake pool, fake burn, fake clock)
+
+
+class _FakeBurn:
+    def __init__(self):
+        self.burning = ()
+
+    def degraded(self):
+        return self.burning
+
+
+class _FakeRouter:
+    """pool.live() / spawn_worker / retire_worker — the whole contract
+    the autoscaler needs, with deterministic worker ages."""
+
+    def __init__(self, *ads):
+        self.ads = list(ads)
+        self.spawns = []
+        self.retires = []
+        self.pool = self
+
+    def live(self):
+        return list(self.ads)
+
+    def spawn_worker(self, *, reason="startup"):
+        ad = _ad(f"w{len(self.ads)}-auto", started_t=100.0 + len(self.ads))
+        self.ads.append(ad)
+        self.spawns.append(reason)
+        return ad
+
+    def retire_worker(self, worker_id, *, reason="idle"):
+        self.retires.append((worker_id, reason))
+        self.ads = [a for a in self.ads if a["worker_id"] != worker_id]
+        return True
+
+
+def _scaler(router, burn, t, **over):
+    knobs = dict(
+        min_workers=1,
+        max_workers=3,
+        idle_retire_seconds=10.0,
+        cooldown_seconds=5.0,
+        clock=lambda: t[0],
+    )
+    knobs.update(over)
+    return Autoscaler(router, burn, **knobs)
+
+
+def test_autoscaler_spawns_on_fast_burn_with_cooldown():
+    router = _FakeRouter(_ad("w0", started_t=1.0))
+    burn, t = _FakeBurn(), [0.0]
+    scaler = _scaler(router, burn, t)
+    burn.burning = ("serve_request_seconds",)
+    assert scaler.tick() == "spawn"
+    assert router.spawns == ["slo_fast_burn:serve_request_seconds"]
+    # Still burning, but inside the cooldown: hold, don't stampede.
+    assert scaler.tick() is None
+    t[0] = 6.0
+    assert scaler.tick() == "spawn"
+    # At max_workers: burn or not, never exceed the ceiling.
+    t[0] = 12.0
+    assert scaler.tick() is None
+    assert len(router.ads) == 3
+
+
+def test_autoscaler_retires_idle_youngest_first():
+    router = _FakeRouter(
+        _ad("w-old", started_t=1.0), _ad("w-young", started_t=50.0)
+    )
+    burn, t = _FakeBurn(), [0.0]
+    scaler = _scaler(router, burn, t, idle_retire_seconds=10.0)
+    assert scaler.tick() is None  # records idle-since, retires nothing
+    t[0] = 11.0
+    assert scaler.tick() == "retire"
+    assert router.retires == [("w-young", "idle")]
+    # min_workers floor: the long-lived worker stays forever.
+    t[0] = 1000.0
+    assert scaler.tick() is None
+    assert [a["worker_id"] for a in router.ads] == ["w-old"]
+
+
+def test_autoscaler_inflight_and_burn_reset_the_idle_clock():
+    router = _FakeRouter(
+        _ad("w-old", started_t=1.0, inflight=1),  # never idle
+        _ad("w-busy", started_t=50.0),
+    )
+    burn, t = _FakeBurn(), [0.0]
+    scaler = _scaler(router, burn, t, max_workers=2)
+    scaler.tick()
+    router.ads[1]["inflight"] = 2  # work arrived: not idle anymore
+    t[0] = 11.0
+    assert scaler.tick() is None
+    router.ads[1]["inflight"] = 0
+    t[0] = 12.0
+    scaler.tick()  # idle clock restarts HERE
+    t[0] = 21.0
+    assert scaler.tick() is None  # only 9s idle: under the threshold
+    t[0] = 23.0
+    assert scaler.tick() == "retire"
+    assert router.retires == [("w-busy", "idle")]
+
+
+def test_autoscaler_refuses_inverted_bounds():
+    with pytest.raises(ValueError):
+        Autoscaler(_FakeRouter(), _FakeBurn(), min_workers=3, max_workers=2)

@@ -157,6 +157,10 @@ def render(bundle, run_id: str | None) -> str:
     if serve:
         lines.append("")
         lines.extend(serve)
+    scaleout = render_scaleout(bundle)
+    if scaleout:
+        lines.append("")
+        lines.extend(scaleout)
     perf = render_perf(bundle)
     if perf:
         lines.append("")
@@ -409,6 +413,85 @@ def render_serve(bundle, run_id: str) -> list[str]:
                 ).rstrip()
                 + _phase_breakdown(phases, s)
             )
+    return lines
+
+
+def render_scaleout(bundle) -> list[str]:
+    """The router-fleet section of a horizontally scaled SERVING
+    bundle: worker lifecycle (``worker_spawned`` / ``worker_retired``
+    / ``worker_lost``), per-worker placement + affinity tallies from
+    the router's ``request_done`` records, ``request_rerouted``
+    counts, and the fleet metrics (``serve_workers_live``,
+    ``serve_reroutes_total``, ``affinity_hits_total``) from the last
+    snapshot carrying them. Empty for single-process bundles."""
+    spawned = [r for r in bundle.ledger if r.get("event") == "worker_spawned"]
+    retired = [r for r in bundle.ledger if r.get("event") == "worker_retired"]
+    lost = [r for r in bundle.ledger if r.get("event") == "worker_lost"]
+    rerouted = [
+        r for r in bundle.ledger if r.get("event") == "request_rerouted"
+    ]
+    placed = [
+        r
+        for r in bundle.ledger
+        if r.get("event") == "request_done" and r.get("worker")
+    ]
+    if not (spawned or retired or lost or rerouted or placed):
+        return []
+    lines = [
+        f"scale-out fleet: {len(spawned)} spawned, {len(retired)} retired, "
+        f"{len(lost)} lost, {len(rerouted)} reroute(s)"
+    ]
+    for r in spawned:
+        lines.append(
+            f"  spawned {r.get('worker', '?')} slot={r.get('slot', '?')} "
+            f"reason={r.get('reason', '?')} "
+            f"aot_builds={_num(r.get('aot_builds', '?'))}"
+        )
+    for r in lost:
+        lines.append(
+            f"  lost    {r.get('worker', '?')} "
+            f"during {r.get('request') or '?'} ({r.get('error', '?')})"
+        )
+    for r in retired:
+        lines.append(
+            f"  retired {r.get('worker', '?')} reason={r.get('reason', '?')}"
+        )
+    by_worker: dict[str, list[int]] = {}
+    hits = 0
+    for r in placed:
+        tally = by_worker.setdefault(str(r.get("worker")), [0, 0])
+        tally[0] += 1
+        if r.get("affinity"):
+            tally[1] += 1
+            hits += 1
+    if placed:
+        lines.append(
+            f"  placement: {len(placed)} routed request(s), "
+            f"{hits} affinity-placed"
+        )
+        for worker in sorted(by_worker):
+            served, affine = by_worker[worker]
+            lines.append(
+                f"    {worker}: {served} served, {affine} affinity-placed"
+            )
+    # A merged fleet bundle concatenates every process's snapshots;
+    # the router's fleet counters may not be in the LAST one, so take
+    # the last snapshot that carries each name.
+    fleet: dict[str, object] = {}
+    for snap in bundle.metrics:
+        merged = {**snap.get("counters", {}), **snap.get("gauges", {})}
+        for name in (
+            "serve_workers_live",
+            "serve_reroutes_total",
+            "affinity_hits_total",
+        ):
+            if name in merged:
+                fleet[name] = merged[name]
+    if fleet:
+        lines.append(
+            "  fleet metrics: "
+            + " ".join(f"{k}={_num(v)}" for k, v in fleet.items())
+        )
     return lines
 
 

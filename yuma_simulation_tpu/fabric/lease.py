@@ -296,7 +296,9 @@ class LeaseStore:
     def release(self, unit: int) -> None:
         """Drop the held lease after its result is published. Only
         removes the file while it still carries OUR claim (inode
-        check); a stolen lease belongs to the stealer and stays."""
+        check); a stolen lease belongs to the stealer and stays. The
+        annotation sidecar (if any) goes with it — an advertisement
+        must never outlive the claim it describes."""
         held = self._held.pop(unit, None)
         if held is None:
             return
@@ -304,5 +306,52 @@ class LeaseStore:
         try:
             if os.stat(path).st_ino == held.inode:
                 path.unlink(missing_ok=True)
+                self.annotation_path(unit).unlink(missing_ok=True)
         except FileNotFoundError:
             pass
+
+    # -- heartbeat annotations -------------------------------------------
+
+    def annotation_path(self, unit: int) -> pathlib.Path:
+        return self.directory / f"unit_{unit:05d}.ad.json"
+
+    def annotate(self, unit: int, payload: dict) -> None:
+        """Publish a heartbeat ADVERTISEMENT beside the held lease: an
+        arbitrary JSON payload (atomic tmp+rename, torn-read safe) a
+        scanner can pair with the lease's liveness. The serve scale-out
+        tier rides this — each worker advertises its held StateCache
+        prefixes and warm shape buckets here, and the router scores
+        claims against the ad ONLY while :meth:`read` +
+        :meth:`is_stealable` say the slot lease is live (a dead
+        worker's stale ad never wins a claim). Raises the typed
+        :class:`LeaseExpired` when the lease is no longer ours:
+        advertising for a stolen slot would point the router at a
+        usurped identity."""
+        held = self._held.get(unit)
+        if held is None:
+            raise LeaseExpired(
+                f"host {self.host_id} holds no lease for unit {unit}",
+                unit=unit,
+            )
+        record = dict(payload)
+        record.setdefault("host", self.host_id)
+        record.setdefault("unit", unit)
+        tmp = self.directory / (
+            f".ad.{self.host_id}.{uuid.uuid4().hex[:8]}.tmp"
+        )
+        data = json.dumps(record, sort_keys=True).encode()
+        _fsync_write(tmp, lambda f: f.write(data))
+        os.replace(tmp, self.annotation_path(unit))
+
+    def read_annotation(self, unit: int) -> Optional[dict]:
+        """The unit's last advertisement, or None when absent/torn (a
+        torn ad reads as None, never a crash — exactly like a torn
+        lease record, shared-store writes can always be caught
+        mid-rename)."""
+        try:
+            data = json.loads(self.annotation_path(unit).read_text())
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, OSError, UnicodeDecodeError):
+            return None
+        return data if isinstance(data, dict) else None

@@ -27,6 +27,7 @@ contract.
 from yuma_simulation_tpu.resilience.errors import (  # noqa: F401
     AdmissionRejected,
     CheckpointCorruptionError,
+    ClientRetriesExhausted,
     DeviceLossError,
     DistributedInitError,
     EngineCompileError,
@@ -40,6 +41,7 @@ from yuma_simulation_tpu.resilience.errors import (  # noqa: F401
     QueueOverflow,
     ResilienceError,
     SloShed,
+    WorkerLost,
     classify_failure,
 )
 from yuma_simulation_tpu.resilience.faults import (  # noqa: F401

@@ -83,6 +83,42 @@ class HostLossError(EngineStall):
         self.host_ids = tuple(host_ids)
 
 
+class WorkerLost(HostLossError):
+    """A serve-pool WORKER process died (or became unreachable) with a
+    routed request in flight: the router's forward hit a reset/refused
+    connection, or the worker's slot lease expired mid-request. The
+    scale-out analogue of :class:`HostLossError` on the serving tier —
+    and it subclasses it deliberately, so every fleet-aware handler
+    (typed, retryable, stall-shaped) treats it identically, while the
+    router matches the narrower type to reroute the SAME request onto a
+    surviving worker instead of surfacing a client-visible error.
+    Retryable by construction: serve requests are pure and idempotent,
+    so a reroute re-executes at worst duplicate work, never duplicate
+    effects. Carries the dead worker's id and how many reroute attempts
+    the router has burned so far."""
+
+    def __init__(self, message: str, *, worker_id: str = "", attempts: int = 0):
+        super().__init__(message, host_ids=(worker_id,) if worker_id else ())
+        self.worker_id = worker_id
+        self.attempts = attempts
+
+
+class ClientRetriesExhausted(ResilienceError):
+    """The client's bounded retry budget is spent and the LAST attempt
+    still failed at the transport level (connection reset/refused,
+    unreachable server). NOT an :class:`EngineFailure`: nothing
+    server-side can act on it — the caller must surface it. Carries the
+    attempt count and the last transport error so the caller's log says
+    how hard the client tried. HTTP-level 429/503 responses do NOT
+    raise this: after the budget is spent they are RETURNED (the
+    server's typed body is the contract and must reach the caller)."""
+
+    def __init__(self, message: str, *, attempts: int = 0, last_error=None):
+        super().__init__(message)
+        self.attempts = attempts
+        self.last_error = last_error
+
+
 class LeaseExpired(ResilienceError):
     """A fleet work-unit lease was lost: the holder's renewal found the
     claim file replaced (stolen after expiry), torn, or gone. NOT an

@@ -3,7 +3,7 @@
 The long-lived process that turns the platform into a product: engines
 stay warm across requests, heterogeneous tenants coalesce onto shared
 compiled shapes, and hostile traffic degrades gracefully instead of
-taking the process down. Five modules:
+taking the process down. The pipeline modules:
 
 - :mod:`.admission` — validate + price every request through the
   dispatch planner and the analytic HBM preflight BEFORE any compile
@@ -21,17 +21,37 @@ taking the process down. Five modules:
 - :mod:`.service` / :mod:`.server` — the pipeline core and its stdlib
   `http.server` front (``/v1/simulate``, ``/v1/sweep``, ``/v1/table``,
   ``/healthz``, ``/metrics``) plus the stdlib
-  :class:`~.server.SimulationClient`.
+  :class:`~.server.SimulationClient` (bounded retry-with-backoff via
+  ``retries=``).
+
+The horizontal scale-out tier (PR 16) rides on top of that pipeline:
+
+- :mod:`.apikeys` — signed HMAC tenant identity (``X-Api-Key``); the
+  verified tenant overwrites the payload claim before admission;
+- :mod:`.worker` — one pipeline process per pool slot, heartbeating a
+  lease annotated with its held state-cache prefixes and warm shape
+  buckets;
+- :mod:`.router` — the stateless front-end: admits through the same
+  :func:`.admission.admit` path, places by pure claim scoring
+  (:func:`.router.claim_score`), reroutes around killed workers;
+- :mod:`.autoscaler` — SLO fast-burn adds supply (AOT-preloaded
+  spawns), idleness retires it youngest-first.
 
 Run it: ``python -m yuma_simulation_tpu.serve`` (see ``--help``;
-``--smoke`` drives the CI smoke lane). README "Serving" has the
-operator contract.
+``--smoke`` drives the CI smoke lane, ``--router --worker-pool DIR``
+the scale-out deployment, ``--scaleout-drill`` its chaos proof).
+README "Serving" / "Horizontal serving" has the operator contract.
 """
 
 from yuma_simulation_tpu.serve.admission import (  # noqa: F401
     AdmissionTicket,
     admit,
 )
+from yuma_simulation_tpu.serve.apikeys import (  # noqa: F401
+    ApiKeyring,
+    mint_api_key,
+)
+from yuma_simulation_tpu.serve.autoscaler import Autoscaler  # noqa: F401
 from yuma_simulation_tpu.serve.lifecycle import (  # noqa: F401
     CircuitBreaker,
     warmup,
@@ -47,7 +67,15 @@ from yuma_simulation_tpu.serve.server import (  # noqa: F401
     SimulationServer,
     wait_until_ready,
 )
+from yuma_simulation_tpu.serve.router import (  # noqa: F401
+    RouterConfig,
+    RouterService,
+    WorkerPool,
+    claim_score,
+    rank_claims,
+)
 from yuma_simulation_tpu.serve.service import (  # noqa: F401
     ServeConfig,
     SimulationService,
 )
+from yuma_simulation_tpu.serve.worker import ServeWorker  # noqa: F401

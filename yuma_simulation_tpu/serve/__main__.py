@@ -39,6 +39,7 @@ def _build_config(args, **overrides) -> "ServeConfig":  # noqa: F821
         replay_cache_dir=args.replay_cache,
         replay_epochs_per_snapshot=args.replay_epochs_per_snapshot,
         replay_stride=args.replay_stride,
+        api_keys_path=getattr(args, "api_keys", None),
     )
 
 
@@ -259,20 +260,141 @@ def main(argv=None) -> int:
         help="carry-checkpoint stride (epochs) of cached baselines",
     )
     parser.add_argument(
+        "--api-keys",
+        default=None,
+        metavar="PATH",
+        help="signed-API-key keyfile (JSON tenant -> secret): requests "
+        "must present a valid X-Api-Key and the verified tenant "
+        "replaces any payload claim (typed 401 otherwise)",
+    )
+    parser.add_argument(
         "--smoke",
         action="store_true",
         help="CI smoke: ephemeral port, contract-defining requests, "
         "graceful shutdown, exit nonzero on any miss",
     )
+    # -- horizontal scale-out (PR 16) ---------------------------------
+    scale = parser.add_argument_group(
+        "scale-out",
+        "worker-pool mode (one warm worker claiming a lease slot), "
+        "router mode (the stateless front placing onto the pool), and "
+        "the kill-a-worker chaos drill",
+    )
+    scale.add_argument(
+        "--worker-pool",
+        default=None,
+        metavar="DIR",
+        help="join this pool directory as a WORKER: claim "
+        "--worker-slot, serve on an ephemeral port, heartbeat "
+        "state-cache/warm-bucket advertisements",
+    )
+    scale.add_argument(
+        "--worker-slot", type=int, default=0,
+        help="pool slot (lease unit) this worker claims",
+    )
+    scale.add_argument(
+        "--worker-id", default=None,
+        help="stable worker identity (default: worker-<pid>)",
+    )
+    scale.add_argument(
+        "--worker-ttl", type=float, default=3.0,
+        help="lease TTL seconds: miss heartbeats this long and the "
+        "router treats the worker as dead",
+    )
+    scale.add_argument(
+        "--router",
+        action="store_true",
+        help="run the stateless ROUTER: spawn --workers warm workers "
+        "into --worker-pool and place every request by state-cache "
+        "affinity",
+    )
+    scale.add_argument(
+        "--workers", type=int, default=2,
+        help="initial worker count for --router",
+    )
+    scale.add_argument(
+        "--max-workers", type=int, default=8,
+        help="pool slot ceiling (router + autoscaler)",
+    )
+    scale.add_argument(
+        "--no-affinity",
+        action="store_true",
+        help="router: round-robin placement instead of "
+        "state-cache-affinity claim scoring",
+    )
+    scale.add_argument(
+        "--worker-arg",
+        action="append",
+        default=None,
+        metavar="ARG",
+        help="extra CLI arg forwarded to every spawned worker "
+        "(repeatable; '{worker_id}' substitutes)",
+    )
+    scale.add_argument(
+        "--scaleout-drill",
+        action="store_true",
+        help="CI chaos lane: 3 workers + router, kill one mid-load, "
+        "prove typed reroutes + affinity + autoscaler, merge and "
+        "gate every flight bundle; exit nonzero on any miss",
+    )
     args = parser.parse_args(argv)
 
     if args.smoke:
         return run_smoke(args)
+    if args.scaleout_drill:
+        from yuma_simulation_tpu.serve.drill import run_scaleout_drill
 
-    from yuma_simulation_tpu.serve.server import SimulationServer
+        return run_scaleout_drill(args)
+    if args.worker_pool and not args.router:
+        from yuma_simulation_tpu.serve.worker import run_worker
+
+        return run_worker(args)
+
     from yuma_simulation_tpu.utils import setup_logging
 
     setup_logging()
+    if args.router:
+        from yuma_simulation_tpu.serve.router import (
+            RouterConfig,
+            RouterService,
+        )
+        from yuma_simulation_tpu.serve.server import SimulationServer
+
+        if not args.worker_pool:
+            parser.error("--router requires --worker-pool DIR")
+        router = RouterService(
+            RouterConfig(
+                pool_dir=args.worker_pool,
+                workers=args.workers,
+                max_workers=args.max_workers,
+                worker_args=tuple(args.worker_arg or ()),
+                lease_ttl_seconds=args.worker_ttl,
+                bundle_dir=args.bundle_dir,
+                api_keys_path=args.api_keys,
+                affinity=not args.no_affinity,
+                default_deadline_seconds=args.deadline,
+                max_batch=args.max_batch,
+                replay_archive_dir=args.replay_archive,
+                replay_cache_dir=args.replay_cache,
+                replay_epochs_per_snapshot=(
+                    args.replay_epochs_per_snapshot
+                ),
+                replay_stride=args.replay_stride,
+            )
+        )
+        router.start_workers()
+        server = SimulationServer(
+            service=router, host=args.host, port=args.port
+        )
+        print(
+            f"routing on {server.url} "
+            f"({args.workers} workers; Ctrl-C to stop)"
+        )
+        server.serve_forever()
+        return 0
+
+    from yuma_simulation_tpu.serve.server import SimulationServer
+
     server = SimulationServer(
         _build_config(args), host=args.host, port=args.port
     )

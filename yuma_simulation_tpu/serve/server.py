@@ -34,6 +34,7 @@ import json
 import logging
 import socket
 import threading
+import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
@@ -161,7 +162,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self.headers.get(BAGGAGE_HEADER),
             )
             status, body, headers = self.service.handle(
-                kind, payload, request_id=self._rid, trace=trace
+                kind,
+                payload,
+                request_id=self._rid,
+                trace=trace,
+                api_key=self.headers.get("X-Api-Key"),
             )
             self._send_json(status, body, headers)
         except BrokenPipeError:
@@ -285,18 +290,54 @@ class ServeResponse:
         return out
 
 
+#: HTTP statuses the client's bounded retry loop treats as transient
+#: (the server said "come back": quota shed / draining / overloaded).
+_RETRYABLE_STATUSES = (429, 503)
+
+
 class SimulationClient:
     """Stdlib client for the serving tier (the v1 helper): JSON over
     urllib, typed :class:`ServeResponse` back — 4xx/5xx are RETURNED
     (the server's typed bodies are the contract), never raised; only
-    transport-level failures raise (`URLError`)."""
+    transport-level failures raise.
+
+    **Bounded retry-with-backoff** (``retries`` > 0): transport-level
+    connection resets/refusals and transient HTTP statuses (429/503)
+    are retried up to ``retries`` extra attempts with exponential
+    backoff — a server-sent ``Retry-After`` overrides the computed
+    backoff (capped at ``max_backoff_seconds``), and every attempt
+    re-sends the SAME ``traceparent``, so the server-side spans of all
+    attempts stitch into one caller trace. When the budget is spent:
+    a transport-level failure raises the typed
+    :class:`..resilience.errors.ClientRetriesExhausted`; a transient
+    HTTP response is RETURNED (its typed body is the contract and must
+    reach the caller). ``retries=0`` (default) preserves the legacy
+    single-shot behavior — callers who assert on raw 429s (quota
+    tests, the smoke drill) see every response.
+
+    ``api_key`` (see :mod:`.apikeys`) rides every request as
+    ``X-Api-Key`` against deployments with signed tenant identity."""
 
     def __init__(
-        self, base_url: str, *, tenant: str = "default", timeout: float = 120.0
+        self,
+        base_url: str,
+        *,
+        tenant: str = "default",
+        timeout: float = 120.0,
+        retries: int = 0,
+        backoff_base: float = 0.1,
+        max_backoff_seconds: float = 5.0,
+        api_key: Optional[str] = None,
     ):
+        if retries < 0:
+            raise ValueError("retries must be >= 0")
         self.base_url = base_url.rstrip("/")
         self.tenant = tenant
         self.timeout = timeout
+        self.retries = int(retries)
+        self.backoff_base = float(backoff_base)
+        self.max_backoff_seconds = float(max_backoff_seconds)
+        self.api_key = api_key
 
     def _trace_headers(self) -> dict:
         """One traceparent per call: the caller's active run + innermost
@@ -325,39 +366,90 @@ class SimulationClient:
     ) -> ServeResponse:
         url = self.base_url + path
         data = None
+        # One trace identity for the WHOLE retry loop: every attempt
+        # re-sends the same traceparent, so the server-side request
+        # spans of attempt 1..N stitch into one caller trace instead of
+        # N unrelated ones.
         headers = {"Accept": "application/json"}
         headers.update(self._trace_headers())
+        if self.api_key is not None:
+            headers["X-Api-Key"] = self.api_key
         if payload is not None:
             data = json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"
-        req = urllib.request.Request(
-            url, data=data, headers=headers, method=method
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                raw = resp.read()
-                status = resp.status
-                hdrs = dict(resp.headers.items())
-        except urllib.error.HTTPError as err:
-            raw = err.read()
-            status = err.code
-            hdrs = dict(err.headers.items()) if err.headers else {}
-        try:
-            body = json.loads(raw.decode() or "{}")
-        except ValueError:
-            body = {"status": "error", "raw": raw.decode(errors="replace")}
-        retry_after = None
-        if "Retry-After" in hdrs:
+        last_exc: Optional[Exception] = None
+        response: Optional[ServeResponse] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                wait = min(
+                    self.max_backoff_seconds,
+                    self.backoff_base * (2.0 ** (attempt - 1)),
+                )
+                # The server's own Retry-After is the honest backoff:
+                # honor it (still capped — a hostile or confused server
+                # must not park the client for an hour).
+                if response is not None and response.retry_after:
+                    wait = min(
+                        self.max_backoff_seconds, response.retry_after
+                    )
+                time.sleep(wait)
+            req = urllib.request.Request(
+                url, data=data, headers=headers, method=method
+            )
             try:
-                retry_after = float(hdrs["Retry-After"])
+                try:
+                    with urllib.request.urlopen(
+                        req, timeout=self.timeout
+                    ) as resp:
+                        raw = resp.read()
+                        status = resp.status
+                        hdrs = dict(resp.headers.items())
+                except urllib.error.HTTPError as err:
+                    raw = err.read()
+                    status = err.code
+                    hdrs = dict(err.headers.items()) if err.headers else {}
+            except (urllib.error.URLError, ConnectionError, OSError) as exc:
+                # Transport-level failure (connection refused/reset,
+                # unreachable): retryable while budget remains.
+                last_exc = exc
+                response = None
+                continue
+            try:
+                body = json.loads(raw.decode() or "{}")
             except ValueError:
-                pass
-        return ServeResponse(
-            status=status,
-            body=body,
-            retry_after=retry_after,
-            headers=hdrs,
-            traceparent=headers.get("traceparent"),
+                body = {
+                    "status": "error",
+                    "raw": raw.decode(errors="replace"),
+                }
+            retry_after = None
+            if "Retry-After" in hdrs:
+                try:
+                    retry_after = float(hdrs["Retry-After"])
+                except ValueError:
+                    pass
+            response = ServeResponse(
+                status=status,
+                body=body,
+                retry_after=retry_after,
+                headers=hdrs,
+                traceparent=headers.get("traceparent"),
+            )
+            if status not in _RETRYABLE_STATUSES:
+                return response
+            # 429/503: transient by contract; fall through to retry.
+        if response is not None:
+            # Budget spent on transient HTTP statuses: the server's
+            # typed body is the contract — return the last one.
+            return response
+        from yuma_simulation_tpu.resilience.errors import (
+            ClientRetriesExhausted,
+        )
+
+        raise ClientRetriesExhausted(
+            f"{method} {url} failed after {self.retries + 1} attempt(s): "
+            f"{last_exc}",
+            attempts=self.retries + 1,
+            last_error=last_exc,
         )
 
     def _post(self, path: str, payload: dict) -> ServeResponse:

@@ -111,6 +111,14 @@ class ServeConfig:
     tenant_priority: Optional[dict] = None
     #: Retry-After for SLO-driven sheds (seconds).
     slo_shed_retry_after: float = 5.0
+    #: Signed-API-key keyfile (:mod:`.apikeys`): JSON mapping
+    #: ``tenant -> secret``. When set, every POST must carry a valid
+    #: ``X-Api-Key`` — the verified key RESOLVES the tenant id before
+    #: the negotiated quota/priority tables, so the payload's claimed
+    #: ``tenant``/``priority`` is never trusted; unauthenticated
+    #: requests get a typed 401. None (default) keeps the legacy
+    #: payload-claimed tenant — single-operator deployments.
+    api_keys_path: Optional[str] = None
     #: Background numerics-canary cadence: every this-many seconds of
     #: dispatcher idle time, re-execute one warm shape bucket on the
     #: plan's primary rung AND its demoted rung and compare per-epoch
@@ -216,6 +224,13 @@ class SimulationService:
 
             configure_executable_cache(self.config.executable_cache_dir)
         self.run = RunContext()
+        # Tenant identity (apikeys): load eagerly so a bad keyfile
+        # fails construction, not the first request.
+        self.keyring = None
+        if self.config.api_keys_path:
+            from yuma_simulation_tpu.serve.apikeys import ApiKeyring
+
+            self.keyring = ApiKeyring.load(self.config.api_keys_path)
         self._slo_installed = False
         if slo_engine is not None:
             self.slo = slo_engine
@@ -361,6 +376,17 @@ class SimulationService:
         with self._ledger_lock:
             self.ledger.append(event, **fields)
 
+    def _append_ledger_rootspan(self, event: str, **fields) -> None:
+        """A ledger record under its own fresh root span of the SERVICE
+        run — for records born outside any request span (401s rejected
+        before the pipeline, pool lifecycle events), which must still
+        resolve under ``obsreport --check``'s span gate."""
+        from yuma_simulation_tpu.telemetry.runctx import span
+
+        with self.run.activate():
+            with span(f"{event}:{fields.get('request', '')}", root=True):
+                self._append_ledger(event, **fields)
+
     def _slo_transition(self, rec: dict) -> None:
         """The burn-rate engine's alert hook: every transition is a
         typed ledger record under its own span of the SERVICE run (a
@@ -429,7 +455,8 @@ class SimulationService:
     # -- the request pipeline -------------------------------------------
 
     def handle(
-        self, kind: str, payload, *, request_id=None, trace=None
+        self, kind: str, payload, *, request_id=None, trace=None,
+        api_key=None,
     ) -> tuple[int, dict, dict]:
         """One request, end to end; returns `(status, body, headers)`.
         Total by construction: every exit path is a typed JSON body
@@ -453,6 +480,37 @@ class SimulationService:
         t0 = time.perf_counter()
         t_wall0 = time.time()
         self._requests_total.inc()
+        if self.keyring is not None:
+            # Keys configured: the VERIFIED key is the tenant identity.
+            # The payload's claimed tenant/priority is overwritten (not
+            # merely clamped) before admission ever sees it — an
+            # unauthenticated request is a typed 401, never a silent
+            # fall-through to the anonymous tenant's quota.
+            resolved = self.keyring.resolve(api_key)
+            if resolved is None:
+                self._append_ledger_rootspan(
+                    "request_done",
+                    request=rid,
+                    tenant="<unauthenticated>",
+                    endpoint=kind,
+                    status=401,
+                    outcome="rejected",
+                )
+                return (
+                    401,
+                    {
+                        "status": "rejected",
+                        "error": "Unauthenticated",
+                        "message": "a valid X-Api-Key is required by "
+                        "this deployment",
+                        "request_id": rid,
+                    },
+                    {"X-Request-Id": rid},
+                )
+            if isinstance(payload, dict):
+                payload = dict(payload, tenant=resolved)
+            else:
+                payload = {"tenant": resolved}
         tenant = (
             payload.get("tenant", "anonymous")
             if isinstance(payload, dict)
@@ -1351,6 +1409,15 @@ class SimulationService:
             },
             "canary": self._canary_snapshot(),
         }
+
+    def warm_buckets(self) -> list[str]:
+        """The `ExVxM` shape buckets this process holds warm (warmup
+        shapes + every successfully dispatched simulate shape, most
+        recent last) — advertised by scale-out workers so the router's
+        claim scoring can prefer a worker that already traced the
+        requested shape."""
+        with self._canary_lock:
+            return list(self._canary_order)
 
     def _canary_snapshot(self) -> dict:
         with self._canary_lock:

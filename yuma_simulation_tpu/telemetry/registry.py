@@ -232,6 +232,64 @@ EVENTS = {
         "serve service closed and published its flight bundle",
         operator_reason="shutdown marker closing the request ledger",
     ),
+    # -- horizontal scale-out (serve.router / serve.worker) --------------
+    "worker_spawned": EventSpec(
+        "one pool worker process spawned (startup or SLO-burn "
+        "autoscale); carries the reason and the worker's AOT build "
+        "count (zero when the shared executable cache warmed it)",
+        consumers=("obsreport",),
+    ),
+    "worker_retired": EventSpec(
+        "one pool worker retired gracefully (drain + bundle publish + "
+        "slot release)",
+        consumers=("obsreport",),
+    ),
+    "worker_lost": EventSpec(
+        "a worker observed dead on a forward leg (connection "
+        "reset/refused mid-request) — routing stops considering it "
+        "before its lease even expires",
+        consumers=("obsreport",),
+    ),
+    "request_rerouted": EventSpec(
+        "one forward leg moved off a lost worker onto a survivor "
+        "(the client sees the survivor's answer, never the reset)",
+        consumers=("obsreport",),
+    ),
+    "worker_spawning": EventSpec(
+        "router forked a worker process onto a free slot (precedes "
+        "the ledgered worker_spawned, which waits for readiness)",
+        operator_reason="spawn forensics: pins the pid/slot when a "
+        "worker dies before ever advertising",
+    ),
+    "worker_ready": EventSpec(
+        "worker claimed its slot lease and began heartbeating ads",
+        operator_reason="startup marker in the worker's own log; the "
+        "router-side worker_spawned record is the reconciled event",
+    ),
+    "worker_lease_lost": EventSpec(
+        "worker's own slot lease expired under it (missed heartbeats) "
+        "— it must stop serving rather than split-brain the slot",
+        operator_reason="incident forensics for the worker side of a "
+        "partition; the router side rides worker_lost",
+    ),
+    "worker_stopped": EventSpec(
+        "worker drained, published its bundle, and released its slot",
+        operator_reason="shutdown marker closing the worker's log",
+    ),
+    "router_stopped": EventSpec(
+        "router closed: pool retired, merged ingress bundle published",
+        operator_reason="shutdown marker closing the router's ledger",
+    ),
+    "autoscale_up": EventSpec(
+        "autoscaler spawned one worker on an SLO fast burn",
+        operator_reason="capacity forensics; the ledgered "
+        "worker_spawned record carries the same reason string",
+    ),
+    "autoscale_down": EventSpec(
+        "autoscaler retired one idle worker (youngest-first)",
+        operator_reason="capacity forensics; the ledgered "
+        "worker_retired record is the reconciled event",
+    ),
     "breaker_tripped": EventSpec(
         "circuit breaker opened an engine rung fleet-wide",
         operator_reason="breaker forensics; serve_breaker_trips / "
@@ -409,6 +467,22 @@ METRICS = {
     ),
     "serve_canary_drift": MetricSpec(
         "counter", "serve canary comparisons that confirmed drift",
+    ),
+    # -- horizontal scale-out (serve.router) -----------------------------
+    "serve_workers_live": MetricSpec(
+        "gauge", "live serve workers behind the router (fresh lease + "
+        "advertisement) right now",
+        consumers=("obsreport",),
+    ),
+    "serve_reroutes_total": MetricSpec(
+        "counter", "forward legs rerouted off a lost worker onto a "
+        "survivor",
+        consumers=("obsreport",),
+    ),
+    "affinity_hits_total": MetricSpec(
+        "counter", "requests the claim scorer placed on a worker "
+        "already holding useful state (cache prefix or warm bucket)",
+        consumers=("obsreport",),
     ),
     # -- AOT executable cache (simulation.aot) ---------------------------
     "executable_cache_hits": MetricSpec(
