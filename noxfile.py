@@ -54,8 +54,10 @@ def analysis(session: nox.Session) -> None:
     """Whole-program analysis lane (mirrors the CI `analysis` job):
     jaxlint --strict over yuma_simulation_tpu + tools + tests (tracing
     reach through the call graph, JX1xx concurrency discipline, JX2xx
-    telemetry contracts), the zero-compile shapecheck gate over the
-    planner bucket grid, and the telemetry-registry runtime validation.
+    telemetry contracts, JX3xx wire contracts), wirecheck against the
+    committed SCHEMAS.lock.json, the zero-compile shapecheck gate over
+    the planner bucket grid, and the telemetry-registry runtime
+    validation.
     JSON findings land in the session tmp dir, same schema CI uploads."""
     session.install("-e", ".[test]")
     import os
@@ -65,6 +67,11 @@ def analysis(session: nox.Session) -> None:
         "python", "-m", "tools.jaxlint",
         "yuma_simulation_tpu", "tools", "tests", "--strict",
         "--artifact", os.path.join(tmp, "jaxlint_findings.json"),
+    )
+    session.run(
+        "python", "-m", "tools.wirecheck",
+        "yuma_simulation_tpu", "tools", "tests", "--check", "--strict",
+        "--artifact", os.path.join(tmp, "wirecheck_schemas.json"),
     )
     session.run(
         "python", "-m", "tools.shapecheck", "--check",

@@ -419,6 +419,112 @@ EVENTS = {
 }
 """,
     ),
+    "JX301": (
+        # report reads a field no producer of the event ever writes —
+        # the column is permanently empty
+        """
+class Host:
+    def ok(self, unit):
+        self.ledger.append("unit_ok", unit=unit, stalls=2)
+
+
+def report(records):
+    oks = [r for r in records if r.get("event") == "unit_ok"]
+    return [r.get("stall_count") for r in oks]
+""",
+        """
+class Host:
+    def ok(self, unit):
+        self.ledger.append("unit_ok", unit=unit, stalls=2)
+
+
+def report(records):
+    oks = [r for r in records if r.get("event") == "unit_ok"]
+    return [r.get("stalls") for r in oks]
+""",
+    ),
+    "JX302": (
+        # typed error raised on a serve-reachable path with no HTTP
+        # mapping anywhere in the serve tier
+        """
+class ResilienceError(Exception):
+    pass
+
+
+class QuotaBlown(ResilienceError):
+    pass
+
+
+def classify_failure(exc):
+    if isinstance(exc, ResilienceError):
+        return None
+    return None
+
+
+def check(payload):
+    if not payload:
+        raise QuotaBlown("over budget")
+
+
+def handle_request(payload):
+    check(payload)
+    return 200, {"status": "ok"}
+""",
+        # a typed except on the serve path IS the HTTP mapping
+        """
+class ResilienceError(Exception):
+    pass
+
+
+class QuotaBlown(ResilienceError):
+    pass
+
+
+def classify_failure(exc):
+    if isinstance(exc, ResilienceError):
+        return None
+    return None
+
+
+def check(payload):
+    if not payload:
+        raise QuotaBlown("over budget")
+
+
+def handle_request(payload):
+    try:
+        check(payload)
+    except QuotaBlown as exc:
+        return 429, {"status": "rejected", "error": str(exc)}
+    return 200, {"status": "ok"}
+""",
+    ),
+    "JX303": (
+        # claim scoring reads an annotation field the heartbeat never
+        # advertises; the advertised 'magic' is dead weight both ways
+        """
+class Pool:
+    def heartbeat(self, slot):
+        self.leases.annotate(
+            slot, {"worker_id": "w0", "inflight": 0, "magic": 1}
+        )
+
+
+def claim_score(ad):
+    return (ad.get("inflight"), ad.get("crystal"))
+""",
+        """
+class Pool:
+    def heartbeat(self, slot):
+        self.leases.annotate(
+            slot, {"worker_id": "w0", "inflight": 0}
+        )
+
+
+def claim_score(ad):
+    return (ad.get("inflight"), ad.get("worker_id"))
+""",
+    ),
 }
 
 #: rules whose scope is path-filtered
@@ -433,6 +539,12 @@ _RULE_PATHS = {
     "JX201": "yuma_simulation_tpu/fabric/emit.py",
     "JX202": "yuma_simulation_tpu/fabric/count.py",
     "JX203": "yuma_simulation_tpu/telemetry/registry.py",
+    # JX301 consumers are skipped in tests/; tools/ keeps the fixture
+    # out of the JX2xx package census. JX302/JX303 need a serve-path
+    # unit (serve reachability / claim-scoring scope).
+    "JX301": "tools/obsfix.py",
+    "JX302": "yuma_simulation_tpu/serve/handler.py",
+    "JX303": "yuma_simulation_tpu/serve/minirouter.py",
 }
 
 
@@ -495,7 +607,11 @@ def test_parse_error_reported_not_crashed():
 
 
 def test_rule_registry_covers_corpus():
-    assert set(CORPUS) == set(RULES)
+    # JX304 (locked-schema regression) is inherently two-input — a
+    # tree plus a lock file — so its violating/clean pair lives in
+    # tests/unit/test_wirecheck.py as CLI round-trips instead.
+    assert set(RULES) - set(CORPUS) == {"JX304"}
+    assert set(CORPUS) <= set(RULES)
 
 
 def test_live_codebase_is_clean_strict(capsys):

@@ -200,6 +200,31 @@ def test_admission_rejects_malformed_payloads():
         svc.close()
 
 
+def test_admission_accepts_reset_bonds_knobs():
+    """The explicit-array surface's ``reset_bonds_index`` /
+    ``reset_bonds_epoch`` knobs thread into the built Scenario (and
+    non-integers are rejected) — this is also the wirecheck producer
+    evidence that the fields admission reads ARE part of the wire
+    contract, not dead parser surface."""
+    from yuma_simulation_tpu.resilience.errors import AdmissionRejected
+    from yuma_simulation_tpu.serve.admission import admit
+
+    kw = dict(
+        request_id="r1", kind="simulate", default_deadline_seconds=30.0
+    )
+    payload = {
+        "weights": np.zeros((2, 2, 3)).tolist(),
+        "stakes": np.ones((2, 2)).tolist(),
+        "reset_bonds_index": 1,
+        "reset_bonds_epoch": 1,
+    }
+    ticket = admit(payload, **kw)
+    assert ticket.scenario.reset_bonds_index == 1
+    assert ticket.scenario.reset_bonds_epoch == 1
+    with pytest.raises(AdmissionRejected):
+        admit(dict(payload, reset_bonds_index="one"), **kw)
+
+
 def test_admission_clamps_priority_to_negotiated_ceiling():
     """The payload ``priority`` field is untrusted: with a
     ``tenant_priority`` ceiling table installed, a tenant rides at most

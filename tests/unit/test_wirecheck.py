@@ -1,0 +1,298 @@
+"""wirecheck: per-gate CLI regression tests + the live-repo-clean gate.
+
+Each of the four wire-contract gates gets a violating tmp-tree that
+must fail ``--check`` with the producer/consumer chain named in the
+finding, mirroring the violating/clean fixture pairs in
+``test_jaxlint.py`` (JX301-JX303 corpus entries). JX304 is inherently
+two-input — a tree plus a lock — so its pair lives here as CLI
+round-trips: ``--update`` then ``--check`` exits 0, hand-deleting a
+locked field exits 1. The final tests run the real CLI over the repo
+with the committed ``SCHEMAS.lock.json`` and require exit 0.
+"""
+
+import json
+import os
+
+import pytest
+
+from tools.wirecheck.cli import main
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: a self-consistent one-producer/one-consumer ledger tree
+_CLEAN_TREE = {
+    "host.py": """
+class Host:
+    def ok(self, unit):
+        self.ledger.append("unit_ok", unit=unit, stalls=2)
+""",
+    "obsfix.py": """
+def report(records):
+    oks = [r for r in records if r.get("event") == "unit_ok"]
+    return [(r.get("unit"), r.get("stalls")) for r in oks]
+""",
+}
+
+
+def _write_tree(root, files):
+    for rel, src in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src, encoding="utf-8")
+    return str(root)
+
+
+def _check(root, lock, *extra):
+    return main([root, "--lock", str(lock), "--check", *extra])
+
+
+def test_update_then_check_round_trips(tmp_path, capsys):
+    root = _write_tree(tmp_path / "pkg", _CLEAN_TREE)
+    lock = tmp_path / "SCHEMAS.lock.json"
+    assert main([root, "--lock", str(lock), "--update"]) == 0
+    payload = json.loads(lock.read_text())
+    assert payload["version"] == 1
+    assert sorted(payload["schemas"]["ledger"]["unit_ok"]) == sorted(
+        ["event", "t", "run_id", "span_id", "parent_id", "unit", "stalls"]
+    )
+    assert _check(root, lock) == 0
+
+
+def test_missing_lock_is_a_usage_error(tmp_path, capsys):
+    root = _write_tree(tmp_path / "pkg", _CLEAN_TREE)
+    assert _check(root, tmp_path / "nope.lock.json") == 2
+    assert "not found" in capsys.readouterr().err
+
+
+def test_deleting_a_locked_field_fails_check(tmp_path, capsys):
+    """JX304, field removal: the additive-only contract — a field
+    frozen in the lock that the tree no longer produces is a
+    regression, and the finding points at the sanctioned escape hatch
+    (``--update``)."""
+    root = _write_tree(tmp_path / "pkg", _CLEAN_TREE)
+    lock = tmp_path / "SCHEMAS.lock.json"
+    assert main([root, "--lock", str(lock), "--update"]) == 0
+    payload = json.loads(lock.read_text())
+    payload["schemas"]["ledger"]["unit_ok"].append("operator_note")
+    lock.write_text(json.dumps(payload))
+    assert _check(root, lock) == 1
+    out = capsys.readouterr().out
+    assert "operator_note" in out and "JX304" in out
+    assert "--update" in out
+
+
+def test_deleting_a_locked_record_fails_check(tmp_path, capsys):
+    root = _write_tree(tmp_path / "pkg", _CLEAN_TREE)
+    lock = tmp_path / "SCHEMAS.lock.json"
+    assert main([root, "--lock", str(lock), "--update"]) == 0
+    payload = json.loads(lock.read_text())
+    payload["schemas"]["ledger"]["unit_gone"] = ["event", "unit"]
+    lock.write_text(json.dumps(payload))
+    assert _check(root, lock) == 1
+    out = capsys.readouterr().out
+    assert "unit_gone" in out and "no longer produced" in out
+
+
+def test_orphan_read_fails_with_producer_chain(tmp_path, capsys):
+    """JX301: a consumed field with no producer exits non-zero and the
+    finding names the event's real producer sites."""
+    tree = dict(_CLEAN_TREE)
+    tree["obsfix.py"] = """
+def report(records):
+    oks = [r for r in records if r.get("event") == "unit_ok"]
+    return [r.get("stall_count") for r in oks]
+"""
+    root = _write_tree(tmp_path / "pkg", tree)
+    lock = tmp_path / "SCHEMAS.lock.json"
+    assert main([root, "--lock", str(lock), "--update"]) == 0
+    assert _check(root, lock) == 1
+    out = capsys.readouterr().out
+    assert "JX301" in out and "stall_count" in out
+    assert "producers of 'unit_ok'" in out and "host.py" in out
+
+
+def test_unmapped_typed_error_fails_with_reach_chain(tmp_path, capsys):
+    """JX302: a ResilienceError subclass raised on a serve-reachable
+    path with no HTTP mapping exits non-zero; the finding shows the
+    reachability chain."""
+    root = _write_tree(
+        tmp_path / "pkg",
+        {
+            "serve/handler.py": """
+class ResilienceError(Exception):
+    pass
+
+
+class QuotaBlown(ResilienceError):
+    pass
+
+
+def check(payload):
+    if not payload:
+        raise QuotaBlown("over budget")
+
+
+def handle_request(payload):
+    check(payload)
+    return 200, {"status": "ok"}
+""",
+        },
+    )
+    lock = tmp_path / "SCHEMAS.lock.json"
+    assert main([root, "--lock", str(lock), "--update"]) == 0
+    assert _check(root, lock) == 1
+    out = capsys.readouterr().out
+    assert "JX302" in out and "QuotaBlown" in out
+    assert "via" in out and "check" in out
+
+
+def test_one_sided_annotation_fails_both_directions(tmp_path, capsys):
+    """JX303: a scored-but-never-advertised annotation field AND an
+    advertised-but-never-read one both exit non-zero, each naming the
+    other side's sites."""
+    root = _write_tree(
+        tmp_path / "pkg",
+        {
+            "serve/minirouter.py": """
+class Pool:
+    def heartbeat(self, slot):
+        self.leases.annotate(
+            slot, {"worker_id": "w0", "inflight": 0, "magic": 1}
+        )
+
+
+def claim_score(ad):
+    return (ad.get("inflight"), ad.get("worker_id"), ad.get("crystal"))
+""",
+        },
+    )
+    lock = tmp_path / "SCHEMAS.lock.json"
+    assert main([root, "--lock", str(lock), "--update"]) == 0
+    assert _check(root, lock) == 1
+    out = capsys.readouterr().out
+    assert out.count("JX303") == 2
+    assert "crystal" in out and "advertised at:" in out  # orphan score
+    assert "magic" in out and "dead wire weight" in out  # dead weight
+    assert "minirouter.py" in out
+
+
+def test_suppression_silences_and_strict_sweeps(tmp_path, capsys):
+    """JX3xx rides jaxlint's suppression machinery: a per-line
+    disable pragma silences the finding, and a stale one fails
+    ``--strict``. (The pragma is assembled at runtime so the scanner
+    doesn't read THIS file's fixture strings as suppressions.)"""
+    pragma = "# jaxlint: " + "disable=JX303"
+    root = _write_tree(
+        tmp_path / "pkg",
+        {
+            "serve/minirouter.py": f"""
+class Pool:
+    def heartbeat(self, slot):
+        self.leases.annotate(
+            slot,
+            {{"worker_id": "w0", "magic": 1}},  {pragma}
+        )
+
+
+def claim_score(ad):
+    return (ad.get("worker_id"),)
+""",
+        },
+    )
+    lock = tmp_path / "SCHEMAS.lock.json"
+    assert main([root, "--lock", str(lock), "--update"]) == 0
+    assert _check(root, lock) == 0
+    capsys.readouterr()
+    # drop the dead-weight field: the suppression goes stale and only
+    # --strict turns that into a failure
+    (tmp_path / "pkg" / "serve" / "minirouter.py").write_text(
+        f"""
+class Pool:
+    def heartbeat(self, slot):
+        self.leases.annotate(
+            slot,
+            {{"worker_id": "w0"}},  {pragma}
+        )
+
+
+def claim_score(ad):
+    return (ad.get("worker_id"),)
+""",
+        encoding="utf-8",
+    )
+    assert main([root, "--lock", str(lock), "--update"]) == 0
+    assert _check(root, lock) == 0
+    assert _check(root, lock, "--strict") == 1
+    assert "unused suppression" in capsys.readouterr().out
+
+
+def test_json_payload_and_artifact(tmp_path, capsys):
+    root = _write_tree(tmp_path / "pkg", _CLEAN_TREE)
+    lock = tmp_path / "SCHEMAS.lock.json"
+    artifact = tmp_path / "wirecheck.json"
+    assert main([root, "--lock", str(lock), "--update"]) == 0
+    capsys.readouterr()
+    assert (
+        main(
+            [root, "--lock", str(lock), "--check", "--json",
+             "--artifact", str(artifact)]
+        )
+        == 0
+    )
+    printed = json.loads(capsys.readouterr().out)
+    on_disk = json.loads(artifact.read_text())
+    assert printed == on_disk
+    assert printed["findings"] == [] and printed["lock_regressions"] == []
+    assert "unit_ok" in printed["schemas"]["ledger"]
+
+
+@pytest.mark.parametrize("verb", ["--check", "--update"])
+def test_missing_path_is_usage_error(tmp_path, capsys, verb):
+    assert main([str(tmp_path / "ghost"), verb]) == 2
+
+
+def test_live_repo_is_clean_against_committed_lock(capsys):
+    """The acceptance gate: ``python -m tools.wirecheck --check`` over
+    all three roots with the committed SCHEMAS.lock.json exits 0 — no
+    orphan reads, no unmapped typed errors, no one-sided annotations,
+    no lock regressions, and (--strict) no rotting JX3xx
+    suppressions."""
+    roots = [
+        os.path.join(REPO, "yuma_simulation_tpu"),
+        os.path.join(REPO, "tools"),
+        os.path.join(REPO, "tests"),
+    ]
+    lock = os.path.join(REPO, "SCHEMAS.lock.json")
+    rc = main([*roots, "--lock", lock, "--check", "--strict"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"wirecheck --check failed on the live tree:\n{out}"
+
+
+def test_live_lock_matches_live_tree_exactly(capsys):
+    """The committed lock is regenerable: the current tree's schemas
+    must be a superset of the lock (additive evolution) AND the lock
+    must not lag — a PR that grows a schema must also run --update, or
+    the next --update produces diff noise on an unrelated change."""
+    from tools.jaxlint.analyzer import iter_python_files
+    from tools.jaxlint.program import Program, parse_unit
+    from tools.wirecheck.extract import extract_index
+    from tools.wirecheck.gates import schemas_of
+
+    roots = [
+        os.path.join(REPO, "yuma_simulation_tpu"),
+        os.path.join(REPO, "tools"),
+        os.path.join(REPO, "tests"),
+    ]
+    units = [
+        parse_unit(f.read_text(encoding="utf-8"), str(f))
+        for f in iter_python_files(roots)
+    ]
+    current = schemas_of(extract_index(Program(units)))
+    with open(os.path.join(REPO, "SCHEMAS.lock.json")) as fh:
+        locked = json.load(fh)["schemas"]
+    assert current == locked, (
+        "SCHEMAS.lock.json is stale — run `python -m tools.wirecheck "
+        "--update` and commit the diff"
+    )

@@ -5,15 +5,22 @@ Each family module exposes ``FAMILY`` (its name), ``RULES`` (code ->
 code, message)`` records a raw finding; the driver applies per-line
 suppressions afterwards. Codes are stable across refactors: JX0xx
 trace/hygiene discipline (PR 2), JX1xx concurrency discipline, JX2xx
-telemetry contracts (both PR 11).
+telemetry contracts (both PR 11), JX3xx wire/durable-record contracts
+(the wirecheck family).
 """
 
 from __future__ import annotations
 
-from tools.jaxlint.rules import concurrency, contracts, hygiene, tracing
+from tools.jaxlint.rules import (
+    concurrency,
+    contracts,
+    hygiene,
+    tracing,
+    wire,
+)
 
 #: Family modules in check order (deterministic output ordering).
-FAMILIES = (tracing, hygiene, concurrency, contracts)
+FAMILIES = (tracing, hygiene, concurrency, contracts, wire)
 
 #: The aggregate rule registry: code -> (name, summary).
 RULES: dict[str, tuple[str, str]] = {}

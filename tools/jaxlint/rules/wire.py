@@ -1,0 +1,107 @@
+"""JX3xx: wire/durable-artifact contracts (the wirecheck family).
+
+Thin jaxlint adapter over :mod:`tools.wirecheck` — extraction lives in
+``tools/wirecheck/extract.py``, the gates in ``tools/wirecheck/gates.py``
+— so the same producer/consumer index backs both this rule family (per
+line suppressible, swept by ``--strict``) and the standalone
+``python -m tools.wirecheck`` CLI that owns the schema lock.
+
+The gates are whole-program by construction and self-gate on evidence:
+JX301/JX303 stay silent for record kinds whose producers (or consumers)
+are outside the analyzed roots, JX302 requires a ``ResilienceError``
+hierarchy plus a serve tier in the program, and JX304 only runs when
+the analyzed roots span the repo AND ``SCHEMAS.lock.json`` exists at
+the repo root — so single-file fixture runs and partial-root
+invocations never produce blind-spot noise.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from tools.jaxlint.program import Program
+
+FAMILY = "wire"
+
+RULES = {
+    "JX301": (
+        "orphan-wire-read",
+        "a consumer reads a record field that no producer in the "
+        "program ever writes — the read is permanently None/KeyError "
+        "and the report/score built from it is a hole",
+    ),
+    "JX302": (
+        "unmapped-typed-error",
+        "a ResilienceError subclass raised in a serve-reachable "
+        "function has no HTTP-status mapping in the serve tier or no "
+        "retryability class in classify_failure",
+    ),
+    "JX303": (
+        "lease-annotation-closure",
+        "a lease-annotation field is scored by claim ranking but "
+        "never advertised by the worker heartbeat (or advertised but "
+        "never read: dead wire weight)",
+    ),
+    "JX304": (
+        "locked-schema-regression",
+        "a field frozen in SCHEMAS.lock.json is no longer produced — "
+        "wire schemas evolve additively; regenerate the lock with "
+        "`python -m tools.wirecheck --update` only for deliberate "
+        "removals",
+    ),
+}
+
+#: the committed schema lock at the repo root (tools/jaxlint/rules/ ->
+#: repo); tests monkeypatch-free: they exercise JX304 through the
+#: wirecheck CLI's --lock instead.
+_LOCK_PATH = Path(__file__).resolve().parents[3] / "SCHEMAS.lock.json"
+
+#: JX304 needs the whole repo in view: a partial-root run would read
+#: the lock, miss the producers living in the unanalyzed root, and
+#: report every schema as regressed.
+_REPO_ROOTS = ("yuma_simulation_tpu", "tools", "tests")
+
+
+def _spans_repo(program: Program) -> bool:
+    seen = set()
+    for unit in program.units:
+        posix = Path(unit.path).as_posix()
+        for root in _REPO_ROOTS:
+            if f"{root}/" in posix or posix.startswith(f"{root}/"):
+                seen.add(root)
+    return set(_REPO_ROOTS) <= seen
+
+
+def _locked_schemas() -> dict | None:
+    if not _LOCK_PATH.is_file():
+        return None
+    try:
+        payload = json.loads(_LOCK_PATH.read_text(encoding="utf-8"))
+    except (OSError, ValueError):  # pragma: no cover — unreadable lock
+        return None
+    schemas = payload.get("schemas")
+    return schemas if isinstance(schemas, dict) else None
+
+
+def check(program: Program, add) -> None:
+    # Imported here, not at module top: rules/__init__ imports every
+    # family eagerly, and wirecheck imports jaxlint.program — the lazy
+    # import keeps the package graph acyclic at import time.
+    from tools.wirecheck.extract import extract_index
+    from tools.wirecheck.gates import run_gates
+
+    index = extract_index(program)
+
+    def anchor(line: int):
+        class _A:
+            lineno = line
+            col_offset = 0
+
+        return _A()
+
+    def emit(unit, line, code, message):
+        add(unit, anchor(line), code, message)
+
+    locked = _locked_schemas() if _spans_repo(program) else None
+    run_gates(program, index, emit, locked_schemas=locked)

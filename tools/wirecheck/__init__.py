@@ -1,0 +1,39 @@
+"""wirecheck: whole-program wire/durable-artifact contract analysis.
+
+The serve/fleet/replay tiers communicate through five duck-typed record
+surfaces — ledger JSONL, ``event=`` log lines, lease-annotation
+sidecars, HTTP request/response bodies, and the flight bundle's
+``slo.json``/``numerics.jsonl`` streams — none of which any type
+checker sees: a producer writes a dict literal, a consumer ``.get``s a
+field name, and nothing but a drill that happens to exercise both sides
+notices when the names drift apart. This package rides jaxlint's
+program index (:mod:`tools.jaxlint.program`) to make those shapes a
+checked contract:
+
+- :mod:`tools.wirecheck.extract` indexes every producer (dict literals
+  flowing into ``FailureLedger.append`` / ``log_event`` /
+  ``lease.annotate`` / serve response builders / client request
+  builders / ``sketch_records`` / ``SLOEngine.snapshot``) and every
+  consumer (subscript/``.get`` field reads in the report tools, fleet
+  health, router claim scoring, and client response parsing);
+- :mod:`tools.wirecheck.gates` unifies them into per-artifact-kind
+  field schemas and checks the four wire-contract properties (orphan
+  reads, typed-error totality, lease-annotation closure, additive-only
+  lock evolution) — the same checks jaxlint surfaces as the JX3xx rule
+  family (:mod:`tools.jaxlint.rules.wire`);
+- :mod:`tools.wirecheck.cli` is the ``python -m tools.wirecheck``
+  driver: ``--check`` gates the tree against the committed
+  ``SCHEMAS.lock.json``; ``--update`` is the sanctioned way to evolve
+  the lock (additively) when a record kind legitimately grows.
+
+Stdlib ``ast`` only — like jaxlint, it runs without jax installed.
+"""
+
+from tools.wirecheck.extract import (  # noqa: F401
+    WireIndex,
+    extract_index,
+)
+from tools.wirecheck.gates import (  # noqa: F401
+    lock_diff,
+    schemas_of,
+)

@@ -1,0 +1,7 @@
+"""``python -m tools.wirecheck`` entry point."""
+
+import sys
+
+from tools.wirecheck.cli import main
+
+sys.exit(main())

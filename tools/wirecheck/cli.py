@@ -1,0 +1,227 @@
+"""wirecheck command line: ``python -m tools.wirecheck --check``.
+
+Two verbs over the same whole-program index:
+
+- ``--check`` (default): run the JX3xx wire-contract gates over the
+  analyzed roots, diff the produced schemas against the committed
+  ``SCHEMAS.lock.json``, and exit 1 on any finding — this is the CI
+  gate. A missing lock is a hard error (exit 2): the lock is part of
+  the contract, a clean checkout must carry it.
+- ``--update``: regenerate the lock from the current tree. This is the
+  sanctioned way to evolve a schema; because the gate is additive-only,
+  ``--update`` is routine when a record grows a field and a reviewed
+  act when one disappears (the diff shows up in the lock's git diff).
+
+Exit codes mirror jaxlint: 0 clean, 1 findings (with ``--strict`` also
+unused JX3xx suppressions), 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from tools.jaxlint.analyzer import analyze_units, iter_python_files
+from tools.jaxlint.program import Program, parse_unit
+
+from tools.wirecheck.extract import extract_index
+from tools.wirecheck.gates import lock_diff, schemas_of
+
+#: rules delegated to the jaxlint driver (suppressions, --strict sweep)
+WIRE_RULES = {"JX301", "JX302", "JX303", "JX304"}
+
+DEFAULT_ROOTS = ("yuma_simulation_tpu", "tools", "tests")
+DEFAULT_LOCK = "SCHEMAS.lock.json"
+
+
+def _load_lock(path: Path) -> Optional[dict]:
+    if not path.is_file():
+        return None
+    payload = json.loads(path.read_text(encoding="utf-8"))
+    schemas = payload.get("schemas")
+    if not isinstance(schemas, dict):
+        raise SystemExit(
+            f"wirecheck: malformed lock file {path} (no 'schemas' object)"
+        )
+    return schemas
+
+
+def _write_lock(path: Path, schemas: dict) -> None:
+    payload = {"version": 1, "schemas": schemas}
+    path.write_text(
+        json.dumps(payload, indent=2, sort_keys=True) + "\n",
+        encoding="utf-8",
+    )
+
+
+def _payload(schemas, findings, lock_problems, unused) -> dict:
+    return {
+        "schemas": schemas,
+        "findings": [
+            {
+                "path": f.path,
+                "line": f.line,
+                "code": f.code,
+                "message": f.message,
+            }
+            for f in findings
+        ],
+        "lock_regressions": [
+            {"kind": kind, "key": key, "message": message}
+            for kind, key, message in lock_problems
+        ],
+        "unused_suppressions": [
+            {
+                "path": p,
+                "line": line,
+                "codes": sorted(codes) if codes else None,
+            }
+            for p, line, codes in unused
+        ],
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="wirecheck",
+        description=(
+            "whole-program wire/durable-record contract analyzer "
+            "(ledger events, lease annotations, HTTP payloads, "
+            "slo/numerics telemetry) with an additive-only schema lock"
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=list(DEFAULT_ROOTS),
+        help="roots to analyze (default: %(default)s — partial roots "
+        "weaken the gates, which self-gate on missing evidence)",
+    )
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument(
+        "--check", action="store_true",
+        help="gate the tree against the lock; exit 1 on findings "
+        "(default verb)",
+    )
+    mode.add_argument(
+        "--update", action="store_true",
+        help="regenerate the schema lock from the current tree",
+    )
+    parser.add_argument(
+        "--lock", metavar="PATH", default=DEFAULT_LOCK,
+        help="schema lock file (default: %(default)s)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="print the JSON payload instead of human-readable lines",
+    )
+    parser.add_argument(
+        "--artifact", metavar="PATH",
+        help="also write the JSON payload to PATH (CI artifact)",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="also fail on unused # jaxlint: disable=JX3xx suppressions",
+    )
+    args = parser.parse_args(argv)
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(
+            f"wirecheck: path does not exist: {', '.join(missing)}",
+            file=sys.stderr,
+        )
+        return 2
+
+    units = []
+    for file in iter_python_files(args.paths):
+        units.append(
+            parse_unit(file.read_text(encoding="utf-8"), str(file))
+        )
+    if not units:
+        print(
+            "wirecheck: no python files found under "
+            f"{', '.join(args.paths)}",
+            file=sys.stderr,
+        )
+        return 2
+
+    # The gate pass rides the jaxlint driver so per-line suppressions
+    # (and their --strict staleness sweep) behave identically whether a
+    # finding surfaces via `python -m tools.jaxlint` or here. JX304 is
+    # NOT delegated: the jaxlint family reads the repo-root lock, while
+    # this CLI owns --lock and reports the diff itself below.
+    reports = analyze_units(units, select=WIRE_RULES - {"JX304"})
+    findings = [f for r in reports for f in r.findings]
+    unused = [
+        (r.path, line, codes)
+        for r in reports
+        for line, codes in r.unused_suppressions
+    ]
+
+    schemas = schemas_of(extract_index(Program(units)))
+    lock_path = Path(args.lock)
+
+    if args.update:
+        _write_lock(lock_path, schemas)
+        print(
+            f"wirecheck: wrote {lock_path} "
+            f"({sum(len(v) for v in schemas.values())} record schema(s) "
+            f"across {len(schemas)} kind(s))"
+        )
+        if findings:
+            print(
+                f"wirecheck: note: {len(findings)} contract finding(s) "
+                "remain — --update freezes schemas, it does not waive "
+                "JX301-JX303",
+                file=sys.stderr,
+            )
+        return 0
+
+    locked = _load_lock(lock_path)
+    if locked is None:
+        print(
+            f"wirecheck: lock file {lock_path} not found — run "
+            "`python -m tools.wirecheck --update` and commit it",
+            file=sys.stderr,
+        )
+        return 2
+    lock_problems = lock_diff(schemas, locked)
+
+    payload = _payload(schemas, findings, lock_problems, unused)
+    if args.artifact:
+        with open(args.artifact, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if args.as_json:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        for kind, key, message in lock_problems:
+            print(f"{lock_path}:1:0: JX304 {message}")
+        for p, line, codes in unused:
+            label = ",".join(sorted(codes)) if codes else "all"
+            print(
+                f"{p}:{line}:0: note: unused suppression ({label})"
+                + ("" if args.strict else " [--strict fails on this]")
+            )
+        print(
+            f"wirecheck: {len(findings)} finding(s), "
+            f"{len(lock_problems)} lock regression(s), "
+            f"{len(unused)} unused suppression(s) across "
+            f"{len(units)} file(s)"
+        )
+
+    if findings or lock_problems:
+        return 1
+    if args.strict and unused:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,922 @@
+"""Producer/consumer indexing over jaxlint's program model.
+
+Every cross-process record kind gets one schema key:
+
+=============  =====================  ====================================
+kind           schema key             record surface
+=============  =====================  ====================================
+``ledger``     event name             ``FailureLedger.append`` /
+                                      ``_append_ledger`` JSONL records
+``log``        event name             ``log_event`` key=value lines
+``annotation`` ``"ad"``               lease heartbeat sidecars
+                                      (``lease.annotate`` payloads)
+``response``   ``"body"``             serve HTTP response bodies
+``request``    ``"payload"``          serve HTTP request bodies
+``slo``        ``"snapshot"``         flight bundle ``slo.json``
+``numerics``   ``"record"``           flight bundle ``numerics.jsonl``
+=============  =====================  ====================================
+
+Producers are *literal* writes: dict-literal keys at the emission call
+site, keyword args of ledger appends, ``dict(ad, alive=...)``
+enrichment keywords, ``rec["field"] = ...`` stamp stores. Consumers are
+*literal* reads — ``v.get("field")``, ``v["field"]``, ``"field" in v``
+— attributed to a kind (and, for ledger records, an event) only when
+the variable's provenance is statically clear: the loop/comprehension
+variable of an ``r.get("event") == "name"`` filter, a parameter named
+``ad``/``payload`` in a serve module, an ``X.body`` attribute read.
+Anything dynamic is skipped: under-attribution weakens coverage, never
+invents a finding.
+
+Test files (``tests/``) contribute producers (a drill or test that
+posts a request documents the wire format as much as a client does)
+but never consumers — tests read fields of records they fabricate,
+which would alias fixture shapes into the real schemas.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Iterator, Optional
+
+from tools.jaxlint.model import dotted
+from tools.jaxlint.program import FileUnit, Program
+
+#: Fields the framework stamps onto every ledger/log record at the
+#: emission primitive (``FailureLedger.append`` / ``log_event`` add
+#: ``t`` and the active RunContext identity) — produced for every
+#: event without appearing at any call site.
+LEDGER_AUTO_FIELDS = ("event", "t", "run_id", "span_id", "parent_id")
+LOG_AUTO_FIELDS = ("event", "run_id", "span_id", "parent_id")
+
+#: Call leaves that emit an event-keyed record; the event name is the
+#: first positional arg except for ``log_event(logger, event, ...)``.
+_LEDGER_EMITTERS = {"append", "_append_ledger"}
+_LOG_EMITTERS = {"log_event"}
+
+#: Call leaves whose first dict-literal argument is a serve request
+#: body (client builders, the drill, the router's forward leg).
+_REQUEST_BUILDERS = {"simulate", "sweep", "table", "whatif", "_post"}
+
+#: Client methods that collect ``**kwargs`` into the payload — every
+#: keyword at every call site is a produced payload field. ``whatif``
+#: nests its positional spec under the ``"whatif"`` key, so positional
+#: dicts are NOT top-level fields for these.
+_KWARG_BUILDERS = {"simulate", "sweep", "table", "whatif"}
+
+#: Entry points taking the payload dict itself as a positional arg:
+#: ``client._post(path, {...})``, the service facade's
+#: ``handle(kind, {...})``, and the admission layer's
+#: ``admit({...}, request_id=...)`` (whose keywords are function
+#: params, never payload fields).
+_DICT_BUILDERS = {"_post", "admit", "handle"}
+
+#: Call leaves whose dict-literal args are serve response bodies.
+_RESPONSE_SINKS = {"_send_json", "send_json", "resolve"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Site:
+    """One producer or consumer occurrence."""
+
+    unit: FileUnit
+    line: int
+    #: producer-only: a framework stamp (``annotate`` setdefaults,
+    #: run_id restamps) rather than a caller-advertised field — stamps
+    #: satisfy orphan reads but are exempt from dead-weight checks.
+    stamp: bool = False
+
+    @property
+    def path(self) -> str:
+        return self.unit.path
+
+
+class WireIndex:
+    """producers/consumers: ``(kind, key) -> field -> [Site, ...]``."""
+
+    def __init__(self) -> None:
+        self.producers: dict[tuple[str, str], dict[str, list[Site]]] = {}
+        self.consumers: dict[tuple[str, str], dict[str, list[Site]]] = {}
+
+    def produce(
+        self,
+        kind: str,
+        key: str,
+        field: str,
+        unit: FileUnit,
+        line: int,
+        *,
+        stamp: bool = False,
+    ) -> None:
+        self.producers.setdefault((kind, key), {}).setdefault(
+            field, []
+        ).append(Site(unit, line, stamp))
+
+    def consume(
+        self, kind: str, key: str, field: str, unit: FileUnit, line: int
+    ) -> None:
+        self.consumers.setdefault((kind, key), {}).setdefault(
+            field, []
+        ).append(Site(unit, line))
+
+    def produced_fields(self, kind: str, key: str) -> set[str]:
+        return set(self.producers.get((kind, key), ()))
+
+
+# -- small AST helpers ----------------------------------------------------
+
+
+def _posix(unit: FileUnit) -> str:
+    return Path(unit.path).as_posix()
+
+
+def _is_test_unit(unit: FileUnit) -> bool:
+    p = _posix(unit)
+    return "tests/" in p or Path(p).name.startswith("test_")
+
+
+def _is_serve_unit(unit: FileUnit) -> bool:
+    return "/serve/" in _posix(unit) or "serve/" in _posix(unit)
+
+
+def _call_leaf(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return ""
+
+
+def _literal_names(arg: ast.expr) -> Optional[list[str]]:
+    """A literal event name, or a trace-resolvable choice of two."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.IfExp):
+        a = _literal_names(arg.body)
+        b = _literal_names(arg.orelse)
+        if a is not None and b is not None:
+            return a + b
+    return None
+
+
+def _is_ledger_append(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    recv = (dotted(call.func.value) or "").lower()
+    return "ledger" in recv
+
+
+def _is_lease_receiver(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    recv = (dotted(call.func.value) or "").lower()
+    return "lease" in recv
+
+
+def _dict_literal_keys(node: ast.expr) -> list[tuple[str, int]]:
+    """``(key, lineno)`` for every literal string key of a dict
+    literal (non-literal keys and ``**spread``s are skipped)."""
+    if not isinstance(node, ast.Dict):
+        return []
+    out = []
+    for key in node.keys:
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            out.append((key.value, getattr(key, "lineno", node.lineno)))
+    return out
+
+
+def _read_of(node: ast.expr) -> Optional[tuple[ast.expr, str]]:
+    """``(base, field)`` when ``node`` is a literal field read:
+    ``base.get("f" [, default])`` or ``base["f"]``."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "get"
+        and node.args
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)
+    ):
+        return node.func.value, node.args[0].value
+    if (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.ctx, ast.Load)
+        and isinstance(node.slice, ast.Constant)
+        and isinstance(node.slice.value, str)
+    ):
+        return node.value, node.slice.value
+    return None
+
+
+def _membership_read(node: ast.expr) -> Optional[tuple[ast.expr, str]]:
+    """``(base, field)`` for ``"f" in base`` membership tests."""
+    if (
+        isinstance(node, ast.Compare)
+        and len(node.ops) == 1
+        and isinstance(node.ops[0], ast.In)
+        and isinstance(node.left, ast.Constant)
+        and isinstance(node.left.value, str)
+    ):
+        return node.comparators[0], node.left.value
+    return None
+
+
+def _reads_on_name(tree: ast.AST, names: set[str]) -> Iterator[tuple[str, int]]:
+    """Every literal field read whose base is a bare Name in `names`."""
+    for node in ast.walk(tree):
+        hit = _read_of(node) or _membership_read(node)
+        if hit is None:
+            continue
+        base, field = hit
+        if isinstance(base, ast.Name) and base.id in names:
+            yield field, getattr(node, "lineno", 0)
+
+
+def _functions(tree: ast.Module) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# -- event-scoped ledger-record dataflow ----------------------------------
+
+
+def _event_of_conditions(
+    conditions: list[ast.expr], var: str
+) -> tuple[list[str], list[tuple[str, int]]]:
+    """``(events, extra_reads)`` from a filter like
+    ``r.get("event") == "unit_ok" and r.get("worker")``: the literal
+    event name(s) the filter pins `var`'s records to, plus every other
+    field read on `var` inside the same conditions."""
+    events: list[str] = []
+    leaves: list[ast.expr] = []
+    stack = list(conditions)
+    while stack:
+        cond = stack.pop()
+        if isinstance(cond, ast.BoolOp) and isinstance(cond.op, ast.And):
+            stack.extend(cond.values)
+        else:
+            leaves.append(cond)
+    for leaf in leaves:
+        if (
+            isinstance(leaf, ast.Compare)
+            and len(leaf.ops) == 1
+            and isinstance(leaf.ops[0], (ast.Eq, ast.In))
+        ):
+            read = _read_of(leaf.left)
+            if (
+                read is not None
+                and read[1] == "event"
+                and isinstance(read[0], ast.Name)
+                and read[0].id == var
+            ):
+                comp = leaf.comparators[0]
+                if isinstance(leaf.ops[0], ast.Eq):
+                    names = _literal_names(comp)
+                    if names:
+                        events.extend(names)
+                elif isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    for el in comp.elts:
+                        names = _literal_names(el)
+                        if names:
+                            events.extend(names)
+    extra: list[tuple[str, int]] = []
+    for cond in conditions:
+        for field, line in _reads_on_name(cond, {var}):
+            if field != "event":
+                extra.append((field, line))
+    return events, extra
+
+
+class _LedgerConsumerScanner:
+    """Per-function walk attributing field reads to ledger events.
+
+    Tracks an environment of names statically known to hold records of
+    one event: comprehension results filtered on ``.get("event")``,
+    loop variables inside ``if r.get("event") == ...`` guards, and
+    dicts filled from such variables (the ``last_ok[r["unit"]] = r``
+    idiom). Reads on anything else are ignored.
+    """
+
+    def __init__(self, index: WireIndex, unit: FileUnit) -> None:
+        self.index = index
+        self.unit = unit
+        self.env: dict[str, str] = {}
+
+    def _emit(self, event: str, field: str, line: int) -> None:
+        if field != "event":
+            self.index.consume("ledger", event, field, self.unit, line)
+
+    def _collect_var_reads(
+        self, tree: ast.AST, var: str, event: str
+    ) -> None:
+        for field, line in _reads_on_name(tree, {var}):
+            self._emit(event, field, line)
+
+    def _scan_comprehension(self, node: ast.AST) -> None:
+        if not isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            return
+        gens = node.generators
+        if len(gens) != 1 or not isinstance(gens[0].target, ast.Name):
+            return
+        var = gens[0].target.id
+        events, extra = _event_of_conditions(gens[0].ifs, var)
+        if not events and isinstance(gens[0].iter, ast.Name):
+            bound = self.env.get(gens[0].iter.id)
+            if bound is not None:
+                events = [bound]
+        if not events:
+            return
+        elts: list[ast.AST] = []
+        if isinstance(node, ast.DictComp):
+            elts = [node.key, node.value]
+        else:
+            elts = [node.elt]
+        for event in events:
+            for field, line in extra:
+                self._emit(event, field, line)
+            for elt in elts:
+                self._collect_var_reads(elt, var, event)
+
+    def _bind_target(self, target: ast.expr, event: str) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = event
+
+    def _comprehension_event(self, value: ast.expr) -> Optional[str]:
+        """The single event a comprehension value is filtered to."""
+        if not isinstance(
+            value, (ast.ListComp, ast.SetComp, ast.GeneratorExp)
+        ):
+            return None
+        gens = value.generators
+        if len(gens) != 1 or not isinstance(gens[0].target, ast.Name):
+            return None
+        events, _ = _event_of_conditions(gens[0].ifs, gens[0].target.id)
+        if len(events) == 1:
+            return events[0]
+        return None
+
+    def _iter_event(self, iter_node: ast.expr) -> Optional[str]:
+        """The event a ``for``-loop iterable is bound to: a bound name,
+        or ``bound.values()`` / ``bound.items()``."""
+        if isinstance(iter_node, ast.Name):
+            return self.env.get(iter_node.id)
+        if (
+            isinstance(iter_node, ast.Call)
+            and isinstance(iter_node.func, ast.Attribute)
+            and iter_node.func.attr in ("values", "items")
+            and isinstance(iter_node.func.value, ast.Name)
+        ):
+            return self.env.get(iter_node.func.value.id)
+        return None
+
+    def _scan_scoped_block(
+        self, body: list[ast.stmt], var: str, event: str
+    ) -> None:
+        """Reads on `var` inside a block where it holds `event`
+        records; ``D[...] = var`` stores bind D to the event too."""
+        for stmt in body:
+            for field, line in _reads_on_name(stmt, {var}):
+                self._emit(event, field, line)
+            for node in ast.walk(stmt):
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == var
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript) and isinstance(
+                            tgt.value, ast.Name
+                        ):
+                            self.env[tgt.value.id] = event
+
+    def scan(self, func: ast.FunctionDef) -> None:
+        for stmt in ast.walk(func):
+            if isinstance(stmt, ast.Assign):
+                event = self._comprehension_event(stmt.value)
+                if event is not None:
+                    for tgt in stmt.targets:
+                        self._bind_target(tgt, event)
+                elif isinstance(stmt.value, ast.Name):
+                    bound = self.env.get(stmt.value.id)
+                    if bound is not None:
+                        for tgt in stmt.targets:
+                            self._bind_target(tgt, bound)
+            elif isinstance(stmt, ast.For) and isinstance(
+                stmt.target, ast.Name
+            ):
+                var = stmt.target.id
+                event = self._iter_event(stmt.iter)
+                if event is not None:
+                    self._scan_scoped_block(stmt.body, var, event)
+                else:
+                    # `for r in records: if r.get("event") == ...:`
+                    for inner in ast.walk(stmt):
+                        if not isinstance(inner, ast.If):
+                            continue
+                        events, extra = _event_of_conditions(
+                            [inner.test], var
+                        )
+                        for ev in events:
+                            for field, line in extra:
+                                self._emit(ev, field, line)
+                            self._scan_scoped_block(inner.body, var, ev)
+        for node in ast.walk(func):
+            self._scan_comprehension(node)
+
+
+# -- per-kind extraction passes -------------------------------------------
+
+
+def _extract_event_producers(unit: FileUnit, index: WireIndex) -> None:
+    for call in ast.walk(unit.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        leaf = _call_leaf(call)
+        if leaf in _LEDGER_EMITTERS:
+            if leaf == "append" and not _is_ledger_append(call):
+                continue
+            kind, name_idx, auto = "ledger", 0, LEDGER_AUTO_FIELDS
+        elif leaf in _LOG_EMITTERS:
+            kind, name_idx, auto = "log", 1, LOG_AUTO_FIELDS
+        else:
+            continue
+        if len(call.args) <= name_idx:
+            continue
+        events = _literal_names(call.args[name_idx])
+        if not events:
+            continue
+        fields = [kw.arg for kw in call.keywords if kw.arg is not None]
+        for event in events:
+            for field in fields:
+                index.produce(kind, event, field, unit, call.lineno)
+            for field in auto:
+                index.produce(
+                    kind, event, field, unit, call.lineno, stamp=True
+                )
+
+
+def _returned_dict_keys(func: ast.FunctionDef) -> list[tuple[str, int]]:
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.Return) and node.value is not None:
+            out.extend(_dict_literal_keys(node.value))
+    return out
+
+
+def _extract_annotation(
+    program: Program, unit: FileUnit, index: WireIndex
+) -> None:
+    """Producers: ``lease.annotate(slot, payload)`` payload keys — a
+    dict literal in place, or the returned dict literal of the resolved
+    payload-builder call (``self.advertisement()``); the annotate
+    primitive's own ``setdefault`` stamps; ``dict(ad, alive=...)``
+    enrichment of a read-back ad. Consumers: field reads on ``ad``
+    variables in serve modules."""
+    enclosing_cls: dict[int, Optional[str]] = {}
+
+    def walk_cls(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk_cls(child, child.name)
+            else:
+                enclosing_cls[id(child)] = cls
+                walk_cls(child, cls)
+
+    walk_cls(unit.tree, None)
+
+    def cls_of(call: ast.Call) -> Optional[str]:
+        node: ast.AST = call
+        return enclosing_cls.get(id(node))
+
+    for call in ast.walk(unit.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if _call_leaf(call) != "annotate" or not _is_lease_receiver(call):
+            continue
+        if len(call.args) < 2:
+            continue
+        payload = call.args[1]
+        for field, line in _dict_literal_keys(payload):
+            index.produce("annotation", "ad", field, unit, line)
+        if isinstance(payload, ast.Call):
+            builder = program.resolve_call(unit, payload, cls_of(call))
+            if builder is not None:
+                for field, line in _returned_dict_keys(builder.node):
+                    index.produce(
+                        "annotation", "ad", field, builder.unit, line
+                    )
+        # the annotate primitive's own identity stamps
+        target = program.resolve_call(unit, call, cls_of(call))
+        if target is not None:
+            for node in ast.walk(target.node):
+                if (
+                    isinstance(node, ast.Call)
+                    and _call_leaf(node) == "setdefault"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    index.produce(
+                        "annotation",
+                        "ad",
+                        node.args[0].value,
+                        target.unit,
+                        node.lineno,
+                        stamp=True,
+                    )
+
+    if not _is_serve_unit(unit):
+        return
+    for func in _functions(unit.tree):
+        ad_names = {"ad"}
+        for node in ast.walk(func):
+            # names bound from read_annotation() are ads too
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _call_leaf(node.value) == "read_annotation"
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        ad_names.add(tgt.id)
+            # loop vars over an `ads` collection
+            if (
+                isinstance(node, (ast.For, ast.comprehension))
+                and isinstance(node.target, ast.Name)
+                and isinstance(node.iter, ast.Name)
+                and node.iter.id == "ads"
+            ):
+                ad_names.add(node.target.id)
+        for field, line in _reads_on_name(func, ad_names):
+            index.consume("annotation", "ad", field, unit, line)
+        for node in ast.walk(func):
+            # dict(ad, alive=..., slot=...) enrichment produces fields
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "dict"
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in ad_names
+            ):
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        index.produce(
+                            "annotation", "ad", kw.arg, unit, node.lineno
+                        )
+
+
+def _dict_splat_calls(node: ast.expr) -> list[str]:
+    """Leaf names of ``**call()`` entries in a dict literal — the
+    ``{"status": "ok", **self.replay.timeline_info(n)}`` idiom where
+    most of the body comes from a backend builder."""
+    if not isinstance(node, ast.Dict):
+        return []
+    out = []
+    for key, value in zip(node.keys, node.values):
+        if key is None and isinstance(value, ast.Call):
+            leaf = _call_leaf(value)
+            if leaf:
+                out.append(leaf)
+    return out
+
+
+def _extract_response(
+    unit: FileUnit,
+    index: WireIndex,
+    funcs_by_name: dict,
+) -> None:
+    def produce_builder(leaf: str, seen: set) -> None:
+        """Merge the returned dict-literal keys of every program
+        function with this bare name (duck-typed backend builders like
+        ``timeline_info``/``healthz`` — ``self.X.method`` receivers
+        defeat exact call resolution, so name lookup is the contract)."""
+        if leaf in seen:
+            return
+        seen.add(leaf)
+        for builder_unit, func in funcs_by_name.get(leaf, ()):
+            for field, line in _returned_dict_keys(func):
+                index.produce("response", "body", field, builder_unit, line)
+            for node in ast.walk(func):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    for inner in _dict_splat_calls(node.value):
+                        produce_builder(inner, seen)
+
+    def produce_body(value: ast.expr) -> None:
+        for field, line in _dict_literal_keys(value):
+            index.produce("response", "body", field, unit, line)
+        for leaf in _dict_splat_calls(value):
+            produce_builder(leaf, set())
+        if isinstance(value, ast.Call):
+            leaf = _call_leaf(value)
+            if leaf:
+                produce_builder(leaf, set())
+
+    if _is_serve_unit(unit):
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.Call):
+                if _call_leaf(node) not in _RESPONSE_SINKS:
+                    continue
+                for arg in node.args:
+                    produce_body(arg)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                values = [node.value]
+                if isinstance(node.value, ast.Tuple):
+                    values = list(node.value.elts)
+                for value in values:
+                    produce_body(value)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                # `body = {...}` assembly (coalescer lane slicing, the
+                # service's _execute branches) and `body["k"] = v`
+                # enrichment on the same name
+                tgt = node.targets[0]
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id == "body"
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    produce_body(node.value)
+                elif (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "body"
+                    and isinstance(tgt.slice, ast.Constant)
+                    and isinstance(tgt.slice.value, str)
+                ):
+                    index.produce(
+                        "response",
+                        "body",
+                        tgt.slice.value,
+                        unit,
+                        node.lineno,
+                    )
+    if _is_test_unit(unit):
+        return
+    for node in ast.walk(unit.tree):
+        hit = _read_of(node) or _membership_read(node)
+        if hit is None:
+            continue
+        base, field = hit
+        if isinstance(base, ast.Attribute) and base.attr == "body":
+            index.consume(
+                "response", "body", field, unit, getattr(node, "lineno", 0)
+            )
+
+
+def _extract_request(unit: FileUnit, index: WireIndex) -> None:
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = _call_leaf(node)
+        if leaf in _KWARG_BUILDERS and isinstance(
+            node.func, ast.Attribute
+        ):
+            # client.simulate(case=..., deadline_seconds=...) collects
+            # **kwargs into the payload dict — every keyword at every
+            # call site is a produced payload field
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    index.produce(
+                        "request", "payload", kw.arg, unit, node.lineno
+                    )
+        if leaf in _DICT_BUILDERS:
+            # _post("/path", {...}) / admit({...}, request_id=...) /
+            # handle(kind, {...}): the positional dict IS the payload
+            for arg in node.args:
+                for field, line in _dict_literal_keys(arg):
+                    index.produce("request", "payload", field, unit, line)
+    # one-hop dataflow: dict literals bound to a name that later feeds
+    # a payload entry point — `payload = {...}; svc.handle(k, payload)`
+    # and the test corpus's `for payload in ({...}, {...}): handle(...)`
+    for func in _functions(unit.tree):
+        payload_names: set[str] = set()
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_leaf(node) not in _DICT_BUILDERS:
+                continue
+            for arg in node.args:
+                # handle(kind, dict(payload)) defensive-copy unwrap
+                if (
+                    isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Name)
+                    and arg.func.id == "dict"
+                    and arg.args
+                ):
+                    arg = arg.args[0]
+                if isinstance(arg, ast.Name):
+                    payload_names.add(arg.id)
+        if not payload_names:
+            continue
+        for node in ast.walk(func):
+            values: list[ast.expr] = []
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in payload_names
+            ):
+                values = [node.value]
+            elif (
+                isinstance(node, (ast.For, ast.comprehension))
+                and isinstance(node.target, ast.Name)
+                and node.target.id in payload_names
+                and isinstance(node.iter, (ast.Tuple, ast.List))
+            ):
+                values = list(node.iter.elts)
+            for value in values:
+                for field, line in _dict_literal_keys(value):
+                    index.produce("request", "payload", field, unit, line)
+    if not _is_serve_unit(unit) or _is_test_unit(unit):
+        return
+    for node in ast.walk(unit.tree):
+        # payload.setdefault("tenant", ...) / payload["k"] = ... stamps
+        if (
+            isinstance(node, ast.Call)
+            and _call_leaf(node) == "setdefault"
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "payload"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            index.produce(
+                "request",
+                "payload",
+                node.args[0].value,
+                unit,
+                node.lineno,
+                stamp=True,
+            )
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Subscript)
+            and isinstance(node.targets[0].value, ast.Name)
+            and node.targets[0].value.id == "payload"
+            and isinstance(node.targets[0].slice, ast.Constant)
+            and isinstance(node.targets[0].slice.value, str)
+        ):
+            index.produce(
+                "request",
+                "payload",
+                node.targets[0].slice.value,
+                unit,
+                node.lineno,
+                stamp=True,
+            )
+    for func in _functions(unit.tree):
+        for field, line in _reads_on_name(func, {"payload"}):
+            index.consume("request", "payload", field, unit, line)
+
+
+def _extract_slo(unit: FileUnit, index: WireIndex) -> None:
+    posix = _posix(unit)
+    if "slo" in Path(posix).name and not _is_test_unit(unit):
+        for func in _functions(unit.tree):
+            if func.name == "snapshot":
+                for field, line in _returned_dict_keys(func):
+                    index.produce("slo", "snapshot", field, unit, line)
+    for func in _functions(unit.tree):
+        if func.name == "record_slo":
+            for node in ast.walk(func):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)
+                    and isinstance(node.targets[0].slice, ast.Constant)
+                    and isinstance(node.targets[0].slice.value, str)
+                ):
+                    index.produce(
+                        "slo",
+                        "snapshot",
+                        node.targets[0].slice.value,
+                        unit,
+                        node.lineno,
+                        stamp=True,
+                    )
+    if "sloreport" in posix:
+        for func in _functions(unit.tree):
+            for field, line in _reads_on_name(
+                func, {"snapshot", "snap"}
+            ):
+                index.consume("slo", "snapshot", field, unit, line)
+
+
+def _extract_numerics(unit: FileUnit, index: WireIndex) -> None:
+    posix = _posix(unit)
+    for func in _functions(unit.tree):
+        if func.name == "sketch_records":
+            for node in ast.walk(func):
+                for field, line in _dict_literal_keys(node):
+                    index.produce("numerics", "record", field, unit, line)
+        if func.name in ("record_numerics", "append_numerics"):
+            for node in ast.walk(func):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)
+                    and isinstance(node.targets[0].slice, ast.Constant)
+                    and isinstance(node.targets[0].slice.value, str)
+                ):
+                    index.produce(
+                        "numerics",
+                        "record",
+                        node.targets[0].slice.value,
+                        unit,
+                        node.lineno,
+                        stamp=True,
+                    )
+        # `for rec in sketch_records(...): rec["expected"] = ...`
+        # (the supervisor's accepted-drift stamp on canary records)
+        sketch_bound: set[str] = set()
+        for node in ast.walk(func):
+            src = None
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                src = node.value
+                targets = [
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                ]
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                src = node.iter if isinstance(node.iter, ast.Call) else None
+                targets = (
+                    [node.target.id]
+                    if isinstance(node.target, ast.Name)
+                    else []
+                )
+                if (
+                    isinstance(node.iter, ast.Name)
+                    and node.iter.id in sketch_bound
+                ):
+                    sketch_bound.update(targets)
+                    continue
+            else:
+                continue
+            if (
+                src is not None
+                and isinstance(src, ast.Call)
+                and _call_leaf(src) == "sketch_records"
+            ):
+                sketch_bound.update(targets)
+        if sketch_bound:
+            for node in ast.walk(func):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id in sketch_bound
+                    and isinstance(node.targets[0].slice, ast.Constant)
+                    and isinstance(node.targets[0].slice.value, str)
+                ):
+                    index.produce(
+                        "numerics",
+                        "record",
+                        node.targets[0].slice.value,
+                        unit,
+                        node.lineno,
+                        stamp=True,
+                    )
+    consumer_names = None
+    if "driftreport" in posix:
+        consumer_names = {"rec", "record", "r", "primary", "canary"}
+    elif posix.endswith("telemetry/numerics.py"):
+        consumer_names = {"rec", "primary", "canary"}
+    if consumer_names:
+        for func in _functions(unit.tree):
+            for field, line in _reads_on_name(func, consumer_names):
+                index.consume("numerics", "record", field, unit, line)
+
+
+def extract_index(program: Program) -> WireIndex:
+    """The whole program's producer/consumer index."""
+    index = WireIndex()
+    # bare-name function lookup for response-builder resolution
+    # (``{**self.replay.timeline_info(n)}`` — dotted receivers defeat
+    # exact resolution, the method name is the duck-typed contract)
+    funcs_by_name: dict[str, list] = {}
+    for info in program.functions.values():
+        if _is_test_unit(info.unit):
+            continue
+        funcs_by_name.setdefault(info.node.name, []).append(
+            (info.unit, info.node)
+        )
+    for unit in program.units:
+        if unit.tree is None:
+            continue
+        _extract_event_producers(unit, index)
+        _extract_annotation(program, unit, index)
+        _extract_response(unit, index, funcs_by_name)
+        _extract_request(unit, index)
+        _extract_slo(unit, index)
+        _extract_numerics(unit, index)
+        if not _is_test_unit(unit):
+            for func in _functions(unit.tree):
+                _LedgerConsumerScanner(index, unit).scan(func)
+    return index

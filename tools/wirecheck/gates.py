@@ -1,0 +1,501 @@
+"""The four wire-contract gates over a :class:`~.extract.WireIndex`.
+
+Each gate emits findings through a callback ``add(unit, line, code,
+message)`` so the same logic backs both the jaxlint JX3xx rule family
+(per-line suppressible, ``--strict``-swept) and the ``wirecheck`` CLI.
+Every finding names the other side of the contract — the producer
+chain for an orphan read, the reachability chain for an unmapped typed
+error — because a wire-contract failure is never local to the line it
+anchors on.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Callable, Optional
+
+from tools.jaxlint.model import dotted
+from tools.jaxlint.program import FileUnit, FuncInfo, Program
+
+from tools.wirecheck.extract import (
+    Site,
+    WireIndex,
+    _call_leaf,
+    _is_serve_unit,
+)
+
+AddFn = Callable[[FileUnit, int, str, str], None]
+
+#: kinds whose producer schemas are frozen into SCHEMAS.lock.json.
+LOCKED_KINDS = (
+    "ledger",
+    "log",
+    "annotation",
+    "response",
+    "request",
+    "slo",
+    "numerics",
+)
+
+
+def schemas_of(index: WireIndex) -> dict:
+    """``{kind: {key: sorted field list}}`` of every produced record."""
+    out: dict[str, dict[str, list[str]]] = {}
+    for (kind, key), fields in index.producers.items():
+        out.setdefault(kind, {})[key] = sorted(fields)
+    return {k: dict(sorted(v.items())) for k, v in sorted(out.items())}
+
+
+def _producer_chain(index: WireIndex, kind: str, key: str) -> str:
+    """Human-readable producer chain for a finding message."""
+    sites: dict[str, Site] = {}
+    fields_at: dict[str, list[str]] = {}
+    for field, occurrences in index.producers.get((kind, key), {}).items():
+        for site in occurrences:
+            where = f"{Path(site.path).as_posix()}:{site.line}"
+            sites.setdefault(where, site)
+            fields_at.setdefault(where, []).append(field)
+    parts = []
+    for where in sorted(sites)[:3]:
+        shown = sorted(set(fields_at[where]))
+        listed = ", ".join(shown[:8])
+        if len(shown) > 8:
+            listed += ", ..."
+        parts.append(f"{where} (fields: {listed})")
+    more = max(0, len(sites) - 3)
+    chain = "; ".join(parts)
+    if more:
+        chain += f"; and {more} more site(s)"
+    return chain
+
+
+# -- gate 1: no orphan reads (JX301) --------------------------------------
+
+
+def gate_orphan_reads(index: WireIndex, add: AddFn) -> None:
+    """A field consumed anywhere must have at least one producer.
+
+    Judged per schema key, and only for keys that HAVE producers in the
+    analyzed program — a partial run (one root) that sees consumers but
+    no producers cannot distinguish drift from its own blind spot, so
+    it stays silent rather than guessing."""
+    for (kind, key), fields in sorted(index.consumers.items()):
+        if kind == "annotation":
+            continue  # both directions owned by JX303 (lease closure)
+        produced = index.produced_fields(kind, key)
+        if kind in ("ledger", "log"):
+            # one event stream: log_event and ledger appends share the
+            # event namespace, and report tools read the merged view
+            produced = index.produced_fields(
+                "ledger", key
+            ) | index.produced_fields("log", key)
+        if not produced:
+            continue
+        chain = _producer_chain(index, kind, key)
+        for field, sites in sorted(fields.items()):
+            if field in produced:
+                continue
+            seen: set[tuple[str, int]] = set()
+            for site in sites:
+                anchor = (site.path, site.line)
+                if anchor in seen:
+                    continue
+                seen.add(anchor)
+                add(
+                    site.unit,
+                    site.line,
+                    "JX301",
+                    f"orphan read: field '{field}' of {kind} record "
+                    f"'{key}' is consumed here but no producer ever "
+                    f"writes it — producers of '{key}': {chain}",
+                )
+
+
+# -- gate 2: typed-error totality (JX302) ---------------------------------
+
+
+class _ClassTable:
+    """Leaf-name class hierarchy across the program."""
+
+    def __init__(self, program: Program) -> None:
+        self.bases: dict[str, set[str]] = {}
+        self.defined_at: dict[str, tuple[FileUnit, int]] = {}
+        for unit in program.units:
+            if unit.tree is None:
+                continue
+            for node in ast.walk(unit.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                base_leaves = set()
+                for base in node.bases:
+                    d = dotted(base)
+                    if d:
+                        base_leaves.add(d.rsplit(".", 1)[-1])
+                self.bases.setdefault(node.name, set()).update(base_leaves)
+                self.defined_at.setdefault(node.name, (unit, node.lineno))
+
+    def ancestry(self, name: str) -> set[str]:
+        out: set[str] = set()
+        work = [name]
+        while work:
+            cur = work.pop()
+            if cur in out:
+                continue
+            out.add(cur)
+            work.extend(self.bases.get(cur, ()))
+        return out
+
+
+def _raise_leaf(node: ast.Raise) -> Optional[str]:
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    d = dotted(exc) if exc is not None else None
+    if d:
+        return d.rsplit(".", 1)[-1]
+    return None
+
+
+def _handler_leaves(program: Program) -> set[str]:
+    """Every class leaf named by a typed ``except`` clause in a serve
+    module, with module-level exception tuples (the
+    ``_FORWARD_FAILURES`` idiom) resolved."""
+    tuples: dict[str, set[str]] = {}
+    for unit in program.units:
+        if unit.tree is None or not _is_serve_unit(unit):
+            continue
+        for node in unit.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                leaves = set()
+                for el in node.value.elts:
+                    d = dotted(el)
+                    if d:
+                        leaves.add(d.rsplit(".", 1)[-1])
+                tuples[node.targets[0].id] = leaves
+    handled: set[str] = set()
+    for unit in program.units:
+        if unit.tree is None or not _is_serve_unit(unit):
+            continue
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.ExceptHandler) or node.type is None:
+                continue
+            types = (
+                list(node.type.elts)
+                if isinstance(node.type, (ast.Tuple, ast.List))
+                else [node.type]
+            )
+            for t in types:
+                d = dotted(t)
+                if d is None:
+                    continue
+                leaf = d.rsplit(".", 1)[-1]
+                if isinstance(t, ast.Name) and t.id in tuples:
+                    handled.update(tuples[t.id])
+                else:
+                    handled.add(leaf)
+    return handled
+
+
+def _classify_decisions(
+    program: Program,
+) -> Optional[tuple[set[str], set[str]]]:
+    """``(isinstance_roots, constructed)`` of ``classify_failure``, or
+    None when the program carries no classifier (fixture runs skip the
+    retryability half)."""
+    for unit in program.units:
+        if unit.tree is None:
+            continue
+        for node in ast.walk(unit.tree):
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "classify_failure"
+            ):
+                roots: set[str] = set()
+                constructed: set[str] = set()
+                for inner in ast.walk(node):
+                    if not isinstance(inner, ast.Call):
+                        continue
+                    leaf = _call_leaf(inner)
+                    if leaf == "isinstance" and len(inner.args) == 2:
+                        types = (
+                            list(inner.args[1].elts)
+                            if isinstance(
+                                inner.args[1], (ast.Tuple, ast.List)
+                            )
+                            else [inner.args[1]]
+                        )
+                        for t in types:
+                            d = dotted(t)
+                            if d:
+                                roots.add(d.rsplit(".", 1)[-1])
+                    elif leaf and leaf[0].isupper():
+                        constructed.add(leaf)
+                return roots, constructed
+    return None
+
+
+def _serve_bridges_classifier(program: Program) -> bool:
+    """True when some serve module routes caught exceptions through the
+    shared classifier path (``classify_failure`` /
+    ``_failure_response``) — the design where one broad handler plus
+    the taxonomy IS the HTTP mapping for the whole hierarchy."""
+    for unit in program.units:
+        if unit.tree is None or not _is_serve_unit(unit):
+            continue
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.ExceptHandler):
+                for inner in ast.walk(node):
+                    if isinstance(inner, ast.Call) and _call_leaf(inner) in (
+                        "classify_failure",
+                        "_failure_response",
+                    ):
+                        return True
+    return False
+
+
+def gate_typed_errors(program: Program, add: AddFn) -> None:
+    """Every ``ResilienceError`` subclass raised in a serve-reachable
+    function must map to an HTTP status (a typed serve ``except``
+    naming it or an ancestor, or the shared ``classify_failure`` →
+    ``_failure_response`` bridge) and to a retryability class in
+    ``classify_failure``."""
+    table = _ClassTable(program)
+    if "ResilienceError" not in table.bases:
+        return
+    serve_funcs = [
+        f
+        for f in program.functions.values()
+        if _is_serve_unit(f.unit)
+    ]
+    if not serve_funcs:
+        return
+    resilience = {
+        name
+        for name in table.bases
+        if "ResilienceError" in table.ancestry(name)
+    }
+
+    # serve-reachable closure with one witness chain per function
+    chains: dict[str, list[str]] = {}
+    work: list[FuncInfo] = []
+    for f in serve_funcs:
+        if f.qualname not in chains:
+            chains[f.qualname] = [f.qualname]
+            work.append(f)
+    while work:
+        caller = work.pop()
+        for node in ast.walk(caller.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = program.resolve_call(caller.unit, node, caller.cls)
+            if callee is None or callee.qualname in chains:
+                continue
+            chains[callee.qualname] = chains[caller.qualname] + [
+                callee.qualname
+            ]
+            work.append(callee)
+
+    handled = _handler_leaves(program)
+    bridge = _serve_bridges_classifier(program)
+    decisions = _classify_decisions(program)
+    reported: set[tuple[str, str, int]] = set()
+
+    for qualname, chain in sorted(chains.items()):
+        info = program.functions.get(qualname)
+        if info is None:
+            continue
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Raise):
+                continue
+            leaf = _raise_leaf(node)
+            if leaf is None or leaf not in resilience:
+                continue
+            ancestry = table.ancestry(leaf)
+            http_mapped = bool(ancestry & handled) or bridge
+            classified = True
+            if decisions is not None:
+                roots, constructed = decisions
+                classified = bool(ancestry & roots) or leaf in constructed
+            key = (leaf, info.unit.path, node.lineno)
+            if key in reported:
+                continue
+            via = " -> ".join(chain)
+            if not http_mapped:
+                reported.add(key)
+                add(
+                    info.unit,
+                    node.lineno,
+                    "JX302",
+                    f"typed error '{leaf}' raised here is reachable "
+                    f"from the serve tier (via {via}) but no serve "
+                    "module maps it to an HTTP status: add a typed "
+                    "except handler (or route the path through "
+                    "classify_failure/_failure_response)",
+                )
+            elif not classified:
+                reported.add(key)
+                add(
+                    info.unit,
+                    node.lineno,
+                    "JX302",
+                    f"typed error '{leaf}' raised here is reachable "
+                    f"from the serve tier (via {via}) but "
+                    "classify_failure never assigns it a retryability "
+                    "class: derive it from a classified root "
+                    "(EngineFailure/ResilienceError) or teach the "
+                    "classifier about it",
+                )
+
+
+# -- gate 3: lease-annotation closure (JX303) -----------------------------
+
+
+def gate_lease_closure(index: WireIndex, add: AddFn) -> None:
+    """Every annotation field the router scores must be advertised by
+    the worker heartbeat writer, and every advertised field must be
+    read by some placement/autoscaler consumer — a one-sided field is
+    either a placement decision reading garbage or dead wire weight."""
+    produced = index.producers.get(("annotation", "ad"), {})
+    consumed = index.consumers.get(("annotation", "ad"), {})
+    if not produced or not consumed:
+        return
+    producer_chain = _producer_chain(index, "annotation", "ad")
+    for field, sites in sorted(consumed.items()):
+        if field in produced:
+            continue
+        seen: set[tuple[str, int]] = set()
+        for site in sites:
+            anchor = (site.path, site.line)
+            if anchor in seen:
+                continue
+            seen.add(anchor)
+            add(
+                site.unit,
+                site.line,
+                "JX303",
+                f"claim scoring reads annotation field '{field}' that "
+                "no worker heartbeat ever advertises — the score is "
+                f"computed from a hole; advertised at: {producer_chain}",
+            )
+    consumer_sites = "; ".join(
+        sorted(
+            {
+                f"{Path(s.path).as_posix()}:{s.line}"
+                for sites in consumed.values()
+                for s in sites
+            }
+        )[:3]
+    )
+    for field, sites in sorted(produced.items()):
+        if field in consumed:
+            continue
+        if all(site.stamp for site in sites):
+            continue  # framework identity stamps, not advertised hints
+        seen = set()
+        for site in sites:
+            anchor = (site.path, site.line)
+            if anchor in seen or site.stamp:
+                continue
+            seen.add(anchor)
+            add(
+                site.unit,
+                site.line,
+                "JX303",
+                f"annotation field '{field}' is advertised in every "
+                "heartbeat but no placement consumer ever reads it — "
+                "dead wire weight; consumers read at: "
+                f"{consumer_sites}",
+            )
+
+
+# -- gate 4: additive-only lock evolution (JX304) -------------------------
+
+
+def lock_diff(current: dict, locked: dict) -> list[tuple[str, str, str]]:
+    """``(kind, key, message)`` for every locked schema element the
+    current tree no longer produces. Additions are fine (additive
+    evolution is the contract); removals and renames are findings."""
+    problems: list[tuple[str, str, str]] = []
+    for kind, keys in sorted(locked.items()):
+        current_keys = current.get(kind, {})
+        for key, fields in sorted(keys.items()):
+            if key not in current_keys:
+                problems.append(
+                    (
+                        kind,
+                        key,
+                        f"locked {kind} record '{key}' is no longer "
+                        "produced anywhere: old readers that consume "
+                        "it would silently see nothing — restore the "
+                        "producer, or regenerate the lock with "
+                        "`python -m tools.wirecheck --update` if the "
+                        "removal is deliberate",
+                    )
+                )
+                continue
+            missing = sorted(set(fields) - set(current_keys[key]))
+            for field in missing:
+                problems.append(
+                    (
+                        kind,
+                        key,
+                        f"locked field '{field}' of {kind} record "
+                        f"'{key}' is no longer produced: removing or "
+                        "renaming a locked field breaks old readers — "
+                        "restore it, or regenerate the lock with "
+                        "`python -m tools.wirecheck --update` if the "
+                        "removal is deliberate",
+                    )
+                )
+    return problems
+
+
+def gate_lock(
+    index: WireIndex,
+    locked_schemas: dict,
+    program: Program,
+    add: AddFn,
+) -> None:
+    """JX304: anchor each lock regression on the record's first
+    surviving producer site (or the program's first unit when the
+    whole record vanished)."""
+    current = schemas_of(index)
+    fallback: Optional[FileUnit] = None
+    for unit in sorted(program.units, key=lambda u: u.path):
+        if unit.tree is not None:
+            fallback = unit
+            break
+    for kind, key, message in lock_diff(current, locked_schemas):
+        anchor_unit, anchor_line = fallback, 1
+        sites = [
+            site
+            for fields in index.producers.get((kind, key), {}).values()
+            for site in fields
+        ]
+        if sites:
+            best = min(sites, key=lambda s: (s.path, s.line))
+            anchor_unit, anchor_line = best.unit, best.line
+        if anchor_unit is None:
+            continue
+        add(anchor_unit, anchor_line, "JX304", message)
+
+
+def run_gates(
+    program: Program,
+    index: WireIndex,
+    add: AddFn,
+    *,
+    locked_schemas: Optional[dict] = None,
+) -> None:
+    """All four gates; the lock gate only when a lock is supplied."""
+    gate_orphan_reads(index, add)
+    gate_typed_errors(program, add)
+    gate_lease_closure(index, add)
+    if locked_schemas is not None:
+        gate_lock(index, locked_schemas, program, add)

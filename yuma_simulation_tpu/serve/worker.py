@@ -131,11 +131,15 @@ class ServeWorker:
             "worker_id": self.worker_id,
             "slot": self.slot,
             "url": self.server.url,
-            "pid": os.getpid(),
+            # pid / heartbeat_t / requests_total are operator
+            # forensics (read by humans off the lease file when a slot
+            # wedges), deliberately not placement inputs — reviewed
+            # wirecheck asymmetry, not drift.
+            "pid": os.getpid(),  # jaxlint: disable=JX303
             "started_t": self.started_t,
-            "heartbeat_t": time.time(),
+            "heartbeat_t": time.time(),  # jaxlint: disable=JX303
             "inflight": len(service.queue),
-            "requests_total": int(service._requests_total.value),
+            "requests_total": int(service._requests_total.value),  # jaxlint: disable=JX303
             "held_prefixes": held,
             "warm_buckets": service.warm_buckets(),
             # Cold-start proof for the autoscaler drill: a worker
