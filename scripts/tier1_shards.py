@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Run the tier-1 test suite as K fresh-process pytest shards.
+
+The full suite in ONE process exhausts memory before it finishes: JAX
+compilation caches, the AOT executable cache, and the foundry's
+synthetic metagraphs all accumulate per-process and none of them are
+meant to be evicted mid-run (eviction would invalidate the very
+warm-cache behavior the tests assert). Sharding by test FILE into
+fresh interpreters bounds the peak to the largest shard while keeping
+every test's process-level assumptions (fresh registries, cold caches)
+identical to running its file alone.
+
+Deterministic: files are discovered with ``git ls-files``-independent
+sorted glob and dealt round-robin, so shard membership depends only on
+the checked-in test tree and ``--shards``. Shards run CONCURRENTLY by
+default (``--jobs``, default = all of them): the suite is mostly
+wait-bound — multiprocess batteries, poll loops, lease TTLs — so
+overlapping shards recovers most of that idle time even on one core,
+and the fresh-process split is what bounds memory, not the schedule.
+Each shard's output is buffered and flushed whole, in shard order, so
+the combined log reads exactly like a sequential run (the repo's
+verify line counts progress dots from it). Exit status is the worst
+shard's; a shard whose files are all deselected (pytest exit 5) is not
+a failure.
+
+Usage::
+
+    python scripts/tier1_shards.py [--shards K] [--jobs J] [--pytest-arg ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import re
+import subprocess
+import sys
+import threading
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+#: outcome keys summed across shards from pytest's summary line.
+_SUMMARY_RE = re.compile(
+    r"(\d+) (passed|failed|skipped|errors?|xfailed|xpassed|warnings?)"
+)
+
+
+def discover(tests_dir: pathlib.Path) -> list[pathlib.Path]:
+    return sorted(tests_dir.rglob("test_*.py"))
+
+
+def shard(files: list, shards: int) -> list[list]:
+    out: list[list] = [[] for _ in range(shards)]
+    for i, f in enumerate(files):
+        out[i % shards].append(f)
+    return [s for s in out if s]
+
+
+def _run_shard(group: list, extra: list) -> tuple[int, list[str]]:
+    cmd = [
+        sys.executable, "-m", "pytest",
+        *[str(f) for f in group],
+        "-q", "-m", "not slow",
+        "--continue-on-collection-errors",
+        "-p", "no:cacheprovider",
+        "-p", "no:xdist",
+        "-p", "no:randomly",
+        *extra,
+    ]
+    proc = subprocess.Popen(
+        cmd,
+        cwd=REPO_ROOT,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    assert proc.stdout is not None
+    lines = list(proc.stdout)
+    rc = proc.wait()
+    if rc == 5:
+        rc = 0  # every file in the shard deselected: not a failure
+    return rc, lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument(
+        "--shards", type=int, default=4,
+        help="fresh pytest processes to split the files across",
+    )
+    parser.add_argument(
+        "--jobs", type=int, default=0,
+        help="shards running at once (0 = all; 1 = sequential)",
+    )
+    parser.add_argument(
+        "--tests-dir", default=str(REPO_ROOT / "tests"),
+    )
+    parser.add_argument(
+        "--pytest-arg", action="append", default=[],
+        help="extra argument forwarded to every shard (repeatable)",
+    )
+    args = parser.parse_args(argv)
+
+    files = discover(pathlib.Path(args.tests_dir))
+    if not files:
+        print(f"no test files under {args.tests_dir}", file=sys.stderr)
+        return 2
+    groups = shard(files, max(1, args.shards))
+    jobs = args.jobs if args.jobs > 0 else len(groups)
+    results: list = [None] * len(groups)
+    gate = threading.Semaphore(jobs)
+
+    def worker(i: int) -> None:
+        with gate:
+            results[i] = _run_shard(groups[i], args.pytest_arg)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(len(groups))
+    ]
+    for t in threads:
+        t.start()
+    totals: dict[str, int] = {}
+    worst = 0
+    for i, t in enumerate(threads):
+        t.join()
+        rc, lines = results[i]
+        print(
+            f"--- tier1 shard {i + 1}/{len(groups)} "
+            f"({len(groups[i])} files) ---",
+            flush=True,
+        )
+        for line in lines:
+            print(line, end="")
+            for count, what in _SUMMARY_RE.findall(line):
+                # Summary lines are terminal per shard; the totals line
+                # below re-derives the merged counts from them.
+                if line.strip().endswith(("s", ")")) and " in " in line:
+                    totals[what] = totals.get(what, 0) + int(count)
+        sys.stdout.flush()
+        worst = max(worst, rc)
+    merged = ", ".join(
+        f"{totals[k]} {k}"
+        for k in ("passed", "failed", "skipped", "error", "errors")
+        if k in totals
+    )
+    print(
+        f"=== tier1 shards merged: {merged or 'no summary parsed'} "
+        f"across {len(groups)} shard(s), exit {worst} ===",
+        flush=True,
+    )
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -301,6 +301,7 @@ def test_process_engine_fed_by_supervisor_and_defaults_are_calm():
             "unit_duration",
             "cold_start",
             "engine_drift",  # 0.14.0: the numerics-canary objective
+            "replay_freshness",  # 0.22.0: the replay-controller SLO
         }
         observe_duration("unit_seconds", 0.01)  # the no-plumbing helper
         assert get_slo_engine() is eng

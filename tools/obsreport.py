@@ -177,6 +177,10 @@ def render(bundle, run_id: str | None) -> str:
     if replay:
         lines.append("")
         lines.extend(replay)
+    controller = render_controller(bundle)
+    if controller:
+        lines.append("")
+        lines.extend(controller)
     return "\n".join(lines)
 
 
@@ -222,6 +226,81 @@ def render_replay(bundle) -> list[str]:
             f"  tenant {tenant}: whatifs={t['whatifs']} "
             f"cache_hits={t['hits']} simulated {t['suffix']} of "
             f"{t['full']} epochs ({pct} saved by suffix resume)"
+        )
+    return lines
+
+
+def render_controller(bundle) -> list[str]:
+    """The continuous-replay controller section: sweep/watermark
+    progress and self-healing actions, aggregated from the controller
+    bundle's ``window_swept`` / ``watermark_advanced`` /
+    ``subnet_ingested`` / ``subnet_stalled`` / ``subnet_quarantined``
+    ledger records, cross-read against the freshness gauges and
+    counters of the last metrics snapshot (``replay_staleness_seconds``
+    / ``subnets_live`` / ``windows_swept_total`` /
+    ``snapshots_quarantined_total``)."""
+    swept = [r for r in bundle.ledger if r.get("event") == "window_swept"]
+    advanced = [
+        r for r in bundle.ledger if r.get("event") == "watermark_advanced"
+    ]
+    ingested = [
+        r for r in bundle.ledger if r.get("event") == "subnet_ingested"
+    ]
+    stalled = [
+        r for r in bundle.ledger if r.get("event") == "subnet_stalled"
+    ]
+    quarantined = [
+        r for r in bundle.ledger if r.get("event") == "subnet_quarantined"
+    ]
+    if not (swept or stalled or quarantined or ingested):
+        return []
+    last = bundle.metrics[-1] if bundle.metrics else {}
+    counters = last.get("counters", {})
+    gauges = last.get("gauges", {})
+    lines = ["continuous replay (controller):"]
+    lines.append(
+        f"  windows swept={_num(counters.get('windows_swept_total', len(swept)))} "
+        f"watermark advances={len(advanced)} "
+        f"ingest events={len(ingested)}"
+    )
+    lines.append(
+        f"  freshness: staleness="
+        f"{_num(gauges.get('replay_staleness_seconds', 0))}s "
+        f"live subnets={_num(gauges.get('subnets_live', 0))} "
+        f"stalled={len(stalled)} quarantined="
+        f"{_num(counters.get('snapshots_quarantined_total', len(quarantined)))}"
+    )
+    per_subnet: dict[int, dict] = {}
+    for rec in swept:
+        s = per_subnet.setdefault(
+            int(rec.get("netuid", -1)),
+            {"windows": 0, "epochs": 0, "suffix": 0, "head": 0},
+        )
+        s["windows"] += 1
+        s["suffix"] += int(rec.get("suffix_epochs", 0))
+        s["epochs"] = max(s["epochs"], int(rec.get("total_epochs", 0)))
+        s["head"] = max(s["head"], int(rec.get("block_to", 0)))
+    for netuid, s in sorted(per_subnet.items()):
+        pct = (
+            f"{1 - s['suffix'] / s['epochs']:.0%}"
+            if s["epochs"]
+            else "n/a"
+        )
+        lines.append(
+            f"  subnet {netuid}: windows={s['windows']} head block "
+            f"{s['head']}, simulated {s['suffix']} of {s['epochs']} "
+            f"epochs ({pct} saved by watermark resume)"
+        )
+    for rec in stalled:
+        lines.append(
+            f"  stalled: subnet {rec.get('netuid')} head "
+            f"{rec.get('head_block')} ({rec.get('stalled_seconds')}s "
+            "quiet) -> slow poll tier"
+        )
+    for rec in quarantined:
+        lines.append(
+            f"  quarantined: subnet {rec.get('netuid')} block "
+            f"{rec.get('block')} ({rec.get('reason')})"
         )
     return lines
 

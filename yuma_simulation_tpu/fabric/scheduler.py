@@ -711,6 +711,8 @@ def run_fleet_grid(
     tag: str = "",
     supervisor=None,
     finalize: bool = True,
+    initial_state: Optional[dict] = None,
+    epoch_offset: int = 0,
 ) -> dict:
     """Run a hyperparameter grid (or a Monte-Carlo parameter sample —
     any `axes` value lists, random draws included) as this process's
@@ -732,7 +734,17 @@ def run_fleet_grid(
     Returns ``{"dividends": [P, E, V], "quarantine": QuarantineReport,
     "report": FleetHealthReport, "host": FleetHostSummary, "points":
     [...]}`` once every unit is published. `finalize=False` skips the
-    report publish + collection (drill workers)."""
+    report publish + collection (drill workers).
+
+    `initial_state` / `epoch_offset` (additive) thread the engine's
+    suffix-resume contract through every fleet unit — the continuous
+    replay controller's incremental windows, where each unit simulates
+    only the epochs past a durable watermark from the watermarked
+    carry. The carry's content digest and the offset ride the manifest
+    fingerprint, so every joining host must present the identical
+    resume point (a host with a stale carry fails the manifest check
+    instead of publishing silently different bits). Requires a
+    `supervisor=` built with ``quarantine=False``."""
     import jax
     import jax.numpy as jnp
 
@@ -741,7 +753,10 @@ def run_fleet_grid(
         quarantine_entries,
     )
     from yuma_simulation_tpu.resilience.guards import QuarantineReport
-    from yuma_simulation_tpu.resilience.supervisor import SweepSupervisor
+    from yuma_simulation_tpu.resilience.supervisor import (
+        SweepSupervisor,
+        _state_digest as _supervisor_state_digest,
+    )
 
     if not isinstance(fleet, FleetConfig):
         fleet = FleetConfig(directory=fleet)
@@ -773,12 +788,18 @@ def run_fleet_grid(
             canary_fraction=_fleet_canary_fraction(
                 fleet.canary_fraction, idx
             ),
+            # Suffix-resume units cannot arm the non-finite guard (it
+            # rides a monolithic scan carry) — matching run_grid's own
+            # contract rather than raising three layers down.
+            quarantine=initial_state is None,
         )
         out = sup.run_grid(
             scenario,
             yuma_version,
             unit_cfg,
             tag=f"{tag}:fleetunit{idx}",
+            initial_state=initial_state,
+            epoch_offset=epoch_offset,
         )
         rep = out["report"]
         return {
@@ -812,6 +833,18 @@ def run_fleet_grid(
             "unit_size": fleet.unit_size,
             "axes": axes if axes is not None else "prebuilt-configs",
             "shape": [int(d) for d in np.shape(scenario.weights)],
+            # Additive suffix-resume identity (absent for classic
+            # from-zero grids, keeping existing manifests joinable).
+            **(
+                {
+                    "epoch_offset": int(epoch_offset),
+                    "initial_state": _supervisor_state_digest(
+                        initial_state
+                    ),
+                }
+                if initial_state is not None or epoch_offset
+                else {}
+            ),
         },
         result_keys=("dividends",),
     )

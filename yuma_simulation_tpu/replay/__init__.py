@@ -40,6 +40,15 @@ from yuma_simulation_tpu.replay.statecache import (  # noqa: F401
     StateCacheError,
     baseline_key,
 )
+from yuma_simulation_tpu.replay.controller import (  # noqa: F401
+    ControllerConfig,
+    ControllerError,
+    CycleReport,
+    ReplayController,
+    WatermarkStore,
+    WindowSpec,
+    run_host,
+)
 from yuma_simulation_tpu.replay.sweeper import (  # noqa: F401
     sweep_trailing_window,
     version_slug,
@@ -56,15 +65,22 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "ArchiveError",
     "BaselineMeta",
+    "ControllerConfig",
+    "ControllerError",
+    "CycleReport",
+    "ReplayController",
     "ReplayService",
     "SnapshotArchive",
     "StateCache",
     "StateCacheError",
     "TimelineEntry",
+    "WatermarkStore",
     "WhatIfError",
     "WhatIfResult",
     "WhatIfSpec",
+    "WindowSpec",
     "baseline_key",
+    "run_host",
     "run_whatif",
     "sweep_trailing_window",
     "synthetic_timeline",

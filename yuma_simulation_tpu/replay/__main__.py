@@ -1,4 +1,19 @@
-"""The chain-replay drill: the CI ``replay`` lane's engine.
+"""The chain-replay CLI: one-shot drill, continuous-controller modes,
+and the multi-process chaos soak.
+
+Modes (mutually exclusive):
+
+- ``--drill``      — the CI ``replay`` lane's one-shot engine (below);
+- ``--controller`` — the standing continuous-replay controller
+  (:mod:`.controller`): poll the archive, sweep watermark suffixes
+  forever (or ``--cycles N``);
+- ``--host``       — a helper fleet host joining the controller's
+  in-flight windows through the lease-claim path;
+- ``--writer``     — the synthetic archive feed the soak's chaos rides
+  on (stall + torn-blob injections, :mod:`.soak`);
+- ``--soak``       — the CI ``soak`` lane's engine: writer, controller,
+  and host as real processes, SIGKILLs mid-sweep, and a verdict from
+  the durable artifacts only (:func:`.soak.run_soak`).
 
 ``python -m yuma_simulation_tpu.replay --drill --bundle-dir DIR`` runs
 the whole product loop end to end on CPU, deterministically:
@@ -215,21 +230,125 @@ def run_drill(args) -> int:
     return 1 if failures else 0
 
 
+def run_controller_mode(args) -> int:
+    """The standing controller process (``--controller``): one
+    :class:`.controller.ReplayController` on the shared archive/cache/
+    store, polling until killed (crash-safe by construction — SIGKILL
+    at any instant is the soak's bread and butter) or ``--cycles``
+    elapse. One cycle line per poll on stdout — the soak parses
+    ``shed=`` for the backpressure verdict."""
+    import time
+
+    from yuma_simulation_tpu.replay.archive import SnapshotArchive
+    from yuma_simulation_tpu.replay.controller import (
+        ControllerConfig,
+        ReplayController,
+    )
+    from yuma_simulation_tpu.replay.statecache import StateCache
+    from yuma_simulation_tpu.utils import setup_logging
+
+    setup_logging()
+    controller = ReplayController(
+        SnapshotArchive(args.archive),
+        StateCache(args.cache),
+        ControllerConfig(
+            store_root=args.store,
+            versions=tuple(args.versions),
+            epochs_per_snapshot=args.epochs_per_snapshot,
+            stride=args.stride,
+            unit_size=args.unit_size,
+            poll_seconds=args.poll,
+            slow_poll_seconds=args.slow_poll,
+            stall_deadline_seconds=(
+                args.stall_deadline
+                if args.stall_deadline is not None
+                else 10.0
+            ),
+            freshness_budget_seconds=(
+                args.freshness_budget
+                if args.freshness_budget is not None
+                else 30.0
+            ),
+            max_windows_per_cycle=args.max_windows,
+            lease_ttl_seconds=args.lease_ttl,
+        ),
+    )
+    cycles = 0
+    while args.cycles is None or cycles < args.cycles:
+        report = controller.run_cycle()
+        cycles += 1
+        print(
+            f"cycle={cycles} swept={report.windows_swept} "
+            f"shed={report.windows_shed} "
+            f"stalled={report.subnets_stalled} "
+            f"quarantined={report.snapshots_quarantined} "
+            f"stale={report.max_staleness_seconds:.2f}",
+            flush=True,
+        )
+        time.sleep(args.poll)
+    return 0
+
+
+def run_host_mode(args) -> int:
+    """A helper fleet host process (``--host``) for the controller's
+    in-flight windows."""
+    from yuma_simulation_tpu.replay.archive import SnapshotArchive
+    from yuma_simulation_tpu.replay.controller import run_host
+    from yuma_simulation_tpu.replay.statecache import StateCache
+    from yuma_simulation_tpu.utils import setup_logging
+
+    setup_logging()
+    joined = run_host(
+        SnapshotArchive(args.archive),
+        StateCache(args.cache),
+        args.store,
+        poll_seconds=args.poll,
+        unit_size=args.unit_size,
+        lease_ttl_seconds=args.lease_ttl,
+        max_idle_polls=args.max_idle_polls,
+    )
+    print(f"host joined {joined} window(s)", flush=True)
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m yuma_simulation_tpu.replay",
         description=__doc__.split("\n\n")[0],
     )
-    parser.add_argument(
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument(
         "--drill",
         action="store_true",
         help="run the chain-replay drill (CI smoke; forces the CPU "
         "backend)",
     )
+    mode.add_argument(
+        "--soak",
+        action="store_true",
+        help="run the multi-process continuous-replay chaos soak "
+        "(CI soak lane; forces the CPU backend)",
+    )
+    mode.add_argument(
+        "--controller",
+        action="store_true",
+        help="run the standing continuous-replay controller",
+    )
+    mode.add_argument(
+        "--host",
+        action="store_true",
+        help="run a helper fleet host joining in-flight windows",
+    )
+    mode.add_argument(
+        "--writer",
+        action="store_true",
+        help="run the soak's synthetic archive feed",
+    )
     parser.add_argument(
         "--bundle-dir",
         default="replay-bundle",
-        help="drill output root (archive/, cache/, store/, serve/)",
+        help="drill/soak output root (archive/, cache/, store/, "
+        "serve/)",
     )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--netuid", type=int, default=0)
@@ -250,15 +369,114 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "--versions",
         nargs="+",
         default=list(DRILL_VERSIONS),
-        help="Yuma variants the trailing-window sweep runs",
+        help="Yuma variants to sweep",
     )
+    shared = parser.add_argument_group(
+        "controller/host/writer", "shared directories"
+    )
+    shared.add_argument(
+        "--archive", default=None, help="snapshot archive directory"
+    )
+    shared.add_argument(
+        "--cache", default=None, help="epoch-state cache directory"
+    )
+    shared.add_argument(
+        "--store", default=None,
+        help="controller store root (watermarks, window fleet stores, "
+        "flight bundle)",
+    )
+    ctl = parser.add_argument_group("controller")
+    ctl.add_argument("--poll", type=float, default=0.5)
+    ctl.add_argument("--slow-poll", type=float, default=5.0)
+    # Defaults are per mode (standing controller: 10s/30s; soak: tight
+    # enough that the injected downtime overruns the budget), so None
+    # here means "mode default".
+    ctl.add_argument("--stall-deadline", type=float, default=None)
+    ctl.add_argument("--freshness-budget", type=float, default=None)
+    ctl.add_argument(
+        "--max-windows", type=int, default=None,
+        help="windows swept per cycle before shedding (backpressure)",
+    )
+    ctl.add_argument("--unit-size", type=int, default=8)
+    ctl.add_argument("--lease-ttl", type=float, default=30.0)
+    ctl.add_argument(
+        "--cycles", type=int, default=None,
+        help="stop after N cycles (default: run forever)",
+    )
+    ctl.add_argument(
+        "--max-idle-polls", type=int, default=None,
+        help="host only: exit after N consecutive idle polls",
+    )
+    soak = parser.add_argument_group("writer/soak chaos injections")
+    soak.add_argument(
+        "--subnets", type=int, default=4,
+        help="synthetic subnet count",
+    )
+    soak.add_argument(
+        "--rounds", type=int, default=10,
+        help="final snapshot count per (unstalled) subnet",
+    )
+    soak.add_argument(
+        "--interval", type=float, default=0.8,
+        help="seconds between writer append rounds",
+    )
+    soak.add_argument(
+        "--stall-netuid", type=int, default=-1,
+        help="writer: subnet whose feed goes quiet (soak picks the "
+        "last subnet)",
+    )
+    soak.add_argument(
+        "--stall-after", type=int, default=3,
+        help="snapshot count after which the stalled feed goes quiet",
+    )
+    soak.add_argument(
+        "--corrupt-netuid", type=int, default=1,
+        help="subnet that receives the torn-blob injection",
+    )
+    soak.add_argument(
+        "--corrupt-round", type=int, default=5,
+        help="snapshot index (1-based) published with a torn blob",
+    )
+    soak.add_argument(
+        "--kill-after", type=float, default=4.0,
+        help="soak: seconds before SIGKILLing controller + host",
+    )
+    soak.add_argument(
+        "--downtime", type=float, default=4.0,
+        help="soak: seconds the controller stays dead (freshness debt)",
+    )
+    soak.add_argument("--drain-timeout", type=float, default=300.0)
+    soak.add_argument("--recovery-timeout", type=float, default=180.0)
     args = parser.parse_args(argv)
-    if not args.drill:
-        parser.print_help()
-        return 2
 
     import pathlib
 
+    if args.controller or args.host or args.writer:
+        missing = [
+            flag
+            for flag, value in (
+                ("--archive", args.archive),
+                ("--cache", args.cache),
+                ("--store", args.store),
+            )
+            if value is None and not (args.writer and flag != "--archive")
+        ]
+        if missing:
+            parser.error(
+                f"{' '.join(missing)} required for this mode"
+            )
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        if args.controller:
+            return run_controller_mode(args)
+        if args.host:
+            return run_host_mode(args)
+        from yuma_simulation_tpu.replay.soak import run_writer
+
+        return run_writer(args)
+
+    if not (args.drill or args.soak):
+        parser.print_help()
+        return 2
     target = pathlib.Path(args.bundle_dir)
     if target.exists() and any(target.iterdir()):
         # A resumed drill satisfies sweep units from the prior run's
@@ -271,6 +489,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         return 2
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.soak:
+        from yuma_simulation_tpu.replay.soak import run_soak
+
+        return run_soak(args)
     return run_drill(args)
 
 

@@ -32,13 +32,15 @@ is the chain-replay service's job").
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import io
 import json
 import logging
+import os
 import pathlib
-from typing import Optional, Union
+from typing import Optional, Sequence, Union
 
 import numpy as np
 
@@ -197,15 +199,46 @@ class SnapshotArchive:
 
     # -- append ---------------------------------------------------------
 
+    @contextlib.contextmanager
+    def _append_lock(self, netuid: int):
+        """Serialize the append read-modify-write ACROSS PROCESSES: two
+        racing appenders of different blocks would otherwise both read
+        the same index and the second rename would silently drop the
+        first's entry (lost update). One advisory `flock` per subnet —
+        writers of different subnets never contend, readers never take
+        it (the blob-before-index publish order already guarantees a
+        reader mid-publish sees either the old index or a new entry
+        whose blob exists). Held across the blob AND index publishes so
+        the idempotent-re-append / history-rewrite checks race-free."""
+        import fcntl
+
+        subnet_dir = self._subnet_dir(netuid)
+        subnet_dir.mkdir(parents=True, exist_ok=True)
+        fd = os.open(
+            subnet_dir / ".append.lock", os.O_CREAT | os.O_RDWR, 0o644
+        )
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            # Closing the fd releases the flock.
+            os.close(fd)
+
     def append(self, snap: MetagraphSnapshot) -> TimelineEntry:
         """Append one snapshot to its subnet's timeline under the
         archive contract: strictly monotone block heights, stable
-        [V, M] shape, blob-before-index publish order. Re-appending an
+        [V, M] shape, blob-before-index publish order, one appender at
+        a time per subnet (cross-process advisory lock — racing
+        appenders serialize instead of losing updates). Re-appending an
         identical (block, bytes) snapshot is an idempotent no-op."""
         try:
             _check_snapshot(snap)
         except SnapshotError as exc:
             raise ArchiveError(str(exc)) from None
+        with self._append_lock(snap.netuid):
+            return self._append_locked(snap)
+
+    def _append_locked(self, snap: MetagraphSnapshot) -> TimelineEntry:
         entries = []
         if self._timeline_path(snap.netuid).exists():
             entries = self.timeline(snap.netuid)
@@ -286,6 +319,64 @@ class SnapshotArchive:
             entries = entries[-window:]
         return entries
 
+    def entries_after(self, netuid: int, block: int) -> list[TimelineEntry]:
+        """Timeline entries strictly past ``block`` (oldest first) —
+        the continuous-replay controller's suffix query: everything a
+        durable watermark has not swept yet. Empty list when the
+        timeline has nothing newer (a subnet being fully drained is the
+        steady state, not an error)."""
+        return [e for e in self.timeline(netuid) if e.block > int(block)]
+
+    def scenario_for_blocks(
+        self,
+        netuid: int,
+        blocks: Sequence[int],
+        *,
+        epochs_per_snapshot: int = 4,
+    ) -> Scenario:
+        """Compile an EXPLICIT ascending block list into the
+        epoch-varying scenario (same normalization and epoch layout as
+        :meth:`window_scenario`, which delegates here) — how the
+        controller compiles a watermark-to-head suffix window, and how
+        a joining fleet host reconstructs the identical scenario from a
+        published window spec. The list may skip quarantined blocks:
+        the compiled scenario covers exactly the blocks given, in
+        order."""
+        if epochs_per_snapshot < 1:
+            raise ArchiveError(
+                f"epochs_per_snapshot must be >= 1, got {epochs_per_snapshot}"
+            )
+        blocks = [int(b) for b in blocks]
+        if not blocks:
+            raise ArchiveError(
+                f"subnet {netuid}: cannot compile an empty block list"
+            )
+        if blocks != sorted(set(blocks)):
+            raise ArchiveError(
+                f"subnet {netuid}: block list must be strictly ascending, "
+                f"got {blocks}"
+            )
+        W_parts, S_parts = [], []
+        for block in blocks:
+            snap = self.load(netuid, block)
+            row_sums = snap.weights.sum(axis=1, keepdims=True)
+            W_n = np.divide(
+                snap.weights,
+                row_sums,
+                out=np.zeros_like(snap.weights),
+                where=row_sums > 0,
+            ).astype(np.float32)
+            S_n = (snap.stakes / snap.stakes.sum()).astype(np.float32)
+            W_parts.append(np.tile(W_n[None], (epochs_per_snapshot, 1, 1)))
+            S_parts.append(np.tile(S_n[None], (epochs_per_snapshot, 1)))
+        return self._dense_scenario(
+            netuid,
+            blocks,
+            np.concatenate(W_parts),
+            np.concatenate(S_parts),
+            epochs_per_snapshot,
+        )
+
     def window_scenario(
         self,
         netuid: int,
@@ -300,33 +391,28 @@ class SnapshotArchive:
         The result is a plain dense Scenario, so plans, donor packing,
         numerics capture, and the suffix-resume engine contract apply
         unchanged."""
-        if epochs_per_snapshot < 1:
-            raise ArchiveError(
-                f"epochs_per_snapshot must be >= 1, got {epochs_per_snapshot}"
-            )
         entries = self.window_entries(netuid, window=window)
-        W_parts, S_parts = [], []
-        for entry in entries:
-            snap = self.load(netuid, entry.block)
-            row_sums = snap.weights.sum(axis=1, keepdims=True)
-            W_n = np.divide(
-                snap.weights,
-                row_sums,
-                out=np.zeros_like(snap.weights),
-                where=row_sums > 0,
-            ).astype(np.float32)
-            S_n = (snap.stakes / snap.stakes.sum()).astype(np.float32)
-            W_parts.append(np.tile(W_n[None], (epochs_per_snapshot, 1, 1)))
-            S_parts.append(np.tile(S_n[None], (epochs_per_snapshot, 1)))
-        weights = np.concatenate(W_parts)
-        stakes = np.concatenate(S_parts)
+        return self.scenario_for_blocks(
+            netuid,
+            [e.block for e in entries],
+            epochs_per_snapshot=epochs_per_snapshot,
+        )
+
+    def _dense_scenario(
+        self,
+        netuid: int,
+        blocks: Sequence[int],
+        weights: np.ndarray,
+        stakes: np.ndarray,
+        epochs_per_snapshot: int,
+    ) -> Scenario:
         E, V, M = weights.shape
         validators = [f"uid {v}" for v in range(V)]
         scenario = Scenario(
             name=(
                 f"replay netuid={netuid} blocks "
-                f"{entries[0].block}..{entries[-1].block} "
-                f"({len(entries)} snapshots x {epochs_per_snapshot} epochs)"
+                f"{blocks[0]}..{blocks[-1]} "
+                f"({len(blocks)} snapshots x {epochs_per_snapshot} epochs)"
             ),
             validators=validators,
             base_validator=validators[
@@ -351,10 +437,20 @@ class SnapshotArchive:
         snapshot (or a different window) can never serve a stale
         baseline."""
         entries = self.window_entries(netuid, window=window)
-        h = hashlib.sha256()
-        for e in entries:
-            h.update(f"{e.block}:{e.key}\n".encode())
-        return h.hexdigest()
+        return entries_fingerprint(entries)
+
+
+def entries_fingerprint(entries: Sequence[TimelineEntry]) -> str:
+    """Content address of an explicit entry list — the same hash
+    :meth:`SnapshotArchive.timeline_fingerprint` computes over a
+    trailing window, exposed for the controller's quarantine-filtered
+    and watermark-bounded windows (the state cache keys baselines on
+    exactly the entries a window COMPILED, not the timeline's raw
+    contents)."""
+    h = hashlib.sha256()
+    for e in entries:
+        h.update(f"{e.block}:{e.key}\n".encode())
+    return h.hexdigest()
 
 
 def synthetic_timeline(

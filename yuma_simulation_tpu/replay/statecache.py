@@ -459,8 +459,15 @@ class StateCache:
             dividends.append(result.dividends)
             incentives.append(result.incentives)
             carry = result.final_state
-            if hi < E:
-                states[hi] = carry
+            # Interior boundaries feed what-if suffix resume (meta
+            # .checkpoints); the FINAL carry at E additionally publishes
+            # as a state file so the continuous-replay controller can
+            # extend this baseline incrementally (`extend_baseline`)
+            # without re-simulating the prefix. It is deliberately NOT
+            # listed in meta.checkpoints — a what-if never resumes past
+            # its perturbation epoch, and existing consumers pin the
+            # interior-only tuple.
+            states[hi] = carry
         target = self._dir(key)
         target.mkdir(parents=True, exist_ok=True)
         for epoch, state in states.items():
@@ -483,12 +490,170 @@ class StateCache:
             engine=engine,
             stride=stride,
             dtype=jnp.dtype(dtype).name,
-            checkpoints=tuple(sorted(states)),
+            checkpoints=tuple(sorted(c for c in states if c < E)),
             scenario_fingerprint=scenario_fingerprint,
             scenario_name=scenario.name,
         )
         # Meta LAST: its presence is what marks the baseline published
         # (readers treat a directory without meta.json as absent).
+        publish_atomic(
+            self._meta_path(key),
+            json.dumps(meta.to_json(), sort_keys=True).encode(),
+        )
+        with self._lock:
+            self._touch_locked(key)
+            self._evict_locked()
+        return meta
+
+    def final_state(self, key: str) -> dict:
+        """The carry AFTER a baseline's last epoch (the extension
+        point `extend_baseline` resumes from). Typed
+        :class:`StateCacheError` when the baseline or its final state
+        file is absent — pre-0.22.0 baselines never published one, and
+        the caller's fallback is a full rebuild."""
+        meta = self.meta(key)
+        if meta is None:
+            raise StateCacheError(f"no baseline {key[:16]} to extend")
+        return self.load_state(key, meta.epochs)
+
+    def extend_baseline(
+        self,
+        prior_key: str,
+        suffix_scenario,
+        *,
+        scenario_fingerprint: str,
+        config=None,
+    ) -> BaselineMeta:
+        """Extend a published baseline by `suffix_scenario`'s epochs
+        through the suffix-resume contract: resume from the prior
+        baseline's final carry, simulate ONLY the new epochs (stride
+        segments aligned to the prior baseline's global checkpoint
+        grid), and publish the concatenated trajectory under the NEW
+        content-addressed key — the continuous-replay controller's
+        incremental refresh, bitwise identical to a from-scratch
+        :meth:`build_baseline` of the full extended window (same
+        engine, same stride, same carry-threading contract), at the
+        cost of the suffix alone.
+
+        Idempotent exactly like :meth:`build_baseline` (the key IS the
+        content). Typed :class:`StateCacheError` when the prior
+        baseline, its final state, or its trajectory is unreadable —
+        the caller's fallback is a full rebuild."""
+        import dataclasses as dc
+
+        import jax.numpy as jnp
+
+        from yuma_simulation_tpu.models.config import YumaConfig
+        from yuma_simulation_tpu.simulation.engine import simulate
+
+        prior = self.meta(prior_key)
+        if prior is None:
+            raise StateCacheError(f"no baseline {prior_key[:16]} to extend")
+        config = config if config is not None else YumaConfig()
+        E0 = prior.epochs
+        E_suffix, V, M = np.shape(suffix_scenario.weights)
+        if (V, M) != (prior.validators, prior.miners):
+            raise StateCacheError(
+                f"baseline {prior_key[:16]} is [{prior.validators}, "
+                f"{prior.miners}] but the suffix is [{V}, {M}] — a "
+                "re-shaped subnet starts a new baseline"
+            )
+        E1 = E0 + E_suffix
+        stride = prior.stride
+        key = baseline_key(
+            scenario_fingerprint=scenario_fingerprint,
+            version=prior.version,
+            config=config,
+            dtype=prior.dtype,
+            epochs=E1,
+            stride=stride,
+            engine=prior.engine,
+        )
+        existing = self.meta(key)
+        if existing is not None:
+            with self._lock:
+                self._touch_locked(key)
+            return existing
+
+        carry = self.final_state(prior_key)
+        trajectory = self.load_baseline(prior_key)
+        # Segment bounds continue the GLOBAL stride grid (0, stride,
+        # 2*stride, ...), so the extended baseline's checkpoint set is
+        # exactly what a from-scratch build would have published.
+        bounds = [E0]
+        nxt = (E0 // stride + 1) * stride
+        while nxt < E1:
+            bounds.append(nxt)
+            nxt += stride
+        bounds.append(E1)
+        dividends = [trajectory["dividends"]]
+        incentives = [trajectory["incentives"]]
+        states: dict[int, dict] = {}
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            segment = dc.replace(
+                suffix_scenario,
+                weights=suffix_scenario.weights[lo - E0 : hi - E0],
+                stakes=suffix_scenario.stakes[lo - E0 : hi - E0],
+                num_epochs=hi - lo,
+            )
+            result = simulate(
+                segment,
+                prior.version,
+                config,
+                save_bonds=False,
+                save_incentives=True,
+                epoch_impl=prior.engine,
+                dtype=jnp.dtype(prior.dtype),
+                initial_state=carry,
+                epoch_offset=lo,
+                return_state=True,
+            )
+            dividends.append(result.dividends)
+            incentives.append(result.incentives)
+            carry = result.final_state
+            states[hi] = carry
+        target = self._dir(key)
+        target.mkdir(parents=True, exist_ok=True)
+        # The prior baseline's checkpoints carry over (byte copy — the
+        # carries are the same trajectory's), plus the prior FINAL
+        # state when it lands on the stride grid.
+        inherited = [c for c in prior.checkpoints]
+        if E0 % stride == 0:
+            inherited.append(E0)
+        for epoch in inherited:
+            try:
+                blob = self._state_path(prior_key, epoch).read_bytes()
+            except OSError:
+                continue  # a missing inherited checkpoint narrows resume
+            publish_atomic(self._state_path(key, epoch), blob)
+        for epoch, state in states.items():
+            publish_atomic(
+                self._state_path(key, epoch), serialize_state(state)
+            )
+        buf = io.BytesIO()
+        np.savez(
+            buf,
+            dividends=np.concatenate(dividends),
+            incentives=np.concatenate(incentives),
+        )
+        publish_atomic(self._baseline_path(key), buf.getvalue())
+        checkpoints = sorted(
+            set(c for c in inherited if c < E1)
+            | set(c for c in states if c < E1)
+        )
+        meta = BaselineMeta(
+            key=key,
+            epochs=E1,
+            validators=V,
+            miners=M,
+            version=prior.version,
+            engine=prior.engine,
+            stride=stride,
+            dtype=prior.dtype,
+            checkpoints=tuple(checkpoints),
+            scenario_fingerprint=scenario_fingerprint,
+            scenario_name=suffix_scenario.name,
+        )
         publish_atomic(
             self._meta_path(key),
             json.dumps(meta.to_json(), sort_keys=True).encode(),

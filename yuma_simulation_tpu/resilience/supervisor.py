@@ -566,13 +566,23 @@ class SweepSupervisor:
         configs,
         *,
         tag: str = "",
+        initial_state: Optional[dict] = None,
+        epoch_offset: int = 0,
     ) -> dict:
         """Supervised :func:`..simulation.sweep.sweep_hyperparams` over
         a batched config grid (built with `config_grid`): the grid's
         lanes partition into units exactly like scenarios do, each unit
         re-slicing the batched config pytree (static leaves shared).
         Returns the same `{"dividends", "quarantine", "report"}` shape
-        as :meth:`run_batch`, with lanes = grid points."""
+        as :meth:`run_batch`, with lanes = grid points.
+
+        `initial_state` / `epoch_offset` thread the suffix-resume
+        contract through every unit AND its canary re-execution (the
+        replay controller's incremental windows); requires a supervisor
+        built with ``quarantine=False`` (the guard rides a monolithic
+        carry) and is stamped into the checkpoint fingerprint so a
+        resumed directory can never silently mix a suffix sweep with a
+        from-zero one."""
         import jax
 
         leaves = jax.tree.leaves(configs)
@@ -607,7 +617,9 @@ class SweepSupervisor:
             )
             return self._ladder_dispatch(
                 lambda rung: _grid_on_xla(
-                    scenario, yuma_version, unit_cfg, self.quarantine
+                    scenario, yuma_version, unit_cfg, self.quarantine,
+                    initial_state=initial_state,
+                    epoch_offset=epoch_offset,
                 ),
                 label=f"{tag or 'grid'}:unit{idx}",
                 outcome=outcome,
@@ -624,7 +636,9 @@ class SweepSupervisor:
                 configs,
             )
             return _grid_on_xla(
-                scenario, yuma_version, unit_cfg, self.quarantine
+                scenario, yuma_version, unit_cfg, self.quarantine,
+                initial_state=initial_state,
+                epoch_offset=epoch_offset,
             )
 
         return self._run_units(
@@ -639,6 +653,18 @@ class SweepSupervisor:
                 "version": yuma_version,
                 "num_points": num_points,
                 "unit_size": self.unit_size,
+                # Additive suffix-resume identity (None/0 for classic
+                # from-zero grids, so existing fingerprints are stable):
+                # a resumed checkpoint directory must never satisfy a
+                # suffix sweep's units with a from-zero run's results.
+                **(
+                    {
+                        "epoch_offset": int(epoch_offset),
+                        "initial_state": _state_digest(initial_state),
+                    }
+                    if initial_state is not None or epoch_offset
+                    else {}
+                ),
             },
             cost_request=dict(
                 zip(("epochs", "V", "M"), np.shape(scenario.weights)),
@@ -1392,7 +1418,29 @@ def _batch_on_rung(
         )
 
 
-def _grid_on_xla(scenario, yuma_version, configs, quarantine) -> dict:
+def _state_digest(initial_state) -> Optional[str]:
+    """Content address of a suffix-resume carry (sorted-key canonical
+    npz bytes — the state cache's serialization), for checkpoint and
+    fleet-manifest fingerprints: two hosts joining one suffix sweep
+    must agree on the EXACT carry, not just its shape."""
+    if initial_state is None:
+        return None
+    import hashlib
+
+    from yuma_simulation_tpu.replay.statecache import serialize_state
+
+    return hashlib.sha256(serialize_state(initial_state)).hexdigest()
+
+
+def _grid_on_xla(
+    scenario,
+    yuma_version,
+    configs,
+    quarantine,
+    *,
+    initial_state=None,
+    epoch_offset: int = 0,
+) -> dict:
     """One `sweep_hyperparams` dispatch (grid sweeps have a single-rung
     ladder: the vmap'd XLA engine), blocked to completion."""
     import jax
@@ -1403,6 +1451,11 @@ def _grid_on_xla(scenario, yuma_version, configs, quarantine) -> dict:
     with dispatch_annotation("supervised_grid:xla"):
         return jax.block_until_ready(
             sweep_hyperparams(
-                scenario, yuma_version, configs, quarantine=quarantine
+                scenario,
+                yuma_version,
+                configs,
+                quarantine=quarantine,
+                initial_state=initial_state,
+                epoch_offset=epoch_offset,
             )
         )

@@ -260,6 +260,21 @@ def main(argv=None) -> int:
         help="carry-checkpoint stride (epochs) of cached baselines",
     )
     parser.add_argument(
+        "--replay-controller",
+        default=None,
+        metavar="DIR",
+        help="co-host the continuous replay controller: sweep the "
+        "mounted --replay-archive into this store root (durable "
+        "watermarks, incremental fleet windows) on a background "
+        "thread, keeping the shared --replay-cache warm for what-ifs",
+    )
+    parser.add_argument(
+        "--replay-versions",
+        nargs="+",
+        default=["Yuma 2 (Adrian-Fish)"],
+        help="Yuma variants the co-hosted controller sweeps",
+    )
+    parser.add_argument(
         "--api-keys",
         default=None,
         metavar="PATH",
@@ -398,8 +413,49 @@ def main(argv=None) -> int:
     server = SimulationServer(
         _build_config(args), host=args.host, port=args.port
     )
+    stop = None
+    if args.replay_controller:
+        if not (args.replay_archive and args.replay_cache):
+            parser.error(
+                "--replay-controller requires --replay-archive and "
+                "--replay-cache"
+            )
+        import threading
+
+        from yuma_simulation_tpu.replay import (
+            ControllerConfig,
+            ReplayController,
+            SnapshotArchive,
+            StateCache,
+        )
+
+        # The co-hosted controller sweeps into the SAME cache the
+        # what-if handlers resume from, so serving traffic rides warm
+        # carries the standing sweep keeps extending.
+        controller = ReplayController(
+            SnapshotArchive(args.replay_archive),
+            StateCache(args.replay_cache),
+            ControllerConfig(
+                store_root=args.replay_controller,
+                versions=tuple(args.replay_versions),
+                epochs_per_snapshot=args.replay_epochs_per_snapshot,
+                stride=args.replay_stride,
+            ),
+        )
+        stop = threading.Event()
+        threading.Thread(
+            target=controller.run_forever,
+            kwargs={"stop": stop.is_set},
+            name="replay-controller",
+            daemon=True,
+        ).start()
+        print(f"replay controller sweeping into {args.replay_controller}")
     print(f"serving on {server.url} (Ctrl-C to stop)")
-    server.serve_forever()
+    try:
+        server.serve_forever()
+    finally:
+        if stop is not None:
+            stop.set()
     return 0
 
 

@@ -419,6 +419,8 @@ def sweep_hyperparams(
     save_bonds: bool = False,
     quarantine: bool = False,
     dtype=jnp.float32,
+    initial_state: Optional[dict] = None,
+    epoch_offset: int = 0,
 ):
     """`vmap` one scenario over a batched config pytree (stacked float
     leaves, shared static fields). Build `configs` with :func:`config_grid`.
@@ -430,7 +432,19 @@ def sweep_hyperparams(
     while every other grid point is fine): the bad lane is masked and
     recorded in `ys["quarantine"]`, the rest of the grid returns
     bit-for-bit the unguarded values.
-    """
+
+    `initial_state` / `epoch_offset` (additive — the suffix-resume
+    contract, extended to the grid path for the continuous-replay
+    controller's incremental windows): resume every lane from ONE
+    shared carry (the ``final_state`` of a prior ``return_state=True``
+    run over the same config), with the scenario's epochs indexed as
+    global epochs ``[offset, offset + E)``. The carry is broadcast
+    across lanes, so the prefix-equals-carry precondition only holds
+    for lanes whose config matches the carry's producer — a one-point
+    grid (the replay controller's window unit), or a grid whose prior
+    window genuinely ran all lanes on the shared baseline config.
+    Incompatible with `quarantine` (the non-finite guard rides a
+    monolithic scan carry)."""
     spec = variant_for_version(yuma_version)
     W = jnp.asarray(scenario.weights, dtype)
     S = jnp.asarray(scenario.stakes, dtype)
@@ -442,6 +456,23 @@ def sweep_hyperparams(
         -1 if scenario.reset_bonds_epoch is None else scenario.reset_bonds_epoch,
         jnp.int32,
     )
+    carry = None
+    if initial_state is not None:
+        if quarantine:
+            raise ValueError(
+                "sweep_hyperparams: initial_state does not compose with "
+                "quarantine (the guard rides a monolithic scan carry); "
+                "pass quarantine=False for suffix-resume grid units"
+            )
+        from yuma_simulation_tpu.simulation.engine import (
+            validate_initial_state,
+        )
+
+        _, V, M = np.shape(scenario.weights)
+        validate_initial_state(initial_state, spec, V, M)
+        carry = {
+            k: jnp.asarray(v, dtype) for k, v in initial_state.items()
+        }
     from yuma_simulation_tpu.telemetry.numerics import numerics_enabled
 
     fn = lambda cfg: _simulate_scan(  # noqa: E731
@@ -456,6 +487,8 @@ def sweep_hyperparams(
         save_consensus=False,
         guard_nonfinite=quarantine,
         capture_numerics=numerics_enabled(),
+        carry=carry,
+        epoch_offset=epoch_offset,
     )
     return jax.vmap(fn)(configs)
 

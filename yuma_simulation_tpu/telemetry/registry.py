@@ -371,6 +371,34 @@ EVENTS = {
         operator_reason="typed miss taxonomy on the log stream; the "
         "state_cache_misses counter is the reconciled aggregate",
     ),
+    # -- continuous replay controller (replay.controller) ----------------
+    "subnet_ingested": EventSpec(
+        "the controller observed fresh archive entries for a subnet "
+        "(record carries netuid, new blocks, latest block)",
+        consumers=("obsreport",),
+    ),
+    "window_swept": EventSpec(
+        "one incremental (subnet x variant) window swept, published and "
+        "baseline-extended (record carries netuid, version, block span, "
+        "epoch span, suffix vs full epochs)",
+        consumers=("obsreport",),
+    ),
+    "watermark_advanced": EventSpec(
+        "a durable per-(subnet x variant) watermark moved forward after "
+        "a window's fleet results published (the at-least-once sweep / "
+        "exactly-once publication commit point)",
+        consumers=("obsreport",),
+    ),
+    "subnet_stalled": EventSpec(
+        "a subnet's archive stopped appending past the stall deadline; "
+        "the controller demoted it to the slow poll tier",
+        consumers=("obsreport",),
+    ),
+    "subnet_quarantined": EventSpec(
+        "a corrupt or truncated snapshot blob was quarantined (typed "
+        "reason; the entry is excluded and the subnet keeps draining)",
+        consumers=("obsreport",),
+    ),
 }
 
 
@@ -521,6 +549,26 @@ METRICS = {
     "replay_suffix_epochs_saved": MetricSpec(
         "counter", "epochs cached carries let what-ifs skip "
         "re-simulating (suffix-vs-full savings)",
+        consumers=("obsreport",),
+    ),
+    # -- continuous replay controller (replay.controller) ----------------
+    "replay_staleness_seconds": MetricSpec(
+        "gauge", "per-cycle worst-case age of the oldest unswept "
+        "archive suffix across live subnets (freshness SLO input)",
+        consumers=("obsreport",),
+    ),
+    "subnets_live": MetricSpec(
+        "gauge", "subnets on the fast poll tier (not stalled)",
+        consumers=("obsreport",),
+    ),
+    "windows_swept_total": MetricSpec(
+        "counter", "incremental (subnet x variant) windows published by "
+        "the continuous replay controller",
+        consumers=("obsreport",),
+    ),
+    "snapshots_quarantined_total": MetricSpec(
+        "counter", "corrupt/truncated snapshot blobs quarantined by the "
+        "controller",
         consumers=("obsreport",),
     ),
     # -- SLO engine ------------------------------------------------------

@@ -590,6 +590,33 @@ DEFAULT_SLO_SPECS = (
         min_events=1,
         degrade=True,
     ),
+    # The continuous-replay freshness objective (0.22.0): each controller
+    # cycle feeds one good/bad verdict per live subnet — good iff the
+    # subnet's oldest unswept archive suffix is younger than the
+    # controller's freshness budget (`replay_staleness_seconds` is the
+    # gauge twin). A killed controller or a wedged fleet host turns the
+    # stream bad within one poll interval, fast-burns, and recovers once
+    # restarted sweeps drain the backlog; `degrade=True` lets the serve
+    # tier shed low-priority what-ifs while the burn is active
+    # (backpressure: capacity goes to catching the replay tail up).
+    # Burn thresholds are scaled to the 0.95 objective (budget 0.05):
+    # the SRE-canon 14.4x would need a >144% bad fraction — impossible
+    # — so fast burn fires at 10x (>=50% of live subnets stale, e.g.
+    # every subnet after a controller kill) and slow at 4x (>=20%
+    # persistently stale — a shed tier that never catches up).
+    SLOSpec(
+        "replay_freshness",
+        objective=0.95,
+        description="live subnets whose unswept archive suffix is "
+        "younger than the controller's freshness budget",
+        event="replay_fresh",
+        fast_window_seconds=60.0,
+        fast_burn_threshold=10.0,
+        slow_window_seconds=600.0,
+        slow_burn_threshold=4.0,
+        min_events=5,
+        degrade=True,
+    ),
 )
 
 _ENGINE: Optional[SLOEngine] = None
