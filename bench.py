@@ -675,6 +675,63 @@ def _bench(args) -> None:
         ),
     }
 
+    # Dispatch-sketch overhead (0.23.0): the always-on per-dispatch
+    # LatencySketch observation lives on the host side of EVERY
+    # `simulate()` call (telemetry.slo.observe_dispatch — one O(1)
+    # table update per dispatched region). This times the full
+    # simulate() path — plan, ladder, dispatch, the seam itself — with
+    # the observation ON vs OFF over the same small workload, so the
+    # seam's cost is a tracked number, not an assumption; perfgate
+    # gates `dispatch_sketch.overhead_frac` under the same < 5% bar as
+    # the numerics capture.
+    from yuma_simulation_tpu.scenarios.base import Scenario
+    from yuma_simulation_tpu.simulation.engine import simulate
+    from yuma_simulation_tpu.telemetry.slo import set_dispatch_observation
+
+    sk_E, sk_V, sk_M = 64, 64, 256
+    sk_validators = [f"sv{i}" for i in range(sk_V)]
+    sk_rng = np.random.default_rng(23)
+    sk_scenario = Scenario(
+        name="dispatch_sketch_overhead",
+        validators=sk_validators,
+        base_validator=sk_validators[0],
+        weights=sk_rng.random((sk_E, sk_V, sk_M)).astype(np.float32),
+        stakes=np.ones((sk_E, sk_V), np.float32),
+        num_epochs=sk_E,
+    )
+
+    def _sketch_runs(enabled):
+        def run(n):
+            prev = set_dispatch_observation(enabled)
+            try:
+                out = None
+                for _ in range(max(1, n // sk_E)):
+                    out = simulate(sk_scenario, "Yuma 1 (paper)")
+                return out.dividends
+            finally:
+                set_dispatch_observation(prev)
+
+        return run
+
+    sketch_off = _time_best(
+        _sketch_runs(False), sk_E, granularity=sk_E,
+        label="dispatch_sketch_off",
+    )
+    sketch_on = _time_best(
+        _sketch_runs(True), sk_E, granularity=sk_E,
+        label="dispatch_sketch_on",
+    )
+    secondary["dispatch_sketch_off"] = round(sketch_off, 1)
+    secondary["dispatch_sketch_on"] = round(sketch_on, 1)
+    dispatch_sketch = {
+        "workload": f"simulate() {sk_V}v x {sk_M}m, E={sk_E}",
+        "epochs_per_sec_off": round(sketch_off, 1),
+        "epochs_per_sec_on": round(sketch_on, 1),
+        "overhead_frac": (
+            round(1.0 - sketch_on / sketch_off, 4) if sketch_off else None
+        ),
+    }
+
     # DOUBLE-BUFFERED chunked streaming: the beyond-HBM workload shape —
     # a 10k-epoch [E, V, M] stack would be ~41 GiB, so only ~2 slabs may
     # be live at a time. simulate_streamed now overlaps slab k+1's
@@ -866,7 +923,7 @@ def _bench(args) -> None:
         _append_history(line, primary_impl, primary, smoke=args.smoke,
                         skip_costs=args.skip_costs, history=args.history,
                         numerics=numerics_overhead, cold_start=cold_start,
-                        whatif=whatif)
+                        whatif=whatif, dispatch_sketch=dispatch_sketch)
 
 
 def _append_history(
@@ -880,6 +937,7 @@ def _append_history(
     numerics: Optional[dict] = None,
     cold_start: Optional[dict] = None,
     whatif: Optional[dict] = None,
+    dispatch_sketch: Optional[dict] = None,
 ) -> dict:
     """One richer record per run into the JSONL history perfgate gates
     on: the stdout fields + per-metric dispersion + the AOT cost report
@@ -940,6 +998,10 @@ def _append_history(
         # What-if suffix-resume speedup (cached carry vs full re-sim)
         # — a tracked, perfgate-gated metric (ISSUE 14).
         "whatif": whatif if whatif is not None else {},
+        # Dispatch-sketch observation overhead (seam on vs off over the
+        # same simulate() workload) — a tracked, perfgate-gated metric
+        # (ISSUE 19, continuous telemetry).
+        "dispatch_sketch": dispatch_sketch if dispatch_sketch is not None else {},
         # Declared floors for perfgate's attained-fraction gate: the
         # distance-to-ceiling itself is gated, not just absolute rates.
         "attained_floor": dict(ATTAINED_FLOORS),

@@ -1,0 +1,539 @@
+"""Continuous telemetry plane (ISSUE 19): rotating flight segments,
+the live ops plane, on-demand profiling, and roofline-gap attribution.
+
+The contract under test: under a RotationPolicy the span/metrics/
+numerics streams append O(batch) into crash-safe size/age-bounded
+segments (SIGKILL mid-append loses at most a torn tail; the tolerant
+readers and the restarted writer both recover), retention NEVER
+reclaims a segment an open run touched, `load_bundle` reads segmented
+and monolithic layouts identically, the /debug/profile latch is
+single-flight with a hard auto-stop deadline, and tools/perfattrib
+either attributes every engine rung to its roofline or types the
+reason it cannot."""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import pathlib
+
+import numpy as np
+import pytest
+
+from yuma_simulation_tpu.telemetry import (
+    MetricsRegistry,
+    RunContext,
+    check_bundle,
+    load_bundle,
+    span,
+)
+from yuma_simulation_tpu.telemetry.flight import (
+    COMPACTED_NAME,
+    FlightRecorder,
+    RotationPolicy,
+    SEAL_NAME,
+    SEGMENT_PREFIX,
+    SEGMENTS_DIR,
+)
+from yuma_simulation_tpu.telemetry.ops import (
+    OpsPlane,
+    ProfileBusyError,
+    ProfileSession,
+)
+from yuma_simulation_tpu.telemetry.slo import (
+    DispatchStats,
+    LatencySketch,
+    get_dispatch_stats,
+    observe_dispatch,
+    set_dispatch_observation,
+)
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+#: Age trigger disabled: every test below drives rotation by size.
+SMALL = RotationPolicy(
+    max_segment_bytes=512, max_segment_age_seconds=0.0
+)
+
+
+def _numerics_batch(run_id: str, n: int = 4) -> list:
+    # well-formed numerics records (check_bundle validates them), with
+    # `unit` — part of numerics_identity — distinct per record so the
+    # loader's newest-wins dedupe keeps them all
+    return [
+        {"run_id": run_id, "unit": f"{run_id}:{i}", "stream": "dividends",
+         "engine": "xla", "role": "primary", "epochs": 2,
+         "fingerprint": [[i, i + 1]], "absmax": 1.0 + i}
+        for i in range(n)
+    ]
+
+
+def _sealed_segments(directory) -> list:
+    root = pathlib.Path(directory) / SEGMENTS_DIR
+    if not root.is_dir():
+        return []
+    return sorted(
+        p
+        for p in root.iterdir()
+        if p.name.startswith(SEGMENT_PREFIX) and (p / SEAL_NAME).exists()
+    )
+
+
+# ------------------------------------------------------------- rotation
+
+
+def test_rotation_seals_on_size_and_bundle_reads_across_segments(tmp_path):
+    rec = FlightRecorder(tmp_path, rotation=SMALL)
+    for i in range(20):
+        rec.append_numerics(_numerics_batch(f"run-{i}"))
+    sealed = _sealed_segments(tmp_path)
+    assert len(sealed) >= 2, "512-byte bound never tripped"
+    for seg in sealed:
+        seal = json.loads((seg / SEAL_NAME).read_text())
+        assert seal["event"] == "segment_sealed"
+        assert seal["segment"] == seg.name
+        assert seal["bytes"] > 0
+        assert isinstance(seal["run_ids"], list) and seal["run_ids"]
+    # the loader stitches every segment back into one stream
+    bundle = load_bundle(tmp_path)
+    assert len(bundle.numerics) == 20 * 4
+    assert {n["run_id"] for n in bundle.numerics} == {
+        f"run-{i}" for i in range(20)
+    }
+    assert [s["segment"] for s in bundle.segments if s.get("event") ==
+            "segment_sealed"] == [s.name for s in sealed]
+
+
+def test_segmented_and_monolithic_bundles_read_identically(tmp_path):
+    mono_dir, seg_dir = tmp_path / "mono", tmp_path / "seg"
+    for directory, rotation in ((mono_dir, None), (seg_dir, SMALL)):
+        reg = MetricsRegistry()
+        reg.counter("requests_total").inc(3)
+        rec = FlightRecorder(directory, rotation=rotation)
+        with RunContext("run-io") as run:
+            with span("outer"):
+                with span("inner"):
+                    pass
+            rec.record(run, registry=reg)
+        rec.append_numerics(_numerics_batch("run-io"))
+
+    mono, seg = load_bundle(mono_dir), load_bundle(seg_dir)
+    assert check_bundle(mono) == []
+    assert check_bundle(seg) == []
+
+    def canon(records, keys):
+        return sorted(
+            tuple(r.get(k) for k in keys) for r in records
+        )
+
+    span_keys = ("run_id", "span_id", "name", "status")
+    assert canon(mono.spans, span_keys) == canon(seg.spans, span_keys)
+    num_keys = ("run_id", "epoch", "absmax")
+    assert canon(mono.numerics, num_keys) == canon(seg.numerics, num_keys)
+    assert [m["counters"] for m in mono.metrics] == [
+        m["counters"] for m in seg.metrics
+    ]
+
+
+def test_rotation_default_off_keeps_monolithic_layout(tmp_path, monkeypatch):
+    monkeypatch.delenv("YUMA_TPU_FLIGHT_ROTATE", raising=False)
+    rec = FlightRecorder(tmp_path)
+    assert rec.rotation is None
+    rec.append_numerics(_numerics_batch("run-legacy"))
+    assert (tmp_path / "numerics.jsonl").exists()
+    assert not (tmp_path / SEGMENTS_DIR).exists()
+
+
+def test_rotation_env_opt_in(tmp_path, monkeypatch):
+    monkeypatch.setenv("YUMA_TPU_FLIGHT_ROTATE", "1")
+    assert FlightRecorder(tmp_path).rotation == RotationPolicy()
+    monkeypatch.setenv("YUMA_TPU_FLIGHT_ROTATE", "off")
+    assert FlightRecorder(tmp_path).rotation is None
+
+
+# ------------------------------------------------- crash-safety (SIGKILL)
+
+_KILL_CHILD = r"""
+import sys
+from yuma_simulation_tpu.telemetry.flight import FlightRecorder, RotationPolicy
+
+rec = FlightRecorder(
+    sys.argv[1],
+    rotation=RotationPolicy(max_segment_bytes=512,
+                            max_segment_age_seconds=0.0),
+)
+print("ready", flush=True)
+i = 0
+while True:
+    rec.append_numerics(
+        [{"run_id": f"child-{i}", "epoch": e} for e in range(4)]
+    )
+    i += 1
+"""
+
+
+def test_sigkill_mid_rotation_recovers(tmp_path):
+    """SIGKILL a writer mid-append: the tolerant readers shrug off the
+    torn tail, and a fresh recorder continues the predecessor's live
+    segment instead of stranding it."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _KILL_CHILD, str(tmp_path)],
+        stdout=subprocess.PIPE,
+        cwd=REPO_ROOT,
+        env=env,
+    )
+    try:
+        assert proc.stdout.readline().strip() == b"ready"
+        deadline = time.time() + 30.0
+        while not _sealed_segments(tmp_path) and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+    sealed_before = _sealed_segments(tmp_path)
+    assert sealed_before, "child never sealed a segment before the kill"
+
+    # an explicitly torn tail on the live segment must not break readers
+    rec = FlightRecorder(tmp_path, rotation=SMALL)
+    live = rec.live_segment()
+    with open(live / "numerics.jsonl", "ab") as fh:
+        fh.write(b'{"run_id": "torn')
+    bundle = load_bundle(tmp_path)
+    assert any(n["run_id"].startswith("child-") for n in bundle.numerics)
+
+    # the restarted writer continues exactly where the victim stopped
+    before = len(bundle.numerics)
+    rec.append_numerics(_numerics_batch("survivor"))
+    rec.seal_live_segment()
+    bundle = load_bundle(tmp_path)
+    assert len(bundle.numerics) == before + 4
+    assert set(s.name for s in sealed_before) < {
+        s.name for s in _sealed_segments(tmp_path)
+    }
+
+
+# ------------------------------------------------------------- retention
+
+
+def test_retention_never_deletes_open_run_segment(tmp_path):
+    policy = RotationPolicy(
+        max_segment_bytes=256,
+        max_segment_age_seconds=0.0,
+        max_retained_bytes=1,  # reclaim everything reclaimable
+        min_retained_segments=0,
+    )
+    rec = FlightRecorder(tmp_path, rotation=policy)
+    rec.mark_run_open("pinned")
+    rec.append_numerics(_numerics_batch("pinned"))
+    rec.seal_live_segment()
+    pinned_seg = _sealed_segments(tmp_path)[-1].name
+    for i in range(4):
+        rec.append_numerics(_numerics_batch(f"bulk-{i}"))
+        rec.seal_live_segment()
+
+    names = {s.name for s in _sealed_segments(tmp_path)}
+    assert pinned_seg in names, "retention reclaimed an open run's segment"
+    tombstone = json.loads((tmp_path / COMPACTED_NAME).read_text())
+    assert tombstone["event"] == "segments_compacted"
+    assert tombstone["segments"] >= 1
+    assert tombstone["bytes"] > 0
+    assert "pinned" not in tombstone["run_ids"]
+    # the pinned run's records are still readable
+    assert any(
+        n["run_id"] == "pinned" for n in load_bundle(tmp_path).numerics
+    )
+
+    # closing the run releases the pin: the next pass reclaims it
+    rec.mark_run_closed("pinned")
+    rec.append_numerics(_numerics_batch("after-close"))
+    rec.seal_live_segment()
+    assert pinned_seg not in {s.name for s in _sealed_segments(tmp_path)}
+    tombstone = json.loads((tmp_path / COMPACTED_NAME).read_text())
+    assert "pinned" in tombstone["run_ids"]
+
+
+# ------------------------------------------------------- O(batch) flush
+
+
+def test_flush_cost_stays_o_batch_under_rotation(tmp_path):
+    """Soak-length proof that a long-lived server's periodic flush does
+    not degrade as history accumulates: under rotation each flush
+    touches ONLY the live segment, so (a) the bytes any flush rewrites
+    stay bounded by the rotation policy however many flushes came
+    before, and (b) late flushes are not slower than early ones."""
+    rec = FlightRecorder(
+        tmp_path,
+        rotation=RotationPolicy(
+            max_segment_bytes=4096, max_segment_age_seconds=0.0
+        ),
+    )
+    rounds, batch = 300, 4
+    durations = []
+    for i in range(rounds):
+        t0 = time.perf_counter()
+        rec.append_numerics(_numerics_batch(f"soak-{i}", batch))
+        durations.append(time.perf_counter() - t0)
+        live_bytes = rec._segment_bytes(rec.live_segment())
+        assert live_bytes < 4096 + 2048, (
+            f"flush {i}: live segment grew past the rotation bound "
+            f"({live_bytes} bytes) — flush cost is no longer O(batch)"
+        )
+    assert len(_sealed_segments(tmp_path)) >= 2
+    early = sorted(durations[:50])[25]
+    late = sorted(durations[-50:])[25]
+    # generous: the medians must stay the same order of magnitude (a
+    # whole-file merge republish would be ~60x by the last round)
+    assert late < max(early, 1e-4) * 10, (
+        f"flush latency grew {late / early:.1f}x over {rounds} rounds"
+    )
+    assert len(load_bundle(tmp_path).numerics) == rounds * batch
+
+
+# ----------------------------------------------------- dispatch sketches
+
+
+def test_dispatch_stats_snapshot_shape_and_merge():
+    stats = DispatchStats()
+    for seconds in (0.01, 0.02, 0.04):
+        stats.observe(
+            engine="xla", bucket="b256", backend="cpu",
+            seconds=seconds, epochs=64,
+        )
+    snap = stats.snapshot()
+    key = DispatchStats.key_for("xla", "b256", "cpu")
+    assert set(snap) == {key}
+    entry = snap[key]
+    assert entry["dispatches"] == 3
+    assert entry["epochs_total"] == 192
+    assert entry["seconds_total"] == pytest.approx(0.07, abs=1e-6)
+    sketch = LatencySketch.from_json(entry["sketch"])
+    assert 0.01 <= sketch.quantile(0.5) <= 0.04
+
+
+def test_dispatch_stats_bounded_cardinality_overflow():
+    stats = DispatchStats(max_keys=2)
+    for i in range(5):
+        stats.observe(
+            engine=f"e{i}", bucket="b", backend="cpu", seconds=0.01
+        )
+    snap = stats.snapshot()
+    assert len(snap) <= 3  # 2 real keys + the overflow absorber
+    assert sum(e["dispatches"] for e in snap.values()) == 5
+
+
+def test_set_dispatch_observation_suppresses_the_seam():
+    stats = get_dispatch_stats()
+    stats.reset()
+    prev = set_dispatch_observation(False)
+    try:
+        observe_dispatch(
+            engine="xla", bucket="off", backend="cpu", seconds=0.5
+        )
+        assert stats.snapshot() == {}
+    finally:
+        set_dispatch_observation(prev)
+    observe_dispatch(engine="xla", bucket="on", backend="cpu", seconds=0.5)
+    assert DispatchStats.key_for("xla", "on", "cpu") in stats.snapshot()
+
+
+def test_simulate_feeds_dispatch_sketch_and_bundle_metrics(tmp_path):
+    from yuma_simulation_tpu.scenarios import create_case
+    from yuma_simulation_tpu.simulation.engine import simulate
+
+    stats = get_dispatch_stats()
+    stats.reset()
+    case = create_case("Case 1")
+    simulate(case, "Yuma 1 (paper)")
+    snap = stats.snapshot()
+    assert snap, "the dispatch seam observed nothing"
+    entry = next(iter(snap.values()))
+    assert entry["epochs_total"] >= case.num_epochs
+    assert entry["seconds_total"] > 0
+
+    # the sketches ride flight-bundle metrics lines as meta
+    reg = MetricsRegistry()
+    FlightRecorder(tmp_path).snapshot_metrics(reg, run_id="run-sk")
+    line = load_bundle(tmp_path).metrics[-1]
+    assert set(line["dispatch_sketches"]) == set(snap)
+
+
+# --------------------------------------------- profiling (single-flight)
+
+
+def test_profile_session_single_flight_and_deadline(tmp_path):
+    sess = ProfileSession(tmp_path)
+    started = sess.start(0.3, mode="trace")
+    assert started["mode"] == "trace"
+    with pytest.raises(ProfileBusyError) as err:
+        sess.start(0.3, mode="trace")
+    assert err.value.status["serial"] == started["serial"]
+
+    # the deadline timer releases the latch without an operator stop
+    # (poll on the publish count: the latch clears before the publish)
+    deadline = time.time() + 10.0
+    while sess.status()["profiles_published"] < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert sess.status()["profiles_published"] == 1, (
+        "auto-stop deadline never fired"
+    )
+    assert not sess.status()["active"]
+    records = [
+        json.loads(line)
+        for line in (tmp_path / "profiles.jsonl").read_text().splitlines()
+    ]
+    assert records[-1]["event"] == "profile_published"
+    assert records[-1]["artifact"] == started["artifact"]
+    # jax writes the trace artifact at stop_trace — it exists now
+    assert pathlib.Path(started["artifact"]).exists()
+    # a new window is admissible once the latch is free
+    sess.start(0.2, mode="trace")
+    assert sess.stop() is not None
+    assert sess.stop() is None  # idempotent
+
+
+def test_profile_session_rejects_bad_requests(tmp_path):
+    sess = ProfileSession(tmp_path)
+    with pytest.raises(ValueError):
+        sess.start(0.0)
+    with pytest.raises(ValueError):
+        sess.start(1.0, mode="flamegraph")
+    with pytest.raises(ValueError):
+        ProfileSession(None).start(1.0)
+
+
+def test_ops_plane_debug_vars_and_spans(tmp_path):
+    ops = OpsPlane(tmp_path)
+    FlightRecorder(tmp_path, rotation=SMALL).append_numerics(
+        _numerics_batch("ops-run")
+    )
+    with RunContext("ops-run") as run:
+        ops.run = run
+        with span("live-work"):
+            vars_out = ops.debug_vars()
+            spans_out = ops.debug_spans()
+    assert vars_out["profile"]["active"] is False
+    assert "segments" in vars_out
+    assert any(
+        s["name"] == "live-work" for s in spans_out["spans"].values()
+    )
+    ops.close()
+
+
+# ------------------------------------------------------------ perfattrib
+
+
+def _sketch_entry(engine, *, dispatches=8, epochs=512, seconds=2.0):
+    sk = LatencySketch()
+    for _ in range(dispatches):
+        sk.observe(seconds / dispatches)
+    return {
+        "engine": engine,
+        "bucket": "b",
+        "backend": "cpu",
+        "dispatches": dispatches,
+        "epochs_total": epochs,
+        "seconds_total": seconds,
+        "sketch": sk.to_json(),
+    }
+
+
+def _history_record():
+    return {
+        "costs": {
+            "xla": {"flops": 1e9, "bytes_accessed": 1e8, "reason": None},
+            "fused_varying_mxu": {
+                "flops": None,
+                "reason": "Pallas rung unavailable on cpu",
+            },
+        },
+        "rooflines": {
+            "xla": {
+                "predicted_epochs_per_sec": 400.0,
+                "bound": "memory",
+                "device": "cpu",
+            },
+        },
+    }
+
+
+def test_perfattrib_resolves_measured_rungs_and_types_the_rest():
+    from tools.perfattrib import attribute, check_rows
+
+    sketches = {"xla|b|cpu": _sketch_entry("xla")}
+    rows = {r["engine"]: r for r in attribute(_history_record(), sketches)}
+
+    xla = rows["xla"]
+    assert xla["measured_source"] == "dispatch_sketches"
+    assert xla["measured_epochs_per_sec"] == pytest.approx(256.0)
+    assert xla["attained_fraction"] == pytest.approx(256.0 / 400.0)
+    assert xla["limiter"]
+    assert rows["fused_varying_mxu"]["reason_kind"] == "rung_unavailable"
+    # rungs with neither cost nor sketch carry the no-cost reason
+    assert rows["fused_scan"]["reason_kind"] == "no_cost_record"
+    assert check_rows(list(rows.values())) == []
+
+
+def test_perfattrib_check_flags_untyped_gaps():
+    from tools.perfattrib import attribute, check_rows
+
+    # attribute() always types its reasons; the gate exists to catch a
+    # row that lost one (hand-edited history, a future refactor bug)
+    rows = attribute(_history_record(), {})
+    assert check_rows(rows) == []
+    broken = next(r for r in rows if r["engine"] == "fused_varying_mxu")
+    broken.pop("reason")
+    problems = check_rows(rows)
+    assert problems and "fused_varying_mxu" in problems[0]
+
+    # a measured rung with no roofline gets the typed no-roofline reason
+    record2 = _history_record()
+    record2["rooflines"] = {}
+    rows2 = {
+        r["engine"]: r
+        for r in attribute(record2, {"xla|b|cpu": _sketch_entry("xla")})
+    }
+    assert rows2["xla"]["reason_kind"] == "no_device_roofline"
+
+
+def test_perfattrib_collect_sketches_keeps_cumulative_maximum():
+    from tools.perfattrib import collect_sketches
+
+    lines = [
+        {"dispatch_sketches": {"k": _sketch_entry("xla", dispatches=3)}},
+        {"dispatch_sketches": {"k": _sketch_entry("xla", dispatches=9)}},
+        {"dispatch_sketches": {"k": _sketch_entry("xla", dispatches=6)}},
+    ]
+    assert collect_sketches(lines)["k"]["dispatches"] == 9
+
+
+def test_perfattrib_check_passes_on_committed_history():
+    """The ISSUE 19 acceptance gate, run exactly as CI does."""
+    from tools.perfattrib import main
+
+    history = REPO_ROOT / "BENCH_HISTORY.jsonl"
+    assert main(["--history", str(history), "--check"]) == 0
+
+
+# --------------------------------------------------------------- follow
+
+
+def test_obsreport_follow_tails_a_live_segmented_bundle(tmp_path):
+    from tools.obsreport import follow
+
+    rec = FlightRecorder(tmp_path, rotation=SMALL)
+    for i in range(10):
+        rec.append_numerics(_numerics_batch(f"f-{i}"))
+    rec.seal_live_segment()
+    FlightRecorder(tmp_path).record_profile(
+        {"event": "profile_published", "mode": "trace",
+         "artifact": "profiles/trace_001", "seconds": 1.0, "serial": 1}
+    )
+    out = io.StringIO()
+    follow(tmp_path, interval=0.05, max_seconds=0.3, out=out)
+    text = out.getvalue()
+    assert "seg_000000" in text
+    assert "profile" in text

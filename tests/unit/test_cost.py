@@ -345,6 +345,14 @@ def _history_record(value, cv=0.02, smoke=False, backend="cpu", t=0.0,
             "first_dispatch_seconds_warm": 3.5,
             "warm_aot": {"hits": 1, "misses": 0, "builds": 0},
         },
+        # The 0.23.0 schema: the dispatch-sketch observation overhead
+        # is a first-class gated metric (structural + ceiling gates).
+        "dispatch_sketch": {
+            "workload": "simulate() 64v x 256m, E=64",
+            "epochs_per_sec_off": value / 10,
+            "epochs_per_sec_on": value / 10 * 0.99,
+            "overhead_frac": 0.01,
+        },
         # The 0.18.0 schema: the what-if suffix-resume speedup is a
         # first-class gated metric (structural + ratio-floor gates).
         "whatif": {

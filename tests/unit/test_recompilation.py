@@ -273,3 +273,24 @@ def test_serve_warm_repeat_is_compile_free():
         assert sentinel.new_entries == 0
     finally:
         svc.close()
+
+
+def test_dispatch_sketch_seam_is_compile_free():
+    """ISSUE 19: the always-on dispatch-timing seam (one host-side
+    LatencySketch observation per dispatched region) must add ZERO
+    warm-repeat compiles — the sketch never touches traced values."""
+    from yuma_simulation_tpu.simulation.engine import simulate
+    from yuma_simulation_tpu.telemetry.slo import get_dispatch_stats
+
+    stats = get_dispatch_stats()
+    case = create_case("Case 2")
+    simulate(case, "Yuma 1 (paper)")  # warm-up
+    stats.reset()
+    with RecompilationSentinel(
+        _simulate_scan, budget=0, label="sketch-instrumented dispatch"
+    ) as sentinel:
+        simulate(case, "Yuma 1 (paper)")
+    assert sentinel.new_entries == 0
+    # the seam did observe the warm dispatch it is riding on
+    snap = stats.snapshot()
+    assert snap and sum(e["dispatches"] for e in snap.values()) >= 1

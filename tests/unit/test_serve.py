@@ -810,3 +810,77 @@ def test_http_rejects_undecodable_body():
         assert status == 400 and body["error"] == "InvalidJSON"
     finally:
         server.close()
+
+
+def test_debug_ops_endpoints_end_to_end(tmp_path):
+    """ISSUE 19 live ops plane over HTTP: /debug/vars and /debug/spans
+    answer during traffic, POST /debug/profile is single-flight (the
+    concurrent second request gets a typed 409 naming the active
+    window), the auto-stop deadline publishes the trace artifact into
+    the bundle, and a rejected request gets a typed 400."""
+    import pathlib
+
+    from yuma_simulation_tpu.telemetry.flight import load_bundle
+
+    bundle_dir = tmp_path / "ops-bundle"
+    server = SimulationServer(
+        ServeConfig(
+            coalesce_window_seconds=0.0,
+            bundle_dir=str(bundle_dir),
+            flight_rotation=True,
+        )
+    ).start()
+    try:
+        assert wait_until_ready(server.url)
+        client = SimulationClient(server.url, tenant="ops")
+        assert client.simulate(case="Case 1").ok
+
+        v = client.debug_vars()
+        assert v.status == 200
+        assert v.body["profile"]["active"] is False
+        assert "segments" in v.body
+        assert v.body["metrics"]["counters"]["serve_requests_total"] >= 1
+
+        s = client.debug_spans()
+        assert s.status == 200 and s.body["run_id"]
+
+        started = client.debug_profile(seconds=0.5)
+        assert started.status == 200, started.body
+        assert started.body["profile"]["mode"] == "trace"
+        busy = client.debug_profile(seconds=0.5)
+        assert busy.status == 409 and busy.body["error"] == "ProfileBusy"
+        assert (
+            busy.body["active"]["serial"]
+            == started.body["profile"]["serial"]
+        )
+
+        # the deadline auto-stop publishes without an operator stop
+        # (generous deadline: jax's stop_trace writes the capture to
+        # disk, which crawls when the suite shards run concurrently)
+        deadline = time.time() + 90.0
+        profiles = bundle_dir / "profiles.jsonl"
+        records: list = []
+        while time.time() < deadline:
+            if profiles.exists():
+                records = [
+                    json.loads(line)
+                    for line in profiles.read_text().splitlines()
+                ]
+                if any(
+                    r["event"] == "profile_published" for r in records
+                ):
+                    break
+            time.sleep(0.05)
+        assert records, "profile never published before the deadline"
+        assert records[-1]["event"] == "profile_published"
+        assert pathlib.Path(records[-1]["artifact"]).exists()
+
+        bad = client.debug_profile(seconds=-1.0)
+        assert bad.status == 400 and bad.body["error"] == "InvalidRequest"
+    finally:
+        server.close()
+
+    # the published capture is registered in the (segmented) bundle
+    bundle = load_bundle(bundle_dir)
+    assert bundle.profiles
+    assert bundle.profiles[-1]["event"] == "profile_published"

@@ -46,12 +46,15 @@ sys.path.insert(
 )
 
 def load_numerics(directory: str | pathlib.Path) -> list[dict]:
-    """The bundle's ``numerics.jsonl`` records (tolerant reader)."""
-    from yuma_simulation_tpu.utils.checkpoint import read_jsonl_tolerant
+    """The bundle's numerics records, monolithic or segmented.
 
-    return read_jsonl_tolerant(
-        pathlib.Path(directory) / "numerics.jsonl"
-    )
+    Goes through :func:`telemetry.flight.load_bundle` so a bundle
+    written under segment rotation (numerics land in
+    ``segments/seg_*/numerics.jsonl``) reads identically to the
+    classic root ``numerics.jsonl``."""
+    from yuma_simulation_tpu.telemetry.flight import load_bundle
+
+    return load_bundle(pathlib.Path(directory)).numerics
 
 
 def _group_key(rec: dict) -> tuple:

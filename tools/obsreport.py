@@ -20,6 +20,10 @@ Usage::
                                                      # unresolvable records
                                                      # or report mismatch
     python -m tools.obsreport SWEEP_DIR --json       # machine-readable
+    python -m tools.obsreport SWEEP_DIR --follow     # tail a LIVE
+                                                     # (segmented) bundle:
+                                                     # new spans/records/
+                                                     # seals as they land
     python -m tools.obsreport SWEEP_DIR --drill      # run the chaos drill
                                                      # into SWEEP_DIR first
                                                      # (CI smoke; CPU)
@@ -181,7 +185,127 @@ def render(bundle, run_id: str | None) -> str:
     if controller:
         lines.append("")
         lines.extend(controller)
+    telemetry = render_telemetry(bundle)
+    if telemetry:
+        lines.append("")
+        lines.extend(telemetry)
+    dispatch = render_dispatch(bundle)
+    if dispatch:
+        lines.append("")
+        lines.extend(dispatch)
     return "\n".join(lines)
+
+
+def render_telemetry(bundle) -> list[str]:
+    """The continuous-telemetry section of a ROTATING bundle: one line
+    per sealed segment (the ``segment_sealed`` seal records that ride
+    ``segments/seg_*/seal.json``), the retention tombstone (the
+    cumulative ``segments_compacted`` record in ``compacted.json``),
+    and the registered profiler captures (``profile_started`` /
+    ``profile_published`` records in ``profiles.jsonl``). Empty for
+    monolithic bundles with no profiles."""
+    if not (bundle.segments or bundle.profiles or bundle.compacted):
+        return []
+    lines = ["continuous telemetry (rotating segments & profiles):"]
+    seals = [
+        s for s in bundle.segments if s.get("event") == "segment_sealed"
+    ]
+    for seal in seals:
+        lines.append(
+            f"  sealed {seal.get('segment', '?')} at "
+            f"{_fmt_ts(seal.get('t'))}: {_fmt_bytes(seal.get('bytes'))} "
+            f"across {len(seal.get('run_ids', ()))} run(s)"
+        )
+    counters = gauges = {}
+    if bundle.metrics:
+        counters = bundle.metrics[-1].get("counters", {})
+        gauges = bundle.metrics[-1].get("gauges", {})
+    if "telemetry_segments_total" in counters:
+        lines.append(
+            "  rotation counters: sealed="
+            f"{_num(counters['telemetry_segments_total'])} "
+            f"retained={_fmt_bytes(gauges.get('telemetry_bytes_retained', 0))}"
+        )
+    c = bundle.compacted
+    if c and c.get("event") == "segments_compacted":
+        lines.append(
+            f"  compacted: {c.get('segments', 0)} segment(s) / "
+            f"{_fmt_bytes(c.get('bytes', 0))} reclaimed by retention "
+            f"(runs exempted from span checks: "
+            f"{len(c.get('run_ids', ()))})"
+        )
+    for rec in bundle.profiles:
+        event = rec.get("event") or "profile_published"
+        marker = "[.]" if event == "profile_started" else "[x]"
+        lines.append(
+            f"  profile {marker} {event} {_fmt_ts(rec.get('t'))} "
+            f"mode={rec.get('mode', '?')} "
+            f"artifact={rec.get('artifact', '?')}"
+        )
+    return lines
+
+
+def render_dispatch(bundle) -> list[str]:
+    """The dispatch-timing section: the always-on per-(engine rung x
+    shape bucket x backend) latency sketches joined off the bundle's
+    metrics lines, plus the roofline-gap attribution table
+    (``tools/perfattrib.py``) when a BENCH history sits beside the
+    working directory. Empty when no snapshot carried sketches."""
+    try:
+        from tools.perfattrib import (
+            attribute,
+            collect_sketches,
+            render_rows,
+        )
+    except ImportError:  # executed as a bare script, not -m tools.*
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from perfattrib import attribute, collect_sketches, render_rows
+
+    sketches = collect_sketches(bundle.metrics)
+    if not sketches:
+        return []
+    from yuma_simulation_tpu.telemetry.slo import LatencySketch
+
+    lines = [
+        "dispatch timing ('dispatch_seconds' sketch family, "
+        f"{len(sketches)} key(s)):"
+    ]
+    for key, e in sorted(sketches.items()):
+        secs = float(e.get("seconds_total", 0.0))
+        epochs = int(e.get("epochs_total", 0))
+        rate = f" {epochs / secs:.1f}ep/s" if secs > 0 and epochs else ""
+        quantiles = ""
+        if isinstance(e.get("sketch"), dict):
+            try:
+                sk = LatencySketch.from_json(e["sketch"])
+                p50, p99 = sk.quantile(0.5), sk.quantile(0.99)
+                if p50 is not None and p99 is not None:
+                    quantiles = (
+                        f" p50={p50 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms"
+                    )
+            except Exception:
+                pass
+        lines.append(
+            f"  {key}: {e.get('dispatches', 0)} dispatch(es) "
+            f"{secs:.3f}s{rate}{quantiles}"
+        )
+    history = os.environ.get("YUMA_TPU_BENCH_HISTORY", "BENCH_HISTORY.jsonl")
+    if os.path.exists(history):
+        import io
+
+        from yuma_simulation_tpu.utils.checkpoint import (
+            read_jsonl_tolerant,
+        )
+
+        records = read_jsonl_tolerant(history)
+        if records:
+            out = io.StringIO()
+            render_rows(attribute(records[-1], sketches), out=out)
+            lines.append("  roofline-gap attribution (perfattrib):")
+            lines.extend(
+                "  " + line for line in out.getvalue().splitlines()
+            )
+    return lines
 
 
 def render_replay(bundle) -> list[str]:
@@ -653,6 +777,88 @@ def _num(v):
     return int(v) if isinstance(v, float) and v.is_integer() else v
 
 
+def follow(
+    directory: str,
+    *,
+    interval: float = 2.0,
+    max_seconds: float = 0.0,
+    out=None,
+) -> int:
+    """``--follow``: tail a LIVE bundle — poll-reload `directory` every
+    `interval` seconds and print each newly landed span, ledger record,
+    sealed segment and registered profile as one line. Built for the
+    segmented rotation mode (the live segment's appended tail becomes
+    visible between polls; `load_bundle` already tolerates the torn
+    tail a concurrent writer may leave), but works on monolithic
+    bundles too. Runs until Ctrl-C, or for `max_seconds` when given
+    (the CI-friendly bound)."""
+    import time as _time
+
+    from yuma_simulation_tpu.telemetry.flight import load_bundle
+
+    out = out or sys.stdout
+    seen_spans: set = set()
+    seen_segments: set = set()
+    seen_ledger = seen_profiles = 0
+    deadline = _time.monotonic() + max_seconds if max_seconds > 0 else None
+    print(f"following {directory} (interval {interval}s)", file=out)
+    try:
+        while True:
+            bundle = load_bundle(directory)
+            for seal in bundle.segments:
+                name = seal.get("segment")
+                if name in seen_segments:
+                    continue
+                seen_segments.add(name)
+                print(
+                    f"{_fmt_ts(seal.get('t'))}  segment_sealed {name} "
+                    f"{_fmt_bytes(seal.get('bytes'))} "
+                    f"runs={len(seal.get('run_ids', ()))}",
+                    file=out,
+                )
+            for s in sorted(
+                bundle.spans, key=lambda s: float(s.get("t_start") or 0.0)
+            ):
+                key = (s.get("run_id"), s.get("span_id"))
+                if key in seen_spans:
+                    continue
+                seen_spans.add(key)
+                print(
+                    f"{_fmt_ts(s.get('t_start'))}  span {s.get('name')} "
+                    f"[{s.get('span_id')}] run={s.get('run_id')}",
+                    file=out,
+                )
+            for rec in bundle.ledger[seen_ledger:]:
+                print(
+                    f"{_fmt_ts(rec.get('t'))}  {rec.get('event')} "
+                    f"{_fmt_fields(rec)}".rstrip(),
+                    file=out,
+                )
+            seen_ledger = len(bundle.ledger)
+            for rec in bundle.profiles[seen_profiles:]:
+                print(
+                    f"{_fmt_ts(rec.get('t'))}  "
+                    f"{rec.get('event', 'profile_published')} "
+                    f"mode={rec.get('mode', '?')} "
+                    f"artifact={rec.get('artifact', '?')}",
+                    file=out,
+                )
+            seen_profiles = len(bundle.profiles)
+            out.flush()
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    print(
+        f"followed: {len(seen_spans)} span(s), {seen_ledger} ledger "
+        f"record(s), {len(seen_segments)} sealed segment(s), "
+        f"{seen_profiles} profile(s)",
+        file=out,
+    )
+    return 0
+
+
 def run_drill(directory: str) -> None:
     """The deterministic chaos drill: stall + NaN lane + torn chunk
     (+ device loss when `jax.shard_map` exists), supervised into
@@ -943,6 +1149,21 @@ def main(argv: list[str] | None = None) -> int:
         "--json", action="store_true", help="emit the bundle as JSON"
     )
     parser.add_argument(
+        "--follow",
+        action="store_true",
+        help="tail the LIVE bundle: poll-reload and print each newly "
+        "landed span / ledger record / sealed segment / profile "
+        "(Ctrl-C or --max-seconds to stop)",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0,
+        help="--follow poll interval in seconds (default 2)",
+    )
+    parser.add_argument(
+        "--max-seconds", type=float, default=0.0,
+        help="--follow duration bound in seconds (0 = until Ctrl-C)",
+    )
+    parser.add_argument(
         "--drill",
         action="store_true",
         help="run the deterministic chaos drill into DIRECTORY first "
@@ -979,6 +1200,13 @@ def main(argv: list[str] | None = None) -> int:
 
     from yuma_simulation_tpu.fabric.store import is_fleet_store
     from yuma_simulation_tpu.telemetry.flight import check_bundle, load_bundle
+
+    if args.follow:
+        return follow(
+            args.directory,
+            interval=args.interval,
+            max_seconds=args.max_seconds,
+        )
 
     if is_fleet_store(args.directory):
         if args.json:

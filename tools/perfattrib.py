@@ -1,0 +1,358 @@
+"""perfattrib: roofline-gap attribution over measured dispatch timing.
+
+perfgate answers "did the rate move?"; this CLI answers the next
+question an operator asks — "where is the remaining gap to the
+hardware ceiling, and what is eating it?". Inputs are the two halves
+the telemetry plane already records:
+
+- **Measured**: the always-on per-(engine rung x shape bucket x
+  backend) dispatch timing sketches (`dispatch_sketches` on flight-
+  bundle metrics lines — ``yuma_simulation_tpu.telemetry.slo
+  .DispatchStats``). Snapshots are cumulative per process, so the join
+  keeps the HIGHEST-count line per key and merges across keys of one
+  rung. Without a bundle, the BENCH record's own roofline
+  ``measured_epochs_per_sec`` is the measured side.
+- **Predicted**: the AOT cost report + roofline verdicts bench.py
+  appends to ``BENCH_HISTORY.jsonl`` (``yuma_simulation_tpu.telemetry
+  .cost``) — flops/bytes per rung against the device's peak FLOP/s and
+  HBM bandwidth.
+
+The output is one row per engine rung: measured epochs/s, predicted
+ceiling, attained fraction, compute- vs memory-bound, and a suspected
+limiter derived from the sketch shape (dispatch-jitter p99/p50 spread,
+per-dispatch overhead on small epoch batches, or the roofline bound
+itself). Honesty is the contract: a rung with no measurement or no
+roofline carries a TYPED reason (``reason_kind`` +  human sentence) —
+"unmeasured, and here is why" must never be confusable with "forgot".
+
+Usage::
+
+    python -m tools.perfattrib                    # table from history
+    python -m tools.perfattrib BUNDLE             # join a flight bundle's
+                                                  # dispatch sketches
+    python -m tools.perfattrib --check            # gate: exit 1 when any
+                                                  # rung lacks BOTH a
+                                                  # roofline resolution
+                                                  # and a typed reason
+    python -m tools.perfattrib --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_HISTORY = "BENCH_HISTORY.jsonl"
+
+#: Attained fraction at/above which a rung is reported as sitting at its
+#: (amortization-optimistic) roofline ceiling rather than attributed.
+AT_ROOFLINE_FRACTION = 0.8
+
+#: p99/p50 spread above which the sketch itself becomes the suspect:
+#: the rung's median is fine but the tail is not — queueing/jitter, not
+#: a steady-state roofline gap.
+JITTER_SPREAD = 4.0
+
+#: Mean epochs per dispatch below which fixed per-dispatch overhead
+#: (host work, transfer, retrace checks) plausibly dominates the gap.
+SMALL_BATCH_EPOCHS = 64
+
+#: The typed reason vocabulary (``reason_kind``). Every row either
+#: resolves to a roofline (measured + predicted) or carries one of
+#: these — the --check contract.
+REASON_KINDS = (
+    "rung_unavailable",   # cost capture says why (CPU Pallas rungs...)
+    "no_dispatches",      # no sketch and no bench measurement for rung
+    "no_device_roofline",  # device spec lacks peak flops/bandwidth
+    "no_cost_record",     # history record lacks the rung entirely
+)
+
+
+def load_history(path: str) -> list[dict]:
+    from yuma_simulation_tpu.utils.checkpoint import read_jsonl_tolerant
+
+    return read_jsonl_tolerant(path)
+
+
+def collect_sketches(metrics_lines) -> dict:
+    """The joined ``{key: entry}`` dispatch table from a bundle's
+    metrics lines. Snapshots are CUMULATIVE per process, so per key the
+    highest-``dispatches`` line wins (re-reading a growing segmented
+    bundle never double-counts); distinct keys merge side by side."""
+    best: dict[str, dict] = {}
+    for line in metrics_lines or []:
+        sketches = (line or {}).get("dispatch_sketches")
+        if not isinstance(sketches, dict):
+            continue
+        for key, entry in sketches.items():
+            if not isinstance(entry, dict):
+                continue
+            prior = best.get(key)
+            if prior is None or int(entry.get("dispatches", 0)) >= int(
+                prior.get("dispatches", 0)
+            ):
+                best[key] = entry
+    return best
+
+
+def _merge_rung_sketches(entries: list[dict]) -> dict:
+    """Fold one rung's per-(bucket, backend) entries into rung totals
+    plus a merged quantile sketch (sketch merge is exact count
+    addition)."""
+    from yuma_simulation_tpu.telemetry.slo import LatencySketch
+
+    merged: Optional[LatencySketch] = None
+    dispatches = epochs = 0
+    seconds = 0.0
+    for e in entries:
+        dispatches += int(e.get("dispatches", 0))
+        epochs += int(e.get("epochs_total", 0))
+        seconds += float(e.get("seconds_total", 0.0))
+        rec = e.get("sketch")
+        if isinstance(rec, dict):
+            try:
+                sk = LatencySketch.from_json(rec)
+            except Exception:
+                continue
+            merged = sk if merged is None else merged.merge(sk)
+    out = {
+        "dispatches": dispatches,
+        "epochs_total": epochs,
+        "seconds_total": seconds,
+    }
+    if merged is not None and dispatches:
+        out["p50_seconds"] = merged.quantile(0.5)
+        out["p99_seconds"] = merged.quantile(0.99)
+    return out
+
+
+def _suspect_limiter(row: dict) -> str:
+    """The attribution heuristic for a resolved (measured + predicted)
+    rung — deliberately a short, falsifiable sentence, not a verdict."""
+    attained = row.get("attained_fraction")
+    if attained is not None and attained >= AT_ROOFLINE_FRACTION:
+        return "at roofline (ceiling is amortization-optimistic)"
+    suspects: list[str] = []
+    p50, p99 = row.get("p50_seconds"), row.get("p99_seconds")
+    if p50 and p99 and p99 / p50 > JITTER_SPREAD:
+        suspects.append(
+            f"dispatch jitter (p99/p50 = {p99 / p50:.1f}x)"
+        )
+    dispatches = row.get("dispatches") or 0
+    epochs = row.get("epochs_total") or 0
+    if dispatches and epochs / dispatches < SMALL_BATCH_EPOCHS:
+        suspects.append(
+            "per-dispatch overhead "
+            f"({epochs / dispatches:.0f} epochs/dispatch)"
+        )
+    bound = row.get("bound")
+    if bound == "memory":
+        suspects.append("memory-bound: HBM bandwidth")
+    elif bound == "compute":
+        suspects.append("compute-bound: MXU peak")
+    return "; ".join(suspects) or "unattributed gap"
+
+
+def attribute(record: dict, sketches: Optional[dict] = None) -> list[dict]:
+    """One row per engine rung joining the BENCH record's cost/roofline
+    verdicts against the measured dispatch sketches. Every row either
+    resolves (measured AND predicted epochs/s, attained fraction,
+    suspected limiter) or carries a typed reason from
+    :data:`REASON_KINDS` — see the module docstring."""
+    from yuma_simulation_tpu.telemetry.cost import ENGINE_RUNGS
+
+    costs = record.get("costs") or {}
+    rooflines = record.get("rooflines") or {}
+    by_rung: dict[str, list[dict]] = {}
+    for entry in (sketches or {}).values():
+        engine = entry.get("engine") or ""
+        by_rung.setdefault(engine, []).append(entry)
+
+    rows: list[dict] = []
+    for engine in ENGINE_RUNGS:
+        cost = costs.get(engine)
+        rl = rooflines.get(engine) or {}
+        row: dict = {"engine": engine}
+        measured = None
+        entries = by_rung.get(engine)
+        if entries:
+            merged = _merge_rung_sketches(entries)
+            row.update(merged)
+            if merged["seconds_total"] > 0 and merged["epochs_total"] > 0:
+                measured = merged["epochs_total"] / merged["seconds_total"]
+                row["measured_source"] = "dispatch_sketches"
+        if measured is None and isinstance(
+            rl.get("measured_epochs_per_sec"), (int, float)
+        ):
+            measured = float(rl["measured_epochs_per_sec"])
+            row["measured_source"] = "bench"
+        row["measured_epochs_per_sec"] = measured
+        predicted = rl.get("predicted_epochs_per_sec")
+        row["predicted_epochs_per_sec"] = predicted
+        row["bound"] = rl.get("bound")
+        row["device"] = rl.get("device")
+
+        if not isinstance(cost, dict):
+            row["reason_kind"] = "no_cost_record"
+            row["reason"] = (
+                "history record carries no cost capture for this rung "
+                "(bench ran --skip-costs?)"
+            )
+        elif measured is None:
+            if cost.get("reason"):
+                row["reason_kind"] = "rung_unavailable"
+                row["reason"] = str(cost["reason"])
+            else:
+                row["reason_kind"] = "no_dispatches"
+                row["reason"] = (
+                    "no dispatch sketch observed this rung and the "
+                    "bench record carries no measured rate for it"
+                )
+        elif not isinstance(predicted, (int, float)) or predicted <= 0:
+            row["reason_kind"] = "no_device_roofline"
+            row["reason"] = (
+                f"device {rl.get('device', '?')!r} spec lacks peak "
+                "FLOP/s or HBM bandwidth — the roofline ceiling is "
+                "undefined (set YUMA_TPU_DEVICE_SPEC to attribute)"
+            )
+        else:
+            row["attained_fraction"] = measured / float(predicted)
+            row["limiter"] = _suspect_limiter(row)
+        rows.append(row)
+    return rows
+
+
+def check_rows(rows: list[dict]) -> list[str]:
+    """The --check contract: every rung either resolves to a roofline
+    (attained fraction computed) or carries a typed reason. Empty list
+    means the gate passes."""
+    problems: list[str] = []
+    for row in rows:
+        if row.get("attained_fraction") is not None:
+            continue
+        kind = row.get("reason_kind")
+        if kind not in REASON_KINDS or not row.get("reason"):
+            problems.append(
+                f"{row.get('engine')}: unresolved (no roofline "
+                f"attribution) and no typed reason (reason_kind="
+                f"{kind!r})"
+            )
+    return problems
+
+
+def render_rows(rows: list[dict], out=None) -> None:
+    out = out or sys.stdout
+    for row in rows:
+        engine = row["engine"]
+        attained = row.get("attained_fraction")
+        if attained is not None:
+            line = (
+                f"  {engine}: measured "
+                f"{row['measured_epochs_per_sec']:.1f} epochs/s vs "
+                f"predicted {row['predicted_epochs_per_sec']:.1f} "
+                f"({attained:.1%} of roofline, "
+                f"{row.get('bound') or 'unknown'}-bound) -> "
+                f"{row.get('limiter')}"
+            )
+            if row.get("p50_seconds"):
+                line += (
+                    f" [p50 {row['p50_seconds'] * 1e3:.1f}ms"
+                    f" p99 {row['p99_seconds'] * 1e3:.1f}ms"
+                    f" over {row['dispatches']} dispatch(es)]"
+                )
+        else:
+            measured = row.get("measured_epochs_per_sec")
+            head = (
+                f"measured {measured:.1f} epochs/s, "
+                if isinstance(measured, (int, float))
+                else ""
+            )
+            line = (
+                f"  {engine}: {head}no attribution "
+                f"[{row.get('reason_kind')}] {row.get('reason')}"
+            )
+        print(line, file=out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="perfattrib", description=__doc__.split("\n\n")[0]
+    )
+    parser.add_argument(
+        "bundle", nargs="?", default=None,
+        help="flight-bundle directory whose metrics lines carry "
+        "dispatch_sketches (segmented or monolithic); omitted = the "
+        "bench record's own measured rates",
+    )
+    parser.add_argument(
+        "--history", default=DEFAULT_HISTORY,
+        help=f"bench history JSONL (default {DEFAULT_HISTORY})",
+    )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="gate: exit 1 when any engine rung neither resolves to a "
+        "roofline nor carries a typed reason, exit 2 when the history "
+        "is unusable",
+    )
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument(
+        "--report", default=None,
+        help="also write the JSON rows to this path (CI artifact)",
+    )
+    args = parser.parse_args(argv)
+
+    history = load_history(args.history)
+    if not history:
+        print(
+            f"perfattrib: no records in {args.history!r} "
+            "(run bench.py first)",
+            file=sys.stderr,
+        )
+        return 2
+    latest = history[-1]
+
+    sketches: dict = {}
+    if args.bundle:
+        from yuma_simulation_tpu.telemetry.flight import load_bundle
+
+        bundle = load_bundle(args.bundle)
+        sketches = collect_sketches(bundle.metrics)
+
+    rows = attribute(latest, sketches)
+    problems = check_rows(rows)
+    payload = json.dumps(
+        {"history": args.history, "bundle": args.bundle, "rows": rows,
+         "problems": problems},
+        indent=2, sort_keys=True,
+    )
+    if args.report:
+        from yuma_simulation_tpu.utils.checkpoint import publish_atomic
+
+        publish_atomic(args.report, payload.encode())
+    if args.json:
+        print(payload)
+    else:
+        resolved = sum(
+            1 for r in rows if r.get("attained_fraction") is not None
+        )
+        print(
+            f"perfattrib: {len(rows)} rung(s), {resolved} resolved to a "
+            f"roofline, {len(sketches)} dispatch key(s) joined "
+            f"(backend={latest.get('backend')})"
+        )
+        render_rows(rows)
+    if problems:
+        for p in problems:
+            print(f"perfattrib: UNRESOLVED: {p}", file=sys.stderr)
+        if args.check:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -51,7 +51,7 @@ DEFAULT_NOISE_MULT = 3.0
 REQUIRED_FIELDS = (
     "t", "backend", "smoke", "metric", "value", "unit", "secondary",
     "cv", "costs", "rooflines", "attained_floor", "numerics",
-    "cold_start", "whatif",
+    "cold_start", "whatif", "dispatch_sketch",
 )
 
 #: Fields the ``cold_start`` object must carry as numbers (0.17.0:
@@ -90,6 +90,13 @@ WHATIF_SPEEDUP_FLOOR_FRAC = 0.4
 #: pair exactly like the rolling-baseline tolerances — a noisy smoke
 #: window must not false-fail a capture that is actually free.
 NUMERICS_OVERHEAD_MAX = 0.05
+
+#: The dispatch-sketch overhead ceiling (ISSUE 19 acceptance: the
+#: always-on per-dispatch LatencySketch observation at the engine's
+#: dispatch seam must cost < 5% epochs/s on the bench smoke line,
+#: seam-on vs seam-off over the same simulate() workload). Widened by
+#: the pair's timing dispersion like every other in-record comparison.
+DISPATCH_SKETCH_OVERHEAD_MAX = 0.05
 
 #: Every engine rung must appear in the cost report, and each must carry
 #: these analysis fields — as numbers, or as explicit nulls with a
@@ -421,6 +428,44 @@ def check_numerics_overhead(
     return []
 
 
+def _dispatch_sketch_noise(record: dict) -> float:
+    """The seam-on/off pair's timing dispersion (max cv of the two
+    lines) — what widens the overhead ceiling when the windows were
+    noisy."""
+    cv = record.get("cv") or {}
+    return max(
+        float(cv.get("dispatch_sketch_off") or 0.0),
+        float(cv.get("dispatch_sketch_on") or 0.0),
+    )
+
+
+def check_dispatch_sketch_overhead(
+    record: dict, ceiling: float = DISPATCH_SKETCH_OVERHEAD_MAX
+) -> list[str]:
+    """The dispatch-sketch overhead gate: the record's measured
+    ``dispatch_sketch.overhead_frac`` (observation-on vs observation-off
+    epochs/s over the same simulate() workload) must sit under the
+    declared ceiling, noise-widened exactly like the numerics gate.
+    Vacuous when the record carries no dispatch_sketch object — the
+    STRUCTURAL gate already fails that."""
+    sketch = record.get("dispatch_sketch")
+    if not isinstance(sketch, dict):
+        return []
+    overhead = sketch.get("overhead_frac")
+    if not isinstance(overhead, (int, float)):
+        return []
+    noise = _dispatch_sketch_noise(record)
+    ceiling_eff = max(ceiling, DEFAULT_NOISE_MULT * noise)
+    if overhead > ceiling_eff:
+        return [
+            f"dispatch-sketch observation costs {overhead:.1%} epochs/s "
+            f"on {sketch.get('workload', '?')}, above the "
+            f"{ceiling_eff:.1%} ceiling (declared {ceiling:.1%}, "
+            f"cv {noise:.4f})"
+        ]
+    return []
+
+
 def compare(
     history: list[dict],
     *,
@@ -568,6 +613,7 @@ def main(argv=None) -> int:
     problems = check_structure(latest)
     attained_failures = check_attained(latest, floor_overrides)
     numerics_failures = check_numerics_overhead(latest)
+    dispatch_sketch_failures = check_dispatch_sketch_overhead(latest)
     cold_start_failures = check_cold_start(
         latest, args.cold_start_ceiling
     )
@@ -578,6 +624,7 @@ def main(argv=None) -> int:
         "structural_problems": problems,
         "attained_failures": attained_failures,
         "numerics_failures": numerics_failures,
+        "dispatch_sketch_failures": dispatch_sketch_failures,
         "cold_start_failures": cold_start_failures,
         "whatif_failures": whatif_failures,
     }
@@ -618,6 +665,13 @@ def main(argv=None) -> int:
         # on/off comparison, no cross-run baseline needed.
         for f in numerics_failures:
             print(f"perfgate: NUMERICS-OVERHEAD: {f}", file=sys.stderr)
+        if args.check:
+            return 1
+    if dispatch_sketch_failures:
+        # Also active in --structural: the seam-on/off overhead is one
+        # in-record comparison, no cross-run baseline needed.
+        for f in dispatch_sketch_failures:
+            print(f"perfgate: DISPATCH-SKETCH-OVERHEAD: {f}", file=sys.stderr)
         if args.check:
             return 1
     if cold_start_failures:
@@ -701,6 +755,23 @@ def _render(result: dict, latest: dict) -> None:
         )
         print(
             f"  numerics-overhead: {overhead:.2%} "
+            f"(ceiling {ceiling_eff:.1%})"
+        )
+    sketch_fails = result.get("dispatch_sketch_failures", [])
+    sketch_overhead = (latest.get("dispatch_sketch") or {}).get(
+        "overhead_frac"
+    )
+    if sketch_fails:
+        print(
+            f"  dispatch-sketch-overhead: ABOVE CEILING ({sketch_overhead})"
+        )
+    elif isinstance(sketch_overhead, (int, float)):
+        ceiling_eff = max(
+            DISPATCH_SKETCH_OVERHEAD_MAX,
+            DEFAULT_NOISE_MULT * _dispatch_sketch_noise(latest),
+        )
+        print(
+            f"  dispatch-sketch-overhead: {sketch_overhead:.2%} "
             f"(ceiling {ceiling_eff:.1%})"
         )
     verdicts = result.get("verdicts")

@@ -103,6 +103,13 @@ class FleetConfig:
     #: miss it does compile is published for the next host. None
     #: (default) leaves the legacy always-compile path untouched.
     executable_cache_dir: Optional[str] = None
+    #: Segmented flight-recorder rotation for the host bundle
+    #: (:class:`..telemetry.flight.RotationPolicy`): ``True`` enables
+    #: the defaults, a policy instance pins thresholds, ``None``
+    #: (default) defers to the ``YUMA_TPU_FLIGHT_ROTATE`` env opt-in —
+    #: rotation stays OFF unless requested, so existing monolithic
+    #: host bundles are untouched.
+    flight_rotation: object = None
 
     def heartbeat_interval(self) -> float:
         if self.heartbeat_seconds is not None:
@@ -168,6 +175,24 @@ class FleetHost:
             ttl_seconds=config.lease_ttl_seconds,
         )
         self.host_dir = self.store.host_dir(config.host_id)
+        from yuma_simulation_tpu.telemetry.flight import (
+            RotationPolicy,
+            rotation_from_env,
+        )
+        from yuma_simulation_tpu.telemetry.ops import OpsPlane
+
+        fr = config.flight_rotation
+        if fr is True:
+            self.rotation = RotationPolicy()
+        elif fr:
+            self.rotation = fr
+        else:
+            self.rotation = rotation_from_env()
+        #: Shared live-ops mixin (same surface the serve tier exposes
+        #: over HTTP): `ops.debug_vars()` / `ops.debug_spans()` /
+        #: `ops.debug_profile()` against the host bundle. The active
+        #: run is attached by :meth:`run_units` for span stitching.
+        self.ops = OpsPlane(self.host_dir)
         self._numerics_records: list = []
         if config.executable_cache_dir:
             from yuma_simulation_tpu.simulation.aot import (
@@ -271,6 +296,18 @@ class FleetHost:
         with continue_trace(
             ctx, prefix=span_prefix_for(cfg.host_id)
         ) as run:
+            self.ops.run = run
+            if self.rotation is not None:
+                try:
+                    FlightRecorder(
+                        self.host_dir, rotation=self.rotation
+                    ).mark_run_open(run.run_id)
+                except Exception:
+                    logger.warning(
+                        "fleet host rotation open failed for %s",
+                        self.host_dir,
+                        exc_info=True,
+                    )
             try:
                 with span(
                     f"host:{cfg.host_id}", units=num_units, fleet=tag
@@ -373,11 +410,16 @@ class FleetHost:
                 # every record written so far must resolve for
                 # `obsreport --check`.
                 try:
-                    recorder = FlightRecorder(self.host_dir)
+                    recorder = FlightRecorder(
+                        self.host_dir, rotation=self.rotation
+                    )
                     recorder.record(run, registry=registry)
                     recorder.record_numerics(
                         self._numerics_records, run_id=run.run_id
                     )
+                    if self.rotation is not None:
+                        recorder.mark_run_closed(run.run_id)
+                        recorder.seal_live_segment()
                 except Exception:
                     logger.warning(
                         "fleet host bundle publish failed for %s",

@@ -271,21 +271,26 @@ def run_controller_mode(args) -> int:
             ),
             max_windows_per_cycle=args.max_windows,
             lease_ttl_seconds=args.lease_ttl,
+            flight_rotation=args.rotate_flight or None,
+            profile_window_seconds=args.profile_window,
         ),
     )
     cycles = 0
-    while args.cycles is None or cycles < args.cycles:
-        report = controller.run_cycle()
-        cycles += 1
-        print(
-            f"cycle={cycles} swept={report.windows_swept} "
-            f"shed={report.windows_shed} "
-            f"stalled={report.subnets_stalled} "
-            f"quarantined={report.snapshots_quarantined} "
-            f"stale={report.max_staleness_seconds:.2f}",
-            flush=True,
-        )
-        time.sleep(args.poll)
+    try:
+        while args.cycles is None or cycles < args.cycles:
+            report = controller.run_cycle()
+            cycles += 1
+            print(
+                f"cycle={cycles} swept={report.windows_swept} "
+                f"shed={report.windows_shed} "
+                f"stalled={report.subnets_stalled} "
+                f"quarantined={report.snapshots_quarantined} "
+                f"stale={report.max_staleness_seconds:.2f}",
+                flush=True,
+            )
+            time.sleep(args.poll)
+    finally:
+        controller.close()
     return 0
 
 
@@ -402,6 +407,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ctl.add_argument(
         "--cycles", type=int, default=None,
         help="stop after N cycles (default: run forever)",
+    )
+    ctl.add_argument(
+        "--rotate-flight", action="store_true",
+        help="continuous telemetry: rotate the controller's flight "
+        "bundle into crash-safe sealed segments (default bounds; "
+        "YUMA_TPU_FLIGHT_ROTATE=1 is the env equivalent)",
+    )
+    ctl.add_argument(
+        "--profile-window", type=float, default=0.0,
+        help="arm ONE guarded jax.profiler window of this many "
+        "seconds over the first cycle that sweeps work (artifact "
+        "registers into the bundle's profiles.jsonl; 0 disables)",
     )
     ctl.add_argument(
         "--max-idle-polls", type=int, default=None,

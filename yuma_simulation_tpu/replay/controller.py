@@ -256,6 +256,18 @@ class ControllerConfig:
     max_wait_seconds: float = 600.0
     #: Yuma hyperparameters (None -> package defaults).
     config: object = None
+    #: Continuous-telemetry rotation for the controller's flight bundle
+    #: (``--rotate-flight``): ``True`` = default
+    #: :class:`..telemetry.flight.RotationPolicy` bounds, a policy
+    #: instance pins them, ``None`` (default) defers to the
+    #: ``YUMA_TPU_FLIGHT_ROTATE`` env opt-in — rotation stays OFF
+    #: unless explicitly requested.
+    flight_rotation: object = None
+    #: On-demand profiling (``--profile-window``): > 0 arms ONE guarded
+    #: ``jax.profiler`` window of this many seconds over the first
+    #: cycle that sweeps work, registered into the bundle's
+    #: ``profiles.jsonl``. 0 disables (the default).
+    profile_window_seconds: float = 0.0
 
 
 @dataclasses.dataclass
@@ -305,8 +317,39 @@ class ReplayController:
         self.bundle_dir = pathlib.Path(
             bundle_dir if bundle_dir is not None else self.store_root
         )
-        self.recorder = FlightRecorder(self.bundle_dir)
+        # Continuous-telemetry mode: resolve the rotation policy once
+        # (config wins, env opt-in otherwise); the lifetime run is
+        # pinned open so retention never reclaims its segments while
+        # the controller stands.
+        from yuma_simulation_tpu.telemetry.flight import (
+            RotationPolicy,
+            rotation_from_env,
+        )
+        from yuma_simulation_tpu.telemetry.ops import OpsPlane
+
+        if cfg.flight_rotation is True:
+            self.rotation = RotationPolicy()
+        elif cfg.flight_rotation:
+            self.rotation = cfg.flight_rotation
+        else:
+            self.rotation = rotation_from_env()
+        self.recorder = FlightRecorder(
+            self.bundle_dir, rotation=self.rotation
+        )
         self.run = RunContext()
+        if self.rotation is not None:
+            self.recorder.mark_run_open(self.run.run_id)
+        from yuma_simulation_tpu.telemetry.slo import get_slo_engine
+
+        #: The live ops plane (debug vars/spans/profile) — transport-
+        #: free; an embedding host (or the soak harness) mounts it.
+        self.ops = OpsPlane(
+            self.bundle_dir,
+            registry=get_registry(),
+            slo_engine=get_slo_engine(),
+            run=self.run,
+        )
+        self._profiled = False
         #: durable quarantine ledger (reloaded on restart).
         self.ledger = FailureLedger(self.bundle_dir / "ledger.jsonl")
         self._quarantined: set[tuple[int, int]] = {
@@ -777,6 +820,24 @@ class ReplayController:
             if budget is not None and len(work) > budget:
                 report.windows_shed = len(work) - budget
                 work = work[:budget]
+            if (
+                work
+                and self.cfg.profile_window_seconds > 0
+                and not self._profiled
+            ):
+                # One guarded device-profile window over the first
+                # cycle that actually sweeps (--profile-window): the
+                # single-flight latch + auto-stop deadline live in the
+                # ops plane; the artifact registers into the bundle.
+                self._profiled = True
+                try:
+                    self.ops.debug_profile(
+                        self.cfg.profile_window_seconds, mode="trace"
+                    )
+                except Exception:  # noqa: BLE001 — observation only
+                    logger.warning(
+                        "controller profile window failed", exc_info=True
+                    )
             for _, netuid, spec in work:
                 self.sweep_window(spec)
                 report.windows_swept += 1
@@ -807,15 +868,34 @@ class ReplayController:
         """Poll until `stop()` goes true (or `max_cycles` elapse).
         Returns the number of cycles run."""
         cycles = 0
-        while max_cycles is None or cycles < max_cycles:
-            if stop is not None and stop():
-                break
-            self.run_cycle()
-            cycles += 1
-            if stop is not None and stop():
-                break
-            time.sleep(self.cfg.poll_seconds)
+        try:
+            while max_cycles is None or cycles < max_cycles:
+                if stop is not None and stop():
+                    break
+                self.run_cycle()
+                cycles += 1
+                if stop is not None and stop():
+                    break
+                time.sleep(self.cfg.poll_seconds)
+        finally:
+            self.close()
         return cycles
+
+    def close(self) -> None:
+        """Graceful exit: publish any in-flight profile window, release
+        the retention pin, and seal the live segment so the bundle on
+        disk is whole. Idempotent; a SIGKILLed controller simply skips
+        this — the next reader tolerates the torn tail."""
+        try:
+            self.ops.close()
+        except Exception:  # noqa: BLE001 — shutdown must not raise
+            logger.warning("ops-plane close failed", exc_info=True)
+        if self.rotation is not None:
+            try:
+                self.recorder.mark_run_closed(self.run.run_id)
+                self.recorder.seal_live_segment()
+            except Exception:  # noqa: BLE001 — shutdown must not raise
+                logger.warning("final segment seal failed", exc_info=True)
 
 
 # -------------------------------------------------------- helper host

@@ -196,6 +196,7 @@ def run_soak(args) -> int:
         wait_until_ready,
     )
     from yuma_simulation_tpu.serve.service import ServeConfig
+    from yuma_simulation_tpu.telemetry.flight import load_bundle
     from yuma_simulation_tpu.utils import setup_logging
     from yuma_simulation_tpu.utils.checkpoint import (
         publish_atomic,
@@ -250,6 +251,10 @@ def run_soak(args) -> int:
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # Continuous-telemetry rotation, byte-bounded small so a soak-length
+    # run demonstrably seals >= 2 flight segments: every spawned process
+    # (controller, host, writer) inherits the opt-in.
+    env["YUMA_TPU_FLIGHT_ROTATE"] = "16384"
     mod = [sys.executable, "-m", "yuma_simulation_tpu.replay"]
     procs: list[subprocess.Popen] = []
     logfiles = []
@@ -409,8 +414,11 @@ def run_soak(args) -> int:
             f"[soak] SIGKILLed controller+host at +{time.time() - t0:.1f}s",
             flush=True,
         )
-        metrics_path = store_dir / "metrics.jsonl"
-        lines_at_kill = len(read_jsonl_tolerant(metrics_path))
+        # Rotation routes the metrics stream into flight segments, so
+        # count through load_bundle (root + segments in index order) —
+        # appends only ever extend the tail, so positional slicing
+        # against this count stays chronological.
+        lines_at_kill = len(load_bundle(store_dir).metrics)
         time.sleep(args.downtime)
         controller = spawn_controller()
         print(
@@ -590,7 +598,7 @@ def run_soak(args) -> int:
     # the kill snapshot boundary is not required (startup backlog may
     # legitimately burn) — what must hold is a fast burn AFTER the
     # restart and a final snapshot with none.
-    metrics_lines = read_jsonl_tolerant(store_dir / "metrics.jsonl")
+    metrics_lines = load_bundle(store_dir).metrics
     post_restart = metrics_lines[lines_at_kill:]
 
     def burn_active(line: dict) -> float:
@@ -616,6 +624,20 @@ def run_soak(args) -> int:
         any(s > 0 for s in sheds),
         f"backlog shed low-priority refreshes "
         f"(max shed={max(sheds, default=0)})",
+    )
+
+    # Continuous telemetry: the byte-bounded rotation opt-in must have
+    # produced a multi-segment bundle with sealed, crash-safe segments
+    # (the obsreport/sloreport gates below then read the same bundle
+    # through the segment-aware loader).
+    sealed_segments = sorted(
+        p.parent.name
+        for p in (store_dir / "segments").glob("seg_*/seal.json")
+    )
+    expect(
+        len(sealed_segments) >= 2,
+        f"flight recorder sealed >= 2 rotated segments "
+        f"({len(sealed_segments)}: {sealed_segments[:4]})",
     )
 
     # 6. Bitwise: the controller's final incremental baselines against
@@ -709,6 +731,7 @@ def run_soak(args) -> int:
                 "whatifs_ok": load_stats["ok"],
                 "quarantined_block": corrupt_block,
                 "stalled_netuid": stall_netuid,
+                "sealed_segments": len(sealed_segments),
                 "failures": failures,
             },
             indent=2,

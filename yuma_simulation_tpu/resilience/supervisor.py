@@ -315,10 +315,21 @@ class SweepSupervisor:
     #: bottom — a pure determinism canary, still meaningful: a demoted
     #: RETRY must reproduce the primary's bits).
     canary_engine: Optional[str] = None
+    #: On-demand device profiling cadence (PR 19): every Nth unit (unit
+    #: 0 included) dispatches under a ``jax.profiler`` trace written to
+    #: ``DIRECTORY/profiles/unitNNNN`` and registered into the bundle's
+    #: ``profiles.jsonl`` — so a long sweep leaves periodic on-chip
+    #: evidence without an operator attaching by hand. Requires a
+    #: `directory`; 0 disables (the default — a trace costs runtime).
+    profile_every: int = 0
 
     def __post_init__(self) -> None:
         if self.unit_size < 1:
             raise ValueError("unit_size must be >= 1")
+        if self.profile_every < 0:
+            raise ValueError(
+                f"profile_every must be >= 0, got {self.profile_every}"
+            )
         if not (0.0 <= self.canary_fraction <= 1.0):
             raise ValueError(
                 "canary_fraction must be in [0, 1], got "
@@ -766,6 +777,52 @@ class SweepSupervisor:
         numerics_records: list = []
 
         def unit_fn(idx: int) -> dict:
+            if (
+                self.profile_every <= 0
+                or directory is None
+                or idx % self.profile_every != 0
+            ):
+                return _unit_body(idx)
+            # Periodic on-chip evidence: this unit dispatches under a
+            # profiler trace, registered into the bundle whether the
+            # unit succeeds or not (a failing unit's trace is exactly
+            # the one that explains the failure).
+            from yuma_simulation_tpu.utils.profiling import profile_trace
+
+            pdir = directory / "profiles" / f"unit{idx:04d}"
+            log_event(
+                logger,
+                "profile_started",
+                mode="unit",
+                unit=idx,
+                artifact=str(pdir),
+            )
+            try:
+                with profile_trace(str(pdir)):
+                    return _unit_body(idx)
+            finally:
+                try:
+                    FlightRecorder(directory).record_profile(
+                        {
+                            "event": "profile_published",
+                            "mode": "unit",
+                            "unit": idx,
+                            "artifact": str(pdir),
+                        }
+                    )
+                    log_event(
+                        logger,
+                        "profile_published",
+                        mode="unit",
+                        unit=idx,
+                        artifact=str(pdir),
+                    )
+                except Exception:  # noqa: BLE001 — contained observation
+                    logger.warning(
+                        "unit profile registration failed", exc_info=True
+                    )
+
+        def _unit_body(idx: int) -> dict:
             from yuma_simulation_tpu.telemetry.slo import observe_duration
 
             lo, hi = units[idx]
@@ -803,9 +860,34 @@ class SweepSupervisor:
                             # The unit-duration SLO signal: wall time of
                             # the accepted execution, retries included
                             # (what the caller actually waited).
-                            observe_duration(
-                                "unit_seconds",
-                                time.perf_counter() - unit_t0,
+                            unit_seconds = time.perf_counter() - unit_t0
+                            observe_duration("unit_seconds", unit_seconds)
+                            # The dispatch timing sketch: keyed by the
+                            # rung that actually ran (post-demotion),
+                            # the plan's shape bucket, and the backend —
+                            # what tools/perfattrib.py joins against the
+                            # AOT cost records. Never raises.
+                            import jax
+
+                            from yuma_simulation_tpu.telemetry.slo import (
+                                observe_dispatch,
+                            )
+
+                            dshape = np.shape(accepted.get("dividends"))
+                            observe_dispatch(
+                                engine=outcome.engine or self.engine,
+                                bucket=(
+                                    plan.bucket.key
+                                    if plan is not None
+                                    else tag
+                                ),
+                                backend=jax.default_backend(),
+                                seconds=unit_seconds,
+                                epochs=(
+                                    int(dshape[0] * dshape[1])
+                                    if len(dshape) >= 2
+                                    else 0
+                                ),
                             )
                             return accepted
                     except BaseException as exc:  # noqa: BLE001 — classified

@@ -40,6 +40,7 @@ def _build_config(args, **overrides) -> "ServeConfig":  # noqa: F821
         replay_epochs_per_snapshot=args.replay_epochs_per_snapshot,
         replay_stride=args.replay_stride,
         api_keys_path=getattr(args, "api_keys", None),
+        flight_rotation=getattr(args, "rotate_flight", False) or None,
     )
 
 
@@ -281,6 +282,14 @@ def main(argv=None) -> int:
         help="signed-API-key keyfile (JSON tenant -> secret): requests "
         "must present a valid X-Api-Key and the verified tenant "
         "replaces any payload claim (typed 401 otherwise)",
+    )
+    parser.add_argument(
+        "--rotate-flight",
+        action="store_true",
+        help="segmented flight-recorder rotation for the bundle: "
+        "spans/metrics/numerics append into crash-safe size/age-bounded "
+        "segments under BUNDLE/segments/ (default: monolithic files; "
+        "YUMA_TPU_FLIGHT_ROTATE=1 also opts in)",
     )
     parser.add_argument(
         "--smoke",

@@ -11,7 +11,18 @@ bounding, so the transport stays dumb:
   JSON request -> :meth:`..serve.service.SimulationService.handle`;
 - ``GET /healthz`` — liveness + queue/breaker/SLO burn state (JSON);
 - ``GET /metrics`` — the process metrics registry in Prometheus text
-  exposition (the PR 4 surface, now scrapeable).
+  exposition (the PR 4 surface, now scrapeable);
+- ``GET /debug/vars`` — the live ops snapshot
+  (:meth:`..telemetry.ops.OpsPlane.debug_vars`): metrics + SLO burn
+  state + dispatch sketches + recent structured events + profiler and
+  segment status;
+- ``GET /debug/spans?run=RUN_ID`` — one run's span tree stitched from
+  the sealed bundle plus the live run context (defaults to the
+  service's own run);
+- ``POST /debug/profile`` — ``{"seconds": N, "mode": "trace"}`` kicks
+  one guarded on-demand ``jax.profiler`` window (single-flight; a
+  concurrent request gets a typed 409, the artifact registers into the
+  flight bundle).
 
 Every response this layer produces is typed JSON (or Prometheus text):
 a malformed body is a structured 400, an unknown route a structured
@@ -90,6 +101,25 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/healthz":
                 self._send_json(200, self.service.healthz())
+            elif self.path == "/debug/vars":
+                # Pure reads under short locks — answering during load
+                # never blocks the dispatcher.
+                self._send_json(200, self.service.ops.debug_vars())
+            elif self.path.startswith("/debug/spans"):
+                import urllib.parse
+
+                query = urllib.parse.urlparse(self.path).query
+                run_id = urllib.parse.parse_qs(query).get("run", [""])[0]
+                try:
+                    self._send_json(
+                        200, self.service.ops.debug_spans(run_id or None)
+                    )
+                except ValueError as exc:
+                    self._send_json(
+                        400,
+                        {"status": "rejected", "error": "InvalidRequest",
+                         "message": str(exc)[:200]},
+                    )
             elif self.path == "/v1/replay" or self.path.startswith(
                 "/v1/replay/"
             ):
@@ -114,9 +144,54 @@ class _Handler(BaseHTTPRequestHandler):
         except BrokenPipeError:  # client went away; nothing to answer
             pass
 
+    def _do_debug_profile(self) -> None:
+        """POST /debug/profile — outside the admission pipeline (an
+        operator action, not tenant traffic): the ops plane's
+        single-flight latch is the only gate, a concurrent window is a
+        typed 409."""
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            length = 0
+        raw = self.rfile.read(max(0, min(length, MAX_BODY_BYTES)))
+        try:
+            payload = json.loads(raw.decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as exc:
+            self._send_json(
+                400,
+                {"status": "rejected", "error": "InvalidJSON",
+                 "message": str(exc)[:200]},
+            )
+            return
+        from yuma_simulation_tpu.telemetry.ops import ProfileBusyError
+
+        try:
+            started = self.service.ops.debug_profile(
+                float(payload.get("seconds", 5.0)),
+                mode=str(payload.get("mode", "trace")),
+            )
+        except ProfileBusyError as exc:
+            self._send_json(
+                409,
+                {"status": "busy", "error": "ProfileBusy",
+                 "message": str(exc), "active": exc.status},
+            )
+            return
+        except (TypeError, ValueError) as exc:
+            self._send_json(
+                400,
+                {"status": "rejected", "error": "InvalidRequest",
+                 "message": str(exc)[:200]},
+            )
+            return
+        self._send_json(200, {"status": "ok", "profile": started})
+
     def do_POST(self) -> None:  # noqa: N802 — http.server API
         self._rid = self.service.mint_request_id()
         try:
+            if self.path == "/debug/profile":
+                self._do_debug_profile()
+                return
             kind = _ROUTES.get(self.path)
             if kind is None:
                 # Responding BEFORE reading the body on a keep-alive
@@ -487,6 +562,27 @@ class SimulationClient:
 
     def healthz(self) -> ServeResponse:
         return self._request("GET", "/healthz")
+
+    def debug_vars(self) -> ServeResponse:
+        """GET /debug/vars — the live ops snapshot."""
+        return self._request("GET", "/debug/vars")
+
+    def debug_spans(self, run_id: Optional[str] = None) -> ServeResponse:
+        """GET /debug/spans[?run=RUN_ID] — one run's live span tree."""
+        path = "/debug/spans"
+        if run_id:
+            import urllib.parse
+
+            path += "?run=" + urllib.parse.quote(run_id)
+        return self._request("GET", path)
+
+    def debug_profile(
+        self, seconds: float = 5.0, mode: str = "trace"
+    ) -> ServeResponse:
+        """POST /debug/profile — kick one on-demand profiler window."""
+        return self._post(
+            "/debug/profile", {"seconds": seconds, "mode": mode}
+        )
 
     def metrics(self) -> str:
         url = self.base_url + "/metrics"

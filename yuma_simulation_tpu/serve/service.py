@@ -145,6 +145,13 @@ class ServeConfig:
     replay_stride: int = 8
     #: LRU bound on cached baseline trajectories.
     replay_max_baselines: int = 64
+    #: Continuous-telemetry rotation for the flight bundle
+    #: (:class:`..telemetry.flight.RotationPolicy`): ``True`` opts in
+    #: with default bounds, a policy instance pins them, ``None``
+    #: (default) defers to the ``YUMA_TPU_FLIGHT_ROTATE`` env opt-in —
+    #: i.e. rotation stays OFF unless explicitly requested, and
+    #: monolithic bundles keep their exact legacy layout.
+    flight_rotation: object = None
     #: Test-only: construct the service without its dispatcher thread
     #: (so queue-bound behavior can be observed deterministically).
     start_dispatcher: bool = True
@@ -283,6 +290,42 @@ class SimulationService:
         bundle_dir = self.config.bundle_dir
         if bundle_dir is not None:
             pathlib.Path(bundle_dir).mkdir(parents=True, exist_ok=True)
+        # Continuous-telemetry mode: resolve the rotation policy ONCE
+        # (config wins, env opt-in otherwise) and thread it through
+        # every FlightRecorder this service constructs, so the flush
+        # path and the close publish agree on the bundle's layout.
+        self._rotation = None
+        if bundle_dir is not None:
+            from yuma_simulation_tpu.telemetry.flight import (
+                FlightRecorder,
+                RotationPolicy,
+                rotation_from_env,
+            )
+
+            fr = self.config.flight_rotation
+            if fr is True:
+                self._rotation = RotationPolicy()
+            elif fr:
+                self._rotation = fr
+            else:
+                self._rotation = rotation_from_env()
+            if self._rotation is not None:
+                # Pin the service's lifetime run: retention must never
+                # reclaim a sealed segment this run's records live in
+                # while the service is still up.
+                FlightRecorder(
+                    bundle_dir, rotation=self._rotation
+                ).mark_run_open(self.run.run_id)
+        # The live ops plane (GET /debug/vars, /debug/spans, POST
+        # /debug/profile): transport-free; the HTTP layer mounts it.
+        from yuma_simulation_tpu.telemetry.ops import OpsPlane
+
+        self.ops = OpsPlane(
+            bundle_dir,
+            registry=self.registry,
+            slo_engine=self.slo,
+            run=self.run,
+        )
         self.ledger = FailureLedger(
             pathlib.Path(bundle_dir) / "ledger.jsonl"
             if bundle_dir is not None
@@ -422,22 +465,22 @@ class SimulationService:
             if len(self._ingress_runs) > 256:
                 flush, self._ingress_runs = self._ingress_runs, []
         if flush and self.config.bundle_dir is not None:
-            from yuma_simulation_tpu.telemetry.flight import (
-                METRICS_NAME,
-                FlightRecorder,
-            )
+            from yuma_simulation_tpu.telemetry.flight import FlightRecorder
 
             try:
                 with self._publish_lock:
                     # Append-only (no whole-file merge) so the unlucky
                     # 257th request's handler thread pays O(batch), not
-                    # O(total-spans); close() merge-republishes.
-                    rec = FlightRecorder(self.config.bundle_dir)
+                    # O(total-spans); close() merge-republishes. Under
+                    # rotation everything lands in the LIVE segment, so
+                    # the cost stays O(batch) however many sealed
+                    # segments have accumulated.
+                    rec = FlightRecorder(
+                        self.config.bundle_dir, rotation=self._rotation
+                    )
                     rec.append_spans(flush)
-                    self.registry.publish_snapshot(
-                        pathlib.Path(self.config.bundle_dir)
-                        / METRICS_NAME,
-                        run_id=self.run.run_id,
+                    rec.snapshot_metrics(
+                        self.registry, run_id=self.run.run_id
                     )
                     rec.record_slo(self.slo, run_id=self.run.run_id)
                     with self._numerics_lock:
@@ -1478,7 +1521,9 @@ class SimulationService:
                 self._numerics_records = []
             try:
                 with self._publish_lock:
-                    recorder = FlightRecorder(self.config.bundle_dir)
+                    recorder = FlightRecorder(
+                        self.config.bundle_dir, rotation=self._rotation
+                    )
                     recorder.record(
                         self.run,
                         registry=self.registry,
@@ -1486,12 +1531,24 @@ class SimulationService:
                         slo_engine=self.slo,
                     )
                     recorder.record_numerics(nrecs, run_id=self.run.run_id)
+                    if self._rotation is not None:
+                        # Graceful exit: release the retention pin and
+                        # seal the tail so the bundle on disk is whole
+                        # (no torn live segment for the next reader).
+                        recorder.mark_run_closed(self.run.run_id)
+                        recorder.seal_live_segment()
             except Exception:
                 logger.warning(
                     "serve flight-bundle publish failed for %s",
                     self.config.bundle_dir,
                     exc_info=True,
                 )
+        try:
+            # Stop any in-flight profile window so the trace publishes
+            # rather than tears with the process.
+            self.ops.close()
+        except Exception:
+            logger.warning("ops-plane close failed", exc_info=True)
         log_event(
             logger,
             "serve_closed",

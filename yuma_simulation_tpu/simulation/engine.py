@@ -1007,11 +1007,13 @@ def simulate(
     from yuma_simulation_tpu.utils.profiling import timed
 
     demotions = None
+    engine_used = epoch_impl
     # The one epoch-rate record per run (satellite of the telemetry
     # tentpole): dispatch + host fetch timed together, routed through
     # the metrics registry (`epochs_total`/`epochs_per_sec`) and emitted
     # as one `event=epoch_rate` line by `timed` on clean exit.
-    with timed(f"simulate:{yuma_version}", epochs=E_):
+    t_dispatch = timed(f"simulate:{yuma_version}", epochs=E_)
+    with t_dispatch:
         if retry_policy is None and deadline is None:
             ys = _dispatch(epoch_impl)
         elif retry_policy is None:
@@ -1025,7 +1027,7 @@ def simulate(
         else:
             from yuma_simulation_tpu.resilience.retry import run_ladder
 
-            ys, _, records = run_ladder(
+            ys, engine_used, records = run_ladder(
                 _dispatch, epoch_impl, retry_policy, rungs=plan.ladder,
                 label=yuma_version, deadline=deadline,
             )
@@ -1035,6 +1037,20 @@ def simulate(
             ys, state_out = ys
             state_out = jax.device_get(state_out)
         ys = jax.device_get(ys)
+    # The always-on dispatch timing seam (continuous telemetry): one
+    # host-side sketch observation per dispatched region, keyed by the
+    # rung that actually ran (post-demotion), the plan's shape bucket,
+    # and the backend — what tools/perfattrib.py joins against the AOT
+    # cost records.
+    from yuma_simulation_tpu.telemetry.slo import observe_dispatch
+
+    observe_dispatch(
+        engine=engine_used,
+        bucket=plan.bucket.key,
+        backend=jax.default_backend(),
+        seconds=t_dispatch.seconds,
+        epochs=E_,
+    )
     return SimulationResult(
         dividends=ys["dividends"],
         bonds=ys.get("bonds"),

@@ -264,6 +264,27 @@ class MetricsRegistry:
         publish_atomic(path, payload.encode())
         return record
 
+    def append_snapshot(
+        self, path: Union[str, pathlib.Path], **meta
+    ) -> dict:
+        """Append one snapshot line to the JSONL sink at `path` WITHOUT
+        re-reading/republishing the whole file — O(one line) however
+        large the sink has grown, via
+        :func:`..utils.checkpoint.append_durable`. The continuous-
+        telemetry twin of :meth:`publish_snapshot` for rotation-mode
+        flight segments: a crash can tear only the appended TAIL line
+        (readers are torn-tail tolerant), and snapshots are cumulative
+        so a lost tail costs one sample, not history. `meta` rides the
+        line; returns the appended record."""
+        from yuma_simulation_tpu.utils.checkpoint import append_durable
+
+        record = {"t": round(time.time(), 6), **meta, **self.snapshot()}
+        append_durable(
+            pathlib.Path(path),
+            (json.dumps(record, sort_keys=True) + "\n").encode(),
+        )
+        return record
+
     def prometheus_text(self) -> str:
         """The registry in Prometheus text exposition format (0.0.4) —
         serve or dump this for scraping; no client library needed.

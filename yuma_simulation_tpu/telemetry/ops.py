@@ -1,0 +1,433 @@
+"""Live operations plane for standing hosts (PR 19).
+
+Every long-lived process in the system — the serve tier, the pool
+workers, the replay controller, the fleet host — already *has* the
+observability substrate (metrics registry, SLO engine, flight
+recorder, dispatch sketches). What it lacked was a way to look at any
+of it **while the process is alive** without killing it and reading
+the bundle. This module is that seam, deliberately transport-free so
+the HTTP layer (:mod:`..serve.server`), the controller and the fleet
+host can all mount the same three surfaces:
+
+- :meth:`OpsPlane.debug_vars` — one JSON snapshot of the process:
+  metrics registry counters/gauges/histograms, SLO evaluation + burn
+  states, the bounded dispatch-sketch table, the recent structured
+  events ring, profiler status, and the flight-segment summary when
+  rotation is on. Pure reads under short locks; never blocks dispatch.
+- :meth:`OpsPlane.debug_spans` — one run's span tree, stitched from
+  the sealed bundle on disk *plus* the live in-memory
+  :class:`.runctx.RunContext` (spans the recorder hasn't flushed yet),
+  rendered through the same :func:`.flight.build_timeline` obsreport
+  uses so live and post-hoc views can never diverge structurally.
+- :meth:`OpsPlane.debug_profile` — guarded on-demand device
+  profiling: a single-flight latch around ``jax.profiler`` (the
+  profiler is a process singleton; two overlapping traces corrupt
+  both), an auto-stop deadline timer so an operator who walks away
+  cannot leave the profiler running forever, and publication of the
+  finished trace directory into the flight bundle
+  (:meth:`.flight.FlightRecorder.record_profile`) so the artifact is
+  discoverable from the bundle, not just a loose directory. A second
+  request while one is in flight raises the typed
+  :class:`ProfileBusyError` (the HTTP layer maps it to 409).
+
+The **events ring**: :func:`.logging.log_event` — already the single
+funnel for every structured recovery/lifecycle record in the package —
+additionally appends each record here (bounded deque, process-global),
+so ``GET /debug/vars`` shows the last ~256 events without any host
+having to plumb a logger handler.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import pathlib
+import threading
+import time
+from typing import Optional, Union
+
+logger = logging.getLogger(__name__)
+
+#: Bound on the recent-events ring: big enough to cover a burst of
+#: recovery records, small enough that /debug/vars stays one screenful.
+EVENTS_RING_SIZE = 256
+
+#: Hard ceiling on one profile window: the auto-stop deadline clamps
+#: here even if the caller asks for more (a trace this long is an
+#: operator error, not a use case).
+MAX_PROFILE_SECONDS = 300.0
+
+#: Profiling modes accepted by :meth:`ProfileSession.start`.
+PROFILE_MODES = ("trace", "memory")
+
+
+# -- recent-events ring ------------------------------------------------
+
+
+class _EventsRing:
+    """Process-global bounded ring of structured log records."""
+
+    def __init__(self, maxlen: int = EVENTS_RING_SIZE):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=maxlen)
+
+    def note(self, event: str, fields: dict) -> None:
+        rec = {"event": str(event), "t": round(time.time(), 6)}
+        for key, value in fields.items():
+            if key not in rec:
+                rec[key] = value if isinstance(
+                    value, (int, float, bool)
+                ) else str(value)
+        with self._lock:
+            self._ring.append(rec)
+
+    def recent(self, limit: int = 64) -> list:
+        with self._lock:
+            items = list(self._ring)
+        if limit > 0:
+            items = items[-int(limit):]
+        return items
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+_EVENTS = _EventsRing()
+
+
+def note_event(event: str, fields: dict) -> None:
+    """Append one structured record to the process ring (called by
+    :func:`..utils.logging.log_event` under its containment wrapper —
+    this function must stay cheap and non-raising under the GIL)."""
+    _EVENTS.note(event, fields)
+
+
+def recent_events(limit: int = 64) -> list:
+    """The newest ``limit`` structured records, oldest first."""
+    return _EVENTS.recent(limit)
+
+
+def clear_events() -> None:
+    """Test hook: empty the ring (process-global state)."""
+    _EVENTS.clear()
+
+
+# -- on-demand device profiling ---------------------------------------
+
+
+class ProfileBusyError(RuntimeError):
+    """A profile window is already in flight (the profiler is a
+    process singleton — overlapping traces corrupt both). Carries the
+    live session status for the HTTP 409 body."""
+
+    def __init__(self, status: dict):
+        super().__init__(
+            "a profile window is already active "
+            f"(mode={status.get('mode')!r}, "
+            f"deadline_t={status.get('deadline_t')})"
+        )
+        self.status = dict(status)
+
+
+class ProfileSession:
+    """Single-flight guard around ``jax.profiler`` with an auto-stop
+    deadline and bundle registration.
+
+    ``mode="trace"`` opens ``jax.profiler.start_trace`` into a fresh
+    ``profiles/trace_NNN_<ts>`` directory under the bundle and arms a
+    :class:`threading.Timer` for ``seconds``; :meth:`stop` (operator or
+    timer, whichever first — idempotent under the latch) closes the
+    trace and appends a ``profile_published`` record to the bundle's
+    ``profiles.jsonl``. ``mode="memory"`` is synchronous: one device
+    memory snapshot (``jax.profiler.save_device_memory_profile``),
+    published immediately, never holds the latch across a window."""
+
+    def __init__(self, bundle_dir: Optional[Union[str, pathlib.Path]]):
+        self.bundle_dir = (
+            pathlib.Path(bundle_dir) if bundle_dir is not None else None
+        )
+        self._lock = threading.Lock()
+        self._active: Optional[dict] = None
+        self._timer: Optional[threading.Timer] = None
+        self._serial = 0
+        self._published = 0
+
+    # -- introspection -------------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            active = dict(self._active) if self._active else None
+        out = {
+            "active": active is not None,
+            "profiles_published": self._published,
+        }
+        if active:
+            out.update(active)
+        return out
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _profiles_root(self) -> pathlib.Path:
+        if self.bundle_dir is None:
+            raise ValueError(
+                "on-demand profiling requires a bundle directory "
+                "(the trace artifact must register somewhere)"
+            )
+        root = self.bundle_dir / "profiles"
+        root.mkdir(parents=True, exist_ok=True)
+        return root
+
+    def start(self, seconds: float, mode: str = "trace") -> dict:
+        """Begin one profile window. Raises :class:`ProfileBusyError`
+        when a window is already active, :class:`ValueError` on an
+        unknown mode, a non-positive duration, or a host with no
+        bundle directory."""
+        if mode not in PROFILE_MODES:
+            raise ValueError(
+                f"unknown profile mode {mode!r} "
+                f"(expected one of {PROFILE_MODES})"
+            )
+        seconds = float(seconds)
+        if not seconds > 0:
+            raise ValueError(f"profile seconds must be > 0, got {seconds}")
+        seconds = min(seconds, MAX_PROFILE_SECONDS)
+        with self._lock:
+            if self._active is not None:
+                raise ProfileBusyError(dict(self._active))
+            self._serial += 1
+            serial = self._serial
+            stamp = int(time.time())
+            if mode == "memory":
+                # Synchronous one-shot: never holds the latch open.
+                path = self._profiles_root() / (
+                    f"memory_{serial:03d}_{stamp}.prof"
+                )
+                import jax
+
+                jax.profiler.save_device_memory_profile(str(path))
+                return self._publish(
+                    {
+                        "mode": "memory",
+                        "serial": serial,
+                        "artifact": str(path),
+                        "seconds": 0.0,
+                    }
+                )
+            trace_dir = self._profiles_root() / (
+                f"trace_{serial:03d}_{stamp}"
+            )
+            import jax
+
+            jax.profiler.start_trace(str(trace_dir))
+            self._active = {
+                "mode": "trace",
+                "serial": serial,
+                "artifact": str(trace_dir),
+                "seconds": seconds,
+                "t_started": round(time.time(), 6),
+                "deadline_t": round(time.time() + seconds, 6),
+            }
+            self._timer = threading.Timer(seconds, self._auto_stop)
+            self._timer.daemon = True
+            self._timer.start()
+            started = dict(self._active)
+        from yuma_simulation_tpu.utils.logging import log_event
+
+        log_event(
+            logger,
+            "profile_started",
+            mode=started["mode"],
+            seconds=started["seconds"],
+            artifact=started["artifact"],
+        )
+        return started
+
+    def _auto_stop(self) -> None:
+        try:
+            self.stop()
+        except Exception:  # noqa: BLE001 — the timer thread must die quiet
+            logger.warning("profile auto-stop failed", exc_info=True)
+
+    def stop(self) -> Optional[dict]:
+        """Close the active trace window (idempotent: returns ``None``
+        when no window is open — the timer and an operator stop racing
+        is the normal case, not an error)."""
+        with self._lock:
+            active = self._active
+            self._active = None
+            timer, self._timer = self._timer, None
+        if active is None:
+            return None
+        if timer is not None:
+            timer.cancel()
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — a torn trace still gets a record
+            logger.warning("jax.profiler.stop_trace failed", exc_info=True)
+        return self._publish(active)
+
+    def _publish(self, rec: dict) -> dict:
+        record = {
+            "event": "profile_published",
+            "mode": rec["mode"],
+            "serial": rec["serial"],
+            "artifact": rec["artifact"],
+            "seconds": rec["seconds"],
+        }
+        try:
+            from yuma_simulation_tpu.telemetry.flight import FlightRecorder
+
+            FlightRecorder(self.bundle_dir).record_profile(record)
+        except Exception:  # noqa: BLE001 — publication must not kill stop()
+            logger.warning("profile registration failed", exc_info=True)
+        self._published += 1
+        from yuma_simulation_tpu.utils.logging import log_event
+
+        log_event(
+            logger,
+            "profile_published",
+            mode=record["mode"],
+            artifact=record["artifact"],
+        )
+        return record
+
+    def close(self) -> None:
+        """Host shutdown: stop any window so the trace is published
+        rather than torn."""
+        self.stop()
+
+
+# -- the ops plane -----------------------------------------------------
+
+
+class OpsPlane:
+    """Transport-free debug surface shared by every standing host.
+
+    The HTTP layer (serve tier), the replay controller and the fleet
+    host each construct one of these with whatever substrate they
+    actually have — every argument beyond ``bundle_dir`` is optional,
+    and missing pieces simply leave their section out of
+    :meth:`debug_vars` rather than failing the whole snapshot."""
+
+    def __init__(
+        self,
+        bundle_dir: Optional[Union[str, pathlib.Path]] = None,
+        *,
+        registry=None,
+        slo_engine=None,
+        run=None,
+    ):
+        self.bundle_dir = (
+            pathlib.Path(bundle_dir) if bundle_dir is not None else None
+        )
+        self.registry = registry
+        self.slo_engine = slo_engine
+        self.run = run
+        self.profile = ProfileSession(self.bundle_dir)
+
+    # -- /debug/vars ---------------------------------------------------
+
+    def _segments_summary(self) -> dict:
+        from yuma_simulation_tpu.telemetry import flight
+
+        if self.bundle_dir is None:
+            return {"rotation": False}
+        root = self.bundle_dir / flight.SEGMENTS_DIR
+        if not root.is_dir():
+            return {"rotation": False}
+        rec = flight.FlightRecorder(self.bundle_dir)
+        segs = rec._segment_dirs()
+        sealed = [s for s in segs if rec._segment_sealed(s)]
+        out = {
+            "rotation": True,
+            "segments_total": len(segs),
+            "segments_sealed": len(sealed),
+            "bytes_retained": sum(
+                rec._segment_bytes(s) for s in sealed
+            ),
+            "open_runs": rec.open_run_ids(),
+        }
+        tomb = self.bundle_dir / flight.COMPACTED_NAME
+        if tomb.exists():
+            try:
+                import json
+
+                out["compacted"] = json.loads(tomb.read_text())
+            except (OSError, ValueError):
+                pass
+        return out
+
+    def debug_vars(self) -> dict:
+        """One non-blocking snapshot of the live process. Every section
+        is independently contained: a wedged subsystem hides its own
+        section instead of taking the endpoint down."""
+        out: dict = {"t": round(time.time(), 6)}
+        if self.registry is not None:
+            try:
+                out["metrics"] = self.registry.snapshot()
+            except Exception:  # noqa: BLE001
+                logger.warning("debug_vars metrics failed", exc_info=True)
+        if self.slo_engine is not None:
+            try:
+                out["slo"] = self.slo_engine.evaluate()
+            except Exception:  # noqa: BLE001
+                logger.warning("debug_vars slo failed", exc_info=True)
+        try:
+            from yuma_simulation_tpu.telemetry.slo import dispatch_snapshot
+
+            sketches = dispatch_snapshot()
+            if sketches:
+                out["dispatch_sketches"] = sketches
+        except Exception:  # noqa: BLE001
+            logger.warning("debug_vars sketches failed", exc_info=True)
+        out["events"] = recent_events()
+        out["profile"] = self.profile.status()
+        try:
+            out["segments"] = self._segments_summary()
+        except Exception:  # noqa: BLE001
+            logger.warning("debug_vars segments failed", exc_info=True)
+        return out
+
+    # -- /debug/spans --------------------------------------------------
+
+    def debug_spans(self, run_id: Optional[str] = None) -> dict:
+        """One run's span tree, stitched from the sealed bundle plus
+        the live (unflushed) run context. Defaults to the host's own
+        run when no ``run_id`` is given."""
+        from yuma_simulation_tpu.telemetry.flight import (
+            build_timeline,
+            load_bundle,
+        )
+
+        if not run_id and self.run is not None:
+            run_id = self.run.run_id
+        if not run_id:
+            raise ValueError("no run_id given and the host has no run")
+        if self.bundle_dir is None:
+            raise ValueError(
+                "span inspection requires a bundle directory"
+            )
+        bundle = load_bundle(self.bundle_dir)
+        if self.run is not None and self.run.run_id == run_id:
+            # Stitch in live (unflushed) spans: the bundle's copy of a
+            # span wins (it is the sealed truth), the live ring only
+            # fills in what the recorder hasn't published yet.
+            seen = {
+                (s.get("run_id"), s.get("span_id")) for s in bundle.spans
+            }
+            for s in self.run.span_records():
+                if (s.get("run_id"), s.get("span_id")) not in seen:
+                    bundle.spans.append(s)
+        return build_timeline(bundle, run_id)
+
+    # -- /debug/profile ------------------------------------------------
+
+    def debug_profile(self, seconds: float, mode: str = "trace") -> dict:
+        """Kick one guarded profile window; see :class:`ProfileSession`."""
+        return self.profile.start(seconds, mode=mode)
+
+    def close(self) -> None:
+        self.profile.close()

@@ -61,7 +61,7 @@ class MetricSpec:
     """One declared metric series (kind pinned so a counter cannot
     silently become a gauge across a refactor)."""
 
-    kind: str  # "counter" | "gauge" | "histogram"
+    kind: str  # "counter" | "gauge" | "histogram" | "sketch"
     summary: str
     consumers: tuple = ()
     operator_reason: str = ""
@@ -399,6 +399,31 @@ EVENTS = {
         "reason; the entry is excluded and the subnet keeps draining)",
         consumers=("obsreport",),
     ),
+    # -- continuous telemetry plane (telemetry.flight / telemetry.ops) ----
+    "segment_sealed": EventSpec(
+        "the flight recorder's live rotation segment hit a size/age "
+        "bound (or was sealed at close) and published its seal.json "
+        "(record carries segment name, bytes, run ids)",
+        consumers=("obsreport",),
+    ),
+    "segments_compacted": EventSpec(
+        "retention reclaimed sealed segments past the policy's byte "
+        "bound and merged them into the compacted.json tombstone that "
+        "exempts their runs from span checks",
+        consumers=("obsreport",),
+    ),
+    "profile_started": EventSpec(
+        "an on-demand device-profiling window opened (POST "
+        "/debug/profile, SweepSupervisor profile_every, or the replay "
+        "controller's --profile-window; record carries mode, artifact "
+        "dir, deadline)",
+        consumers=("obsreport",),
+    ),
+    "profile_published": EventSpec(
+        "a profiling window closed and its trace artifact was "
+        "registered into the bundle's profiles.jsonl",
+        consumers=("obsreport",),
+    ),
 }
 
 
@@ -571,6 +596,23 @@ METRICS = {
         "controller",
         consumers=("obsreport",),
     ),
+    # -- continuous telemetry plane (telemetry.flight / telemetry.slo) ----
+    "telemetry_segments_total": MetricSpec(
+        "counter", "flight-recorder segments sealed by rotation",
+        consumers=("obsreport",),
+    ),
+    "telemetry_bytes_retained": MetricSpec(
+        "gauge", "bytes currently retained across sealed rotation "
+        "segments (post-compaction)",
+        consumers=("obsreport",),
+    ),
+    "dispatch_seconds": MetricSpec(
+        "sketch", "always-on per-(engine rung x shape bucket x backend) "
+        "dispatch wall-time quantile sketches (DispatchStats), riding "
+        "metrics lines as the dispatch_sketches field; "
+        "tools/perfattrib.py joins them against cost/roofline records",
+        consumers=("obsreport",),
+    ),
     # -- SLO engine ------------------------------------------------------
     "slo_alerts_total": MetricSpec(
         "counter", "burn-rate alert transitions (any direction)",
@@ -605,6 +647,8 @@ def validate_registry() -> list:
                 f"event {name!r}: no consumers and no operator_reason"
             )
     for name, spec in METRICS.items():
-        if spec.kind not in ("counter", "gauge", "histogram"):
+        # "sketch" (0.23.0): a quantile-sketch family riding metrics
+        # lines (dispatch_seconds) rather than a registry series.
+        if spec.kind not in ("counter", "gauge", "histogram", "sketch"):
             problems.append(f"metric {name!r}: unknown kind {spec.kind!r}")
     return problems

@@ -672,3 +672,168 @@ def observe_event(metric: str, ok: bool) -> None:
         get_slo_engine().event(metric, ok)
     except Exception:
         logger.warning("SLO event failed for %s", metric, exc_info=True)
+
+
+# --------------------------------------------- dispatch timing sketches
+
+#: Hard cardinality bound on (engine x bucket x backend) keys: far past
+#: any real serving mix (the canary LRU keeps 32 shapes), tight enough
+#: that a hostile shape-per-request client cannot grow process memory.
+MAX_DISPATCH_KEYS = 64
+
+#: The fold-in key once the bound is hit — measured time is never
+#: dropped, it just loses per-shape attribution past the bound.
+DISPATCH_OVERFLOW_KEY = "overflow"
+
+
+class _DispatchEntry:
+    __slots__ = ("engine", "bucket", "backend", "sketch", "dispatches",
+                 "epochs_total", "seconds_total")
+
+    def __init__(self, engine: str, bucket: str, backend: str):
+        self.engine = engine
+        self.bucket = bucket
+        self.backend = backend
+        self.sketch = LatencySketch()
+        self.dispatches = 0
+        self.epochs_total = 0
+        self.seconds_total = 0.0
+
+
+class DispatchStats:
+    """Always-on per-(engine rung x shape bucket x backend) dispatch
+    timing: a :class:`LatencySketch` of wall seconds plus epoch/second
+    totals per key, bounded at ``max_keys`` (the overflow key absorbs
+    the tail). Fed host-side at the dispatch seam (one observe per
+    dispatched region — O(1), no device sync of its own); snapshots
+    ride flight-bundle metrics lines as the ``dispatch_sketches``
+    field, which ``tools/perfattrib.py`` joins against the bundle's
+    cost/roofline records into the measured-vs-predicted table."""
+
+    def __init__(self, max_keys: int = MAX_DISPATCH_KEYS):
+        self.max_keys = int(max_keys)
+        self._lock = threading.Lock()
+        self._entries: dict[str, _DispatchEntry] = {}
+
+    @staticmethod
+    def key_for(engine: str, bucket: str, backend: str) -> str:
+        return f"{engine}|{bucket}|{backend}"
+
+    def observe(
+        self,
+        *,
+        engine: str,
+        bucket: str,
+        backend: str,
+        seconds: float,
+        epochs: int = 0,
+    ) -> None:
+        key = self.key_for(engine, bucket, backend)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                if len(self._entries) >= self.max_keys:
+                    key = DISPATCH_OVERFLOW_KEY
+                    entry = self._entries.get(key)
+                    if entry is None:
+                        entry = self._entries[key] = _DispatchEntry(
+                            DISPATCH_OVERFLOW_KEY, "", ""
+                        )
+                else:
+                    entry = self._entries[key] = _DispatchEntry(
+                        engine, bucket, backend
+                    )
+            entry.dispatches += 1
+            entry.epochs_total += int(epochs)
+            entry.seconds_total += float(seconds)
+        entry.sketch.observe(seconds)
+
+    def snapshot(self) -> dict:
+        """``{key: {engine, bucket, backend, dispatches, epochs_total,
+        seconds_total, sketch}}`` — sketches serialized
+        (:meth:`LatencySketch.to_json`), so snapshots merge exactly
+        after the fact. Cumulative over process life: a consumer
+        reading a snapshot stream keeps the highest-count line per
+        key."""
+        with self._lock:
+            entries = dict(self._entries)
+        out = {}
+        for key, e in sorted(entries.items()):
+            out[key] = {
+                "engine": e.engine,
+                "bucket": e.bucket,
+                "backend": e.backend,
+                "dispatches": e.dispatches,
+                "epochs_total": e.epochs_total,
+                "seconds_total": round(e.seconds_total, 6),
+                "sketch": e.sketch.to_json(),
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_DISPATCH_STATS = DispatchStats()
+
+#: Process-wide kill switch for the dispatch-timing seam. Exists for
+#: exactly one honest measurement: bench.py times the same dispatch
+#: path observation-on vs observation-off to put a number on the
+#: seam's own cost (perfgate gates `dispatch_sketch.overhead_frac`).
+#: Production code never flips it.
+_OBSERVE_ENABLED = True
+
+
+def set_dispatch_observation(enabled: bool) -> bool:
+    """Enable/disable :func:`observe_dispatch` process-wide; returns
+    the previous setting so callers can restore it."""
+    global _OBSERVE_ENABLED
+    prev = _OBSERVE_ENABLED
+    _OBSERVE_ENABLED = bool(enabled)
+    return prev
+
+
+def get_dispatch_stats() -> DispatchStats:
+    """The process-wide dispatch timing table (see
+    :class:`DispatchStats`)."""
+    return _DISPATCH_STATS
+
+
+def observe_dispatch(
+    *,
+    engine: str,
+    bucket: str,
+    backend: str,
+    seconds: float,
+    epochs: int = 0,
+) -> None:
+    """Feed one dispatched region's wall time into the process table.
+    Host-side only, never raises — the measurement must not fail the
+    dispatch it measures."""
+    if not _OBSERVE_ENABLED:
+        return
+    try:
+        _DISPATCH_STATS.observe(
+            engine=engine,
+            bucket=bucket,
+            backend=backend,
+            seconds=seconds,
+            epochs=epochs,
+        )
+    except Exception:
+        logger.warning(
+            "dispatch timing observation failed for %s", engine,
+            exc_info=True,
+        )
+
+
+def dispatch_snapshot() -> dict:
+    """The process dispatch table, serialized ({} when nothing has
+    dispatched) — what flight-bundle metrics lines carry as
+    ``dispatch_sketches``."""
+    try:
+        return _DISPATCH_STATS.snapshot()
+    except Exception:
+        logger.warning("dispatch sketch snapshot failed", exc_info=True)
+        return {}

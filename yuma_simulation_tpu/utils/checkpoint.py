@@ -160,8 +160,25 @@ def append_durable(path: pathlib.Path, data: bytes) -> None:
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     created = not path.exists()
+    heal = b""
+    if not created:
+        # A predecessor killed mid-append leaves a torn tail with no
+        # newline; appending straight after it would weld the fragment
+        # onto THIS batch's first record and lose both. Terminating the
+        # tail first confines the damage to the one already-torn line
+        # (which the tolerant reader drops). Matters most under
+        # segment rotation, where no later merge republish heals tails.
+        try:
+            with open(path, "rb") as r:
+                r.seek(0, os.SEEK_END)
+                if r.tell() > 0:
+                    r.seek(-1, os.SEEK_END)
+                    if r.read(1) != b"\n":
+                        heal = b"\n"
+        except OSError:
+            heal = b""
     with open(path, "ab") as f:
-        f.write(data)
+        f.write(heal + data)
         f.flush()
         os.fsync(f.fileno())
     if created:

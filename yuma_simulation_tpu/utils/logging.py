@@ -41,6 +41,15 @@ def log_event(
         # Telemetry must never break logging (import cycles during
         # interpreter teardown, partial installs).
         pass
+    try:
+        # The live-ops recent-events ring (telemetry.ops): every
+        # structured record is also visible on GET /debug/vars of a
+        # standing host. Same containment contract as above.
+        from yuma_simulation_tpu.telemetry.ops import note_event
+
+        note_event(event, fields)
+    except Exception:
+        pass
 
     def fmt(v) -> str:
         s = str(v)
