@@ -156,6 +156,13 @@ def soak(session: nox.Session) -> None:
     session.run(
         "python", "-m", "tools.obsreport", bundle + "/serve", "--check",
     )
+    session.run(
+        "python", "-m", "tools.incidentreport", bundle + "/store", "--check",
+    )
+    session.run(
+        "python", "-m", "tools.incidentreport",
+        bundle + "/serve", "--expect-none",
+    )
 
 
 @nox.session
@@ -184,6 +191,11 @@ def chaos(session: nox.Session) -> None:
     # fingerprint stream must compare drift-clean.
     session.run(
         "python", "-m", "tools.driftreport", bundle, "--check", "--require",
+    )
+    # Incident gate: every typed fault the drill ledgered must belong
+    # to a correlated incident with a cause candidate.
+    session.run(
+        "python", "-m", "tools.incidentreport", bundle, "--check",
     )
 
 
@@ -381,6 +393,22 @@ def slo(session: nox.Session) -> None:
         "python", "-m", "pytest",
         "tests/unit/test_slo.py", "tests/unit/test_propagation.py",
         "-q",
+    )
+
+
+@nox.session
+def incidents(session: nox.Session) -> None:
+    """Incident-intelligence lane (ISSUE 20): detector-math property
+    tests (MAD single-outlier / level-shift / reseed, counter stall,
+    saturation, the clean-run zero-firing bound), the order-independent
+    time-series merge property, correlation per cause class with the
+    clean-ledger zero-incident bound, durable incidents.jsonl state,
+    the incidentreport tamper/malformed exit codes, and the O(new
+    bytes) --follow regression."""
+    session.install("-e", ".[test]")
+    session.run(
+        "python", "-m", "pytest", "tests/unit/test_incidents.py", "-q",
+        env={"JAX_PLATFORMS": "cpu"},
     )
 
 

@@ -1,0 +1,642 @@
+"""Incident intelligence (ISSUE 20): the time-series store, the robust
+anomaly detectors, cross-signal incident correlation, and the
+incidentreport gate.
+
+The contract under test: detector math is provably quiet on clean
+series (declared windows + min-samples) and fires ONCE per excursion
+with reseed-after-recovery; the time-series merge is order-independent
+and deduplicable across process bundles via the monotone ``seq``
+stamp; correlation opens exactly one incident per (cause class,
+subject) with the matching typed ledger event as its suspected cause
+and ZERO incidents on clean ledgers; incident state is durable
+(``incidents.jsonl``, torn-tail tolerant, restart-merged); and
+``incidentreport --check`` fails when an incident is deleted out from
+under its cause (tamper) or lacks a cause candidate."""
+
+from __future__ import annotations
+
+import json
+import random
+
+from yuma_simulation_tpu.telemetry.anomaly import (
+    AnomalyEngine,
+    CounterStallDetector,
+    MadDetector,
+    RateOfChangeDetector,
+    SaturationDetector,
+    default_replay_engine,
+)
+from yuma_simulation_tpu.telemetry.incident import (
+    IncidentEngine,
+    correlate,
+    latest_incidents,
+    load_incidents,
+    open_incident_count,
+)
+from yuma_simulation_tpu.telemetry.timeseries import (
+    TimeSeriesStore,
+    store_from_metrics,
+)
+
+VERSION = "Yuma 2 (Adrian-Fish)"
+
+
+def _snapshots(n, *, source, start=0.0, gauge=5.0, jitter=None):
+    """n metrics.jsonl-shaped records with monotone seq, 1s apart."""
+    rng = jitter or (lambda i: 0.0)
+    return [
+        {
+            "t": start + i,
+            "seq": i + 1,
+            "source": source,
+            "counters": {"windows_swept_total": float(i)},
+            "gauges": {"replay_staleness_seconds": gauge + rng(i)},
+        }
+        for i in range(n)
+    ]
+
+
+# ------------------------------------------------------ time-series store
+
+
+class TestTimeSeriesStore:
+    def test_merge_is_order_independent_and_deduped(self):
+        """The satellite property: randomized interleavings of the same
+        multi-process record set (duplicates included) fold to the SAME
+        series."""
+        a = _snapshots(20, source="router")
+        b = _snapshots(20, source="worker", start=0.5, gauge=7.0)
+        reference = TimeSeriesStore()
+        reference.ingest_many(a + b)
+        for trial in range(6):
+            rng = random.Random(trial)
+            shuffled = a + b + rng.sample(a, 10)  # replayed duplicates
+            rng.shuffle(shuffled)
+            store = TimeSeriesStore()
+            new = store.ingest_many(shuffled)
+            assert new == 40  # every duplicate dropped
+            for key in reference.keys():
+                assert store.series(key) == reference.series(key), (
+                    f"series {key} diverged under interleaving {trial}"
+                )
+
+    def test_ring_is_bounded(self):
+        store = TimeSeriesStore(capacity=8)
+        store.ingest_many(_snapshots(50, source="a"))
+        series = store.series("gauge:replay_staleness_seconds")
+        assert len(series) == 8
+        assert series[-1][0] == 49.0  # newest retained
+
+    def test_sketch_quantiles_extracted(self):
+        from yuma_simulation_tpu.telemetry.slo import LatencySketch
+
+        sk = LatencySketch()
+        for v in (0.01, 0.02, 0.04, 0.08, 0.5):
+            sk.observe(v)
+        store = TimeSeriesStore()
+        store.ingest_snapshot(
+            {
+                "t": 1.0,
+                "seq": 1,
+                "dispatch_sketches": {
+                    "xla|E4xV3xM5|cpu": {"sketch": sk.to_json()}
+                },
+            },
+            source="s",
+        )
+        p50 = store.latest("sketch:xla|E4xV3xM5|cpu:p50")
+        p99 = store.latest("sketch:xla|E4xV3xM5|cpu:p99")
+        assert p50 is not None and p99 is not None
+        assert p99[1] >= p50[1] > 0
+
+    def test_registry_snapshots_carry_monotone_seq(self, tmp_path):
+        from yuma_simulation_tpu.telemetry.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("windows_swept_total").inc()
+        r1 = reg.append_snapshot(tmp_path / "m.jsonl")
+        r2 = reg.publish_snapshot(tmp_path / "m2.jsonl")
+        r3 = reg.append_snapshot(tmp_path / "m.jsonl")
+        assert r1["seq"] < r2["seq"] < r3["seq"]
+        on_disk = [
+            json.loads(line)
+            for line in (tmp_path / "m.jsonl").read_text().splitlines()
+        ]
+        assert [r["seq"] for r in on_disk] == [r1["seq"], r3["seq"]]
+        # store_from_metrics round-trips the stamped records
+        store = store_from_metrics(on_disk, source="p")
+        assert len(store.series("counter:windows_swept_total")) == 2
+
+
+# ------------------------------------------------------------- detectors
+
+
+class TestDetectors:
+    def test_mad_single_outlier_fires_once(self):
+        det = MadDetector("g", window=16, min_samples=8, threshold=6.0,
+                          mad_floor=0.5)
+        fired = []
+        for i in range(20):
+            a = det.observe(float(i), 5.0 + (i % 3) * 0.1)
+            assert a is None, "clean series must stay quiet"
+        a = det.observe(20.0, 500.0)
+        fired.append(a)
+        assert a is not None and a.kind == "mad" and a.value == 500.0
+        # still in the same excursion: latched, no re-fire
+        assert det.observe(21.0, 400.0) is None
+
+    def test_mad_level_shift_fires_once_and_reseeds_after_recovery(self):
+        det = MadDetector("g", window=16, min_samples=8, threshold=6.0,
+                          mad_floor=0.5)
+        for i in range(12):
+            det.observe(float(i), 10.0 + (i % 2) * 0.2)
+        shift = [det.observe(12.0 + i, 60.0) for i in range(10)]
+        assert sum(a is not None for a in shift) == 1, (
+            "a sustained level shift is ONE anomaly, not one per sample"
+        )
+        # recovery: samples rejoin the baseline, latch releases...
+        for i in range(12):
+            assert det.observe(30.0 + i, 10.0 + (i % 2) * 0.2) is None
+        # ...and the NEXT excursion is a fresh firing
+        again = det.observe(50.0, 60.0)
+        assert again is not None
+
+    def test_mad_quiet_below_min_samples(self):
+        det = MadDetector("g", window=16, min_samples=8)
+        for i in range(7):
+            assert det.observe(float(i), 1e9 * i) is None
+
+    def test_rate_of_change_fires_on_slope(self):
+        det = RateOfChangeDetector("g", max_per_second=10.0, min_samples=2)
+        assert det.observe(0.0, 0.0) is None
+        assert det.observe(1.0, 5.0) is None
+        a = det.observe(2.0, 500.0)
+        assert a is not None and a.kind == "rate_of_change"
+        assert det.observe(3.0, 505.0) is None  # slope back under
+
+    def test_counter_stall_needs_advancing_activity(self):
+        store = TimeSeriesStore()
+        quiet = CounterStallDetector(
+            "counter:windows_swept_total",
+            "counter:cycles_total",
+            horizon_seconds=10.0,
+        )
+        # target frozen but activity frozen too: nothing was asked
+        for i in range(30):
+            store.ingest_snapshot(
+                {"t": float(i), "seq": i + 1,
+                 "counters": {"windows_swept_total": 4.0,
+                              "cycles_total": 2.0}},
+                source="a",
+            )
+        assert quiet.scan(store) == []
+        # activity advances while the target stays frozen: a real stall
+        store2 = TimeSeriesStore()
+        det = CounterStallDetector(
+            "counter:windows_swept_total",
+            "counter:cycles_total",
+            horizon_seconds=10.0,
+        )
+        for i in range(30):
+            store2.ingest_snapshot(
+                {"t": float(i), "seq": i + 1,
+                 "counters": {"windows_swept_total": 4.0,
+                              "cycles_total": float(i)}},
+                source="a",
+            )
+        fired = det.scan(store2)
+        assert len(fired) == 1 and fired[0].kind == "counter_stall"
+        assert det.scan(store2) == []  # latched until the target moves
+
+    def test_saturation_fires_after_consecutive_samples(self):
+        det = SaturationDetector("gauge:queue_depth", capacity=100.0,
+                                 min_samples=3)
+        store = TimeSeriesStore()
+        depths = [50, 96, 97, 40, 98, 99, 97, 96]
+        for i, d in enumerate(depths):
+            store.ingest_snapshot(
+                {"t": float(i), "seq": i + 1,
+                 "gauges": {"queue_depth": float(d)}},
+                source="a",
+            )
+        fired = det.scan(store)
+        # the 40 resets the run: only the second streak reaches 3
+        assert len(fired) == 1 and fired[0].t == 6.0
+
+    def test_default_replay_engine_quiet_on_clean_feed(self):
+        """The clean false-positive bound: steady staleness jitter on
+        the default controller wiring produces ZERO anomalies."""
+        engine = default_replay_engine()
+        store = TimeSeriesStore()
+        rng = random.Random(7)
+        for i in range(200):
+            store.ingest_snapshot(
+                {"t": float(i), "seq": i + 1,
+                 "gauges": {
+                     "replay_staleness_seconds": 3.0 + rng.random()
+                 }},
+                source="ctl",
+            )
+        assert engine.scan(store) == []
+
+
+# ------------------------------------------------------------ correlation
+
+
+def _ledger_records():
+    return [
+        {"event": "subnet_quarantined", "t": 10.0, "netuid": 7,
+         "block": 1100, "reason": "digest mismatch", "run_id": "r1",
+         "span_id": "s1"},
+        {"event": "subnet_stalled", "t": 20.0, "netuid": 3,
+         "stalled_seconds": 40.0, "run_id": "r1", "span_id": "s2"},
+        {"event": "anomaly_detected", "t": 24.0, "kind": "mad",
+         "series": "gauge:replay_staleness_seconds", "run_id": "r1",
+         "span_id": "s2"},
+        {"event": "slo_alert", "t": 26.0, "slo": "replay_fresh",
+         "run_id": "r1"},
+        {"event": "controller_restarted", "t": 40.0, "run": "r0",
+         "run_id": "r2", "span_id": "s9"},
+        {"event": "watermark_advanced", "t": 50.0, "netuid": 5,
+         "block": 1200, "run_id": "r2"},
+    ]
+
+
+class TestCorrelation:
+    def test_each_cause_class_yields_exactly_one_incident(self):
+        incidents = correlate(_ledger_records())
+        by_class = {i.cause_class: i for i in incidents}
+        assert set(by_class) == {
+            "snapshot-corruption", "subnet-stall", "process-loss"
+        }
+        assert by_class["snapshot-corruption"].cause["event"] == (
+            "subnet_quarantined"
+        )
+        assert by_class["subnet-stall"].subject == "netuid=3"
+        # recurrence of the same (class, subject) folds, never forks
+        doubled = _ledger_records() + [
+            {"event": "subnet_stalled", "t": 70.0, "netuid": 3,
+             "stalled_seconds": 90.0, "run_id": "r2"}
+        ]
+        assert len(correlate(doubled)) == 3
+
+    def test_symptoms_attach_and_never_open(self):
+        incidents = correlate(_ledger_records())
+        stall = next(i for i in incidents if i.cause_class == "subnet-stall")
+        kinds = [s["kind"] for s in stall.symptoms]
+        assert "anomaly" in kinds  # span-adjacent detector firing
+        # symptom-only ledgers open NOTHING (the control-arm bound)
+        assert correlate([
+            {"event": "anomaly_detected", "t": 1.0, "series": "g"},
+            {"event": "slo_alert", "t": 2.0, "slo": "serve_ok"},
+            {"event": "unit_ok", "t": 3.0, "unit": 4},
+        ]) == []
+
+    def test_resolution_states(self):
+        incidents = correlate(_ledger_records())
+        by_class = {i.cause_class: i for i in incidents}
+        # quarantine IS the mitigation
+        assert by_class["snapshot-corruption"].state == "resolved"
+        assert by_class["snapshot-corruption"].resolution == "quarantined"
+        # progress after restart resolves the process loss
+        assert by_class["process-loss"].state == "resolved"
+        assert by_class["process-loss"].resolution == "watermark_advanced"
+        # the stalled subnet never resumed
+        assert by_class["subnet-stall"].state == "open"
+        # a subject-matched recovery resolves the stall
+        recovered = correlate(
+            _ledger_records()
+            + [{"event": "subnet_ingested", "t": 90.0, "netuid": 3,
+                "new_blocks": 2, "head_block": 1300}]
+        )
+        stall = next(
+            i for i in recovered if i.cause_class == "subnet-stall"
+        )
+        assert stall.state == "resolved"
+
+    def test_latest_incidents_keeps_last_record_per_id(self):
+        opened = {"incident": "subnet-stall:netuid=3", "state": "open",
+                  "opened_t": 1.0}
+        resolved = dict(opened, state="resolved", resolved_t=9.0)
+        assert latest_incidents([opened, resolved]) == [resolved]
+        assert latest_incidents([resolved, opened]) == [opened]
+
+
+# ------------------------------------------------- durable incident state
+
+
+class TestDurableState:
+    def test_record_incident_appends_and_survives_torn_tail(self, tmp_path):
+        from yuma_simulation_tpu.telemetry.flight import (
+            FlightRecorder,
+            INCIDENTS_NAME,
+            load_bundle,
+        )
+
+        rec = FlightRecorder(tmp_path)
+        rec.record_incident(
+            {"incident": "subnet-stall:netuid=3", "state": "open",
+             "opened_t": 1.0, "cause_class": "subnet-stall"}
+        )
+        rec.record_incident(
+            {"incident": "subnet-stall:netuid=3", "state": "resolved",
+             "opened_t": 1.0, "resolved_t": 5.0,
+             "cause_class": "subnet-stall"}
+        )
+        with open(tmp_path / INCIDENTS_NAME, "ab") as fh:
+            fh.write(b'{"incident": "torn')  # SIGKILL mid-append
+        bundle = load_bundle(tmp_path)
+        assert len(bundle.incidents) == 2  # torn tail dropped, not fatal
+        current = load_incidents(tmp_path)
+        assert len(current) == 1 and current[0]["state"] == "resolved"
+        assert open_incident_count(tmp_path) == 0
+
+    def test_engine_ticks_open_resolve_and_restart_dedupe(self, tmp_path):
+        from yuma_simulation_tpu.resilience.supervisor import FailureLedger
+        from yuma_simulation_tpu.telemetry.flight import FlightRecorder
+        from yuma_simulation_tpu.telemetry.metrics import MetricsRegistry
+
+        ledger = FailureLedger(tmp_path / "ledger.jsonl")
+        recorder = FlightRecorder(tmp_path)
+        reg = MetricsRegistry()
+        engine = IncidentEngine(ledger, recorder, registry=reg,
+                                anomaly_engine=AnomalyEngine())
+        ledger.append("subnet_stalled", netuid=3, head_block=1100,
+                      stalled_seconds=40.0)
+        incidents = engine.tick(now=100.0)
+        assert [i.state for i in incidents] == ["open"]
+        assert reg.snapshot()["gauges"]["incidents_open"] == 1
+        opened = ledger.entries("incident_opened")
+        assert len(opened) == 1
+        assert opened[0]["cause_event"] == "subnet_stalled"
+        # idempotent: an unchanged ledger appends no new transitions
+        engine.tick(now=101.0)
+        assert len(ledger.entries("incident_opened")) == 1
+        assert len(load_incidents(tmp_path)) == 1
+        # recovery flips the state durably and emits incident_resolved
+        ledger.append("subnet_ingested", netuid=3, new_blocks=2,
+                      head_block=1200)
+        engine.tick(now=102.0)
+        assert len(ledger.entries("incident_resolved")) == 1
+        assert load_incidents(tmp_path)[0]["state"] == "resolved"
+        assert reg.snapshot()["gauges"]["incidents_open"] == 0
+        # a restarted engine reloads prior state: no duplicate appends
+        engine2 = IncidentEngine(ledger, recorder, registry=reg,
+                                 anomaly_engine=AnomalyEngine())
+        engine2.tick(now=103.0)
+        assert len(ledger.entries("incident_opened")) == 1
+        assert len(ledger.entries("incident_resolved")) == 1
+
+    def test_anomalies_are_ledgered_with_counter(self, tmp_path):
+        from yuma_simulation_tpu.resilience.supervisor import FailureLedger
+        from yuma_simulation_tpu.telemetry.flight import FlightRecorder
+        from yuma_simulation_tpu.telemetry.metrics import MetricsRegistry
+
+        ledger = FailureLedger(tmp_path / "ledger.jsonl")
+        reg = MetricsRegistry()
+        gauge = reg.gauge("replay_staleness_seconds")
+        engine = IncidentEngine(
+            ledger, FlightRecorder(tmp_path), registry=reg
+        )
+        for i in range(20):
+            gauge.set(3.0 + (i % 2) * 0.2)
+            engine.feed_snapshot(now=float(i))
+        gauge.set(5000.0)
+        fired = engine.feed_snapshot(now=30.0)
+        assert fired == 1
+        records = ledger.entries("anomaly_detected")
+        assert len(records) == 1
+        assert records[0]["series"] == "gauge:replay_staleness_seconds"
+        assert reg.snapshot()["counters"]["anomalies_total"] == 1
+
+
+# ----------------------------------------------------- controller restart
+
+
+class TestControllerRestart:
+    def test_stale_open_run_becomes_process_loss_incident(self, tmp_path):
+        from yuma_simulation_tpu.replay.archive import SnapshotArchive
+        from yuma_simulation_tpu.replay.controller import (
+            ControllerConfig,
+            ReplayController,
+        )
+        from yuma_simulation_tpu.replay.statecache import StateCache
+
+        def controller():
+            # empty archive: cycles observe/tick without compiling
+            return ReplayController(
+                SnapshotArchive(tmp_path / "archive"),
+                StateCache(tmp_path / "cache"),
+                ControllerConfig(
+                    store_root=tmp_path / "store",
+                    versions=(VERSION,),
+                    flight_rotation=True,
+                ),
+                bundle_dir=tmp_path / "bundle",
+            )
+
+        first = controller()
+        first.run_cycle()
+        # SIGKILL: the run marker stays open, close() never runs
+        second = controller()
+        assert second._stale_runs == [first.run.run_id]
+        second.run_cycle()
+        restarts = second.ledger.entries("controller_restarted")
+        assert [r["run"] for r in restarts] == [first.run.run_id]
+        current = load_incidents(tmp_path / "bundle")
+        classes = {r["cause_class"] for r in current}
+        assert "process-loss" in classes
+        second.close()
+        # a THIRD clean start sees only the crashed run as stale and
+        # folds into the SAME deduped incident — no second incident
+        third = controller()
+        third.run_cycle()
+        third.close()
+        assert len([
+            r for r in load_incidents(tmp_path / "bundle")
+            if r["cause_class"] == "process-loss"
+        ]) == 1
+
+
+# --------------------------------------------------------- incidentreport
+
+
+def _faulted_bundle(tmp_path):
+    """A bundle with a runtime-correlated incident on disk."""
+    from yuma_simulation_tpu.resilience.supervisor import FailureLedger
+    from yuma_simulation_tpu.telemetry.flight import FlightRecorder
+    from yuma_simulation_tpu.telemetry.metrics import MetricsRegistry
+
+    ledger = FailureLedger(tmp_path / "ledger.jsonl")
+    engine = IncidentEngine(
+        ledger, FlightRecorder(tmp_path), registry=MetricsRegistry(),
+        anomaly_engine=AnomalyEngine(),
+    )
+    ledger.append("subnet_quarantined", netuid=7, block=1100,
+                  key="k", reason="digest mismatch")
+    ledger.append("subnet_stalled", netuid=3, head_block=1100,
+                  stalled_seconds=40.0)
+    engine.tick(now=50.0)
+    return tmp_path
+
+
+class TestIncidentReport:
+    def test_check_passes_on_correlated_bundle(self, tmp_path, capsys):
+        from tools.incidentreport import main
+
+        bundle = _faulted_bundle(tmp_path)
+        assert main([str(bundle), "--check"]) == 0
+        out = capsys.readouterr().out
+        assert "snapshot-corruption:netuid=7" in out
+        assert "subnet-stall:netuid=3" in out
+
+    def test_tamper_orphans_the_cause_and_fails(self, tmp_path, capsys):
+        from tools.incidentreport import main
+        from yuma_simulation_tpu.telemetry.flight import INCIDENTS_NAME
+
+        bundle = _faulted_bundle(tmp_path)
+        path = bundle / INCIDENTS_NAME
+        kept = [
+            line
+            for line in path.read_text().splitlines()
+            if "subnet-stall" not in line
+        ]
+        path.write_text("\n".join(kept) + "\n")
+        assert main([str(bundle), "--check"]) == 1
+        err = capsys.readouterr().err
+        assert "uncorrelated cause" in err and "subnet_stalled" in err
+
+    def test_malformed_state_exits_2(self, tmp_path, capsys):
+        from tools.incidentreport import main
+        from yuma_simulation_tpu.telemetry.flight import INCIDENTS_NAME
+
+        bundle = _faulted_bundle(tmp_path)
+        path = bundle / INCIDENTS_NAME
+        garbled = path.read_text().replace('"open"', '"exploded"')
+        path.write_text(garbled)
+        assert main([str(bundle), "--check"]) == 2
+
+    def test_expect_none_pins_control_arms(self, tmp_path, capsys):
+        from tools.incidentreport import main
+
+        clean = tmp_path / "clean"
+        clean.mkdir()
+        assert main([str(clean), "--expect-none"]) == 0
+        faulted = _faulted_bundle(tmp_path / "faulted")
+        assert main([str(faulted), "--expect-none"]) == 1
+
+    def test_offline_correlation_covers_bundles_without_sink(
+        self, tmp_path, capsys
+    ):
+        """Drill bundles have no runtime engine: --check derives the
+        incidents from the ledger and still gates cause presence."""
+        from tools.incidentreport import main
+        from yuma_simulation_tpu.resilience.supervisor import FailureLedger
+
+        (tmp_path / "b").mkdir()
+        ledger = FailureLedger(tmp_path / "b" / "ledger.jsonl")
+        ledger.append("unit_stalled", unit=4, attempt=1)
+        assert main([str(tmp_path / "b"), "--check"]) == 0
+        out = capsys.readouterr().out
+        assert "engine-stall" in out and "offline correlation" in out
+
+
+# ---------------------------------------------------------- surfaces
+
+
+class TestSurfaces:
+    def test_ops_debug_incidents(self, tmp_path):
+        from yuma_simulation_tpu.telemetry.ops import OpsPlane
+
+        plane = OpsPlane(tmp_path)
+        assert plane.debug_incidents() == {"incidents": [], "open": 0}
+        _faulted_bundle(tmp_path)
+        snap = plane.debug_incidents()
+        assert snap["open"] >= 1
+        assert {r["incident"] for r in snap["incidents"]} >= {
+            "subnet-stall:netuid=3"
+        }
+
+    def test_obsreport_renders_incident_section(self, tmp_path):
+        from tools.obsreport import render_incidents
+        from yuma_simulation_tpu.telemetry.flight import load_bundle
+
+        bundle = load_bundle(_faulted_bundle(tmp_path))
+        lines = render_incidents(bundle)
+        text = "\n".join(lines)
+        assert "incident intelligence:" in text
+        assert "subnet-stall:netuid=3" in text
+        # clean bundles render nothing
+        clean = tmp_path / "clean"
+        clean.mkdir()
+        assert render_incidents(load_bundle(clean)) == []
+
+    def test_fleet_report_counts_incident_events(self, tmp_path):
+        from yuma_simulation_tpu.fabric.health import (
+            FLEET_CROSS_CHECKED_COUNTS,
+            FleetHealthReport,
+        )
+
+        assert "incidents_opened" in FLEET_CROSS_CHECKED_COUNTS
+        # additive defaults: pre-0.24 call sites construct without them
+        report = FleetHealthReport(
+            fleet="f", num_units=0, units_published=0, hosts_seen=(),
+            hosts_finished=(), hosts_lost=(), units_stolen=0,
+            units_abandoned=0, units_duplicate=0, stalls_killed=0,
+            engine_demotions=0, mesh_shrinks=0, lanes_quarantined=0,
+        )
+        assert report.incidents_opened == 0
+        assert report.anomalies_detected == 0
+
+    def test_follow_read_cost_is_o_new_bytes(self, tmp_path):
+        """The --follow satellite: after the initial catch-up, a poll
+        with nothing new reads ZERO bytes, and one appended record
+        costs one record's bytes — not a bundle re-read — however much
+        history the segmented bundle holds."""
+        from tools.obsreport import BundleTailer
+        from yuma_simulation_tpu.resilience.supervisor import FailureLedger
+        from yuma_simulation_tpu.telemetry.flight import (
+            FlightRecorder,
+            RotationPolicy,
+        )
+        from yuma_simulation_tpu.telemetry.runctx import RunContext, span
+
+        rec = FlightRecorder(
+            tmp_path,
+            rotation=RotationPolicy(
+                max_segment_bytes=2048, max_segment_age_seconds=0.0
+            ),
+        )
+        run = RunContext()
+        with run.activate():
+            for _ in range(40):
+                with span("cycle"):
+                    pass
+                rec.record(run)
+        ledger = FailureLedger(tmp_path / "ledger.jsonl")
+        for i in range(50):
+            ledger.append("window_swept", netuid=i, version="v",
+                          block_from=0, block_to=1, suffix_epochs=1,
+                          total_epochs=1, resumed=False, units=1,
+                          canaries=0, drift=0, store="s")
+        tailer = BundleTailer(tmp_path)
+        events = tailer.poll()
+        assert tailer.ledger == 50 and tailer.spans > 0
+        baseline = tailer.bytes_read
+        assert baseline > 0
+        # idle tick: zero bytes
+        tailer.poll()
+        assert tailer.bytes_read == baseline
+        # one new record: one record's worth of bytes, not O(bundle)
+        ledger.append("window_swept", netuid=99, version="v",
+                      block_from=0, block_to=1, suffix_epochs=1,
+                      total_epochs=1, resumed=False, units=1,
+                      canaries=0, drift=0, store="s")
+        new = tailer.poll()
+        assert [k for k, _ in new] == ["ledger"]
+        delta = tailer.bytes_read - baseline
+        assert 0 < delta < 1024, (
+            f"one appended record cost {delta} bytes — the tailer is "
+            "re-reading history"
+        )
+        del events

@@ -1,0 +1,316 @@
+"""incidentreport: render and gate a flight bundle's incident record.
+
+The postmortem half of the observability CLI family: obsreport renders
+what happened, sloreport whether it was acceptable, driftreport whether
+the numbers drifted — this renders WHY. For each correlated incident it
+prints the suspected cause (a typed fault ledger event), the symptom
+timeline (detector ``anomaly_detected`` records, SLO burn transitions),
+the blast radius, and the resolution state.
+
+Record of truth: the bundle's durable ``incidents.jsonl`` (appended by
+the runtime :class:`yuma_simulation_tpu.telemetry.incident.IncidentEngine`
+on every state transition, last record per id wins). Bundles without
+one — drill bundles, old bundles — fall back to offline correlation
+over the ledger, which derives the same incidents from the same typed
+events.
+
+Usage::
+
+    python -m tools.incidentreport BUNDLE_DIR                # postmortems
+    python -m tools.incidentreport BUNDLE_DIR --check        # CI gate
+    python -m tools.incidentreport BUNDLE_DIR --expect-none  # control arm
+    python -m tools.incidentreport BUNDLE_DIR --json         # machine-readable
+
+``--check`` semantics (exit 1): every cause-class ledger event must
+belong to an incident, and every incident must carry a cause candidate.
+The first clause is the tamper bound — deleting an incident from
+``incidents.jsonl`` orphans its cause event in the ledger, so a faulted
+drill passes ONLY because correlation actually succeeded. Exit 2 means
+the incident record itself is malformed (undecodable state, missing
+identity). ``--expect-none`` (exit 1 on ANY incident) pins the
+unfaulted control arms to zero.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from yuma_simulation_tpu.telemetry.flight import load_bundle
+from yuma_simulation_tpu.telemetry.incident import (
+    CAUSE_EVENTS,
+    correlate,
+    latest_incidents,
+    unattributed_symptoms,
+)
+
+#: The process-loss cause a restarted controller ledgers after finding
+#: a stale open run marker ("controller_restarted").
+RESTART_EVENT = "controller_restarted"
+
+_VALID_STATES = ("open", "resolved")
+
+
+def _incident_records(bundle) -> tuple[list, bool]:
+    """(current incident records, durable?) — ``incidents.jsonl`` folded
+    last-record-per-id when the sink exists, else offline correlation
+    over the ledger."""
+    if bundle.incidents:
+        return latest_incidents(bundle.incidents), True
+    return [i.to_json() for i in correlate(bundle.ledger)], False
+
+
+def check_incidents(bundle, records: list) -> tuple[list, list]:
+    """(problems -> exit 1, malformed -> exit 2) for one bundle."""
+    problems: list[str] = []
+    malformed: list[str] = []
+    known = set()
+    for rec in records:
+        if not isinstance(rec, dict) or not rec.get("incident"):
+            malformed.append(f"incident record without identity: {rec!r:.120}")
+            continue
+        ident = str(rec["incident"])
+        known.add(ident)
+        if rec.get("state") not in _VALID_STATES:
+            malformed.append(
+                f"{ident}: undecodable state {rec.get('state')!r}"
+            )
+        cause = rec.get("cause")
+        cause_event = (
+            cause.get("event") if isinstance(cause, dict) else None
+        )
+        if cause_event not in CAUSE_EVENTS:
+            problems.append(
+                f"{ident}: no cause candidate "
+                f"(cause event {cause_event!r} is not a typed fault)"
+            )
+        elif CAUSE_EVENTS[cause_event] != rec.get("cause_class"):
+            problems.append(
+                f"{ident}: cause {cause_event} does not support class "
+                f"{rec.get('cause_class')!r}"
+            )
+    # Coverage: every typed fault event in the ledger must belong to an
+    # incident in the record of truth. With a durable incidents.jsonl
+    # this is the tamper bound; without one, offline correlation covers
+    # by construction and the clause is a self-consistency check.
+    from yuma_simulation_tpu.telemetry.incident import _subject
+
+    for rec in bundle.ledger:
+        if not isinstance(rec, dict):
+            continue
+        cls = CAUSE_EVENTS.get(rec.get("event", ""))
+        if cls is None:
+            continue
+        subject = _subject(rec)
+        ident = f"{cls}:{subject}" if subject else cls
+        if ident not in known:
+            problems.append(
+                f"uncorrelated cause: ledger {rec.get('event')} "
+                f"({subject or 'bundle'}) has no incident {ident}"
+            )
+    return problems, malformed
+
+
+def render_incidents(
+    label: str, bundle, records: list, durable: bool
+) -> str:
+    lines = [f"incident report: {label}"]
+    source = "incidents.jsonl" if durable else "offline correlation"
+    open_count = sum(
+        1 for r in records
+        if isinstance(r, dict) and r.get("state") == "open"
+    )
+    lines.append(
+        f"{len(records)} incident(s), {open_count} open ({source})"
+    )
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        ident = rec.get("incident", "?")
+        state = rec.get("state", "?")
+        lines.append(f"  [{'!' if state == 'open' else ' '}] {ident} "
+                     f"[{state}]")
+        cause = rec.get("cause") or {}
+        cause_bits = [f"cause: {cause.get('event', '?')}"]
+        for key in ("netuid", "unit", "worker", "reason", "kind",
+                    "stalled_seconds", "run"):
+            if key in cause:
+                cause_bits.append(f"{key}={cause[key]}")
+        if cause.get("event") == RESTART_EVENT:
+            cause_bits.append("(stale open run marker at startup)")
+        lines.append("      " + " ".join(str(b) for b in cause_bits))
+        opened = rec.get("opened_t")
+        resolved = rec.get("resolved_t")
+        when = f"      opened t={opened}"
+        if resolved is not None:
+            when += (
+                f"; resolved t={resolved}"
+                f" ({rec.get('resolution') or 'recovered'})"
+            )
+        lines.append(when)
+        blast = rec.get("blast_radius") or {}
+        if blast:
+            lines.append(
+                "      blast radius: "
+                + " ".join(
+                    f"{dim}={vals}" for dim, vals in sorted(blast.items())
+                )
+            )
+        symptoms = rec.get("symptoms") or []
+        if symptoms:
+            lines.append(f"      timeline ({len(symptoms)}):")
+            for s in symptoms[:10]:
+                bits = [f"t={s.get('t')}", str(s.get('kind', '?'))]
+                for key in ("event", "series", "slo", "state", "detail",
+                            "reason"):
+                    if s.get(key):
+                        bits.append(str(s[key]))
+                lines.append("        " + " ".join(bits))
+            if len(symptoms) > 10:
+                lines.append(f"        ... {len(symptoms) - 10} more")
+    # Symptom events that attached to no incident are operator
+    # questions, not failures — surface the count, never gate on it.
+    attached = set()
+    for rec in records:
+        if isinstance(rec, dict):
+            for s in rec.get("symptoms") or []:
+                if isinstance(s, dict):
+                    attached.add((s.get("event"), s.get("t")))
+    orphans = [
+        r
+        for r in unattributed_symptoms(bundle.ledger, [])
+        if (r.get("event"), r.get("t")) not in attached
+    ]
+    if orphans:
+        lines.append(f"unattributed symptoms: {len(orphans)}")
+    anomalies = sum(
+        1 for r in bundle.ledger
+        if isinstance(r, dict) and r.get("event") == "anomaly_detected"
+    )
+    opened_events = sum(
+        1 for r in bundle.ledger
+        if isinstance(r, dict) and r.get("event") == "incident_opened"
+    )
+    resolved_events = sum(
+        1 for r in bundle.ledger
+        if isinstance(r, dict) and r.get("event") == "incident_resolved"
+    )
+    lines.append(
+        f"ledger: {anomalies} anomaly_detected, "
+        f"{opened_events} incident_opened, "
+        f"{resolved_events} incident_resolved"
+    )
+    if bundle.metrics:
+        last = bundle.metrics[-1]
+        gauges = last.get("gauges", {}) if isinstance(last, dict) else {}
+        counters = last.get("counters", {}) if isinstance(last, dict) else {}
+        if "incidents_open" in gauges or "anomalies_total" in counters:
+            lines.append(
+                f"metrics: incidents_open={gauges.get('incidents_open', 0)} "
+                f"anomalies_total={counters.get('anomalies_total', 0)}"
+            )
+    return "\n".join(lines)
+
+
+def _targets(directory: str) -> list[tuple[str, pathlib.Path]]:
+    from yuma_simulation_tpu.fabric.store import FleetStore, is_fleet_store
+
+    if is_fleet_store(directory):
+        store = FleetStore(directory)
+        return [
+            (f"host {host_id}", store.host_dir(host_id))
+            for host_id in store.host_ids()
+        ]
+    return [("bundle", pathlib.Path(directory))]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="incidentreport", description=__doc__.split("\n\n")[0]
+    )
+    parser.add_argument("directory", help="flight bundle or fleet store")
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 when any typed fault ledger event lacks a "
+        "correlated incident or any incident lacks a cause candidate; "
+        "exit 2 when the incident record is malformed",
+    )
+    parser.add_argument(
+        "--expect-none",
+        action="store_true",
+        help="exit 1 when ANY incident exists (unfaulted control arms)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit incidents as JSON"
+    )
+    args = parser.parse_args(argv)
+
+    targets = _targets(args.directory)
+    loaded = []
+    for label, path in targets:
+        bundle = load_bundle(path)
+        records, durable = _incident_records(bundle)
+        loaded.append((label, path, bundle, records, durable))
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    label: {"durable": durable, "incidents": records}
+                    for label, _p, _b, records, durable in loaded
+                },
+                indent=2,
+                sort_keys=True,
+                default=str,
+            )
+        )
+    else:
+        for i, (label, path, bundle, records, durable) in enumerate(loaded):
+            if i:
+                print()
+            print(render_incidents(f"{label} ({path})", bundle, records,
+                                   durable))
+
+    rc = 0
+    if args.expect_none:
+        for label, _p, _b, records, _d in loaded:
+            if records:
+                print(
+                    f"\nincidentreport --expect-none FAILED: {label} has "
+                    f"{len(records)} incident(s)",
+                    file=sys.stderr,
+                )
+                rc = max(rc, 1)
+        if rc == 0:
+            print("\nincidentreport --expect-none: zero incidents")
+    if args.check:
+        all_problems: list[str] = []
+        all_malformed: list[str] = []
+        for label, _p, bundle, records, _durable in loaded:
+            problems, malformed = check_incidents(bundle, records)
+            all_problems.extend(f"{label}: {p}" for p in problems)
+            all_malformed.extend(f"{label}: {m}" for m in malformed)
+        if all_malformed:
+            print("\nincidentreport --check MALFORMED:", file=sys.stderr)
+            for m in all_malformed:
+                print(f"  - {m}", file=sys.stderr)
+            return 2
+        if all_problems:
+            print("\nincidentreport --check FAILED:", file=sys.stderr)
+            for p in all_problems:
+                print(f"  - {p}", file=sys.stderr)
+            return 1
+        total = sum(len(records) for _l, _p, _b, records, _d in loaded)
+        print(
+            f"\nincidentreport --check: {total} incident(s) across "
+            f"{len(loaded)} bundle(s); every typed fault correlated, "
+            "every incident caused"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -63,7 +63,7 @@ RULES = {
 }
 
 REGISTRY_RELPATH = "yuma_simulation_tpu/telemetry/registry.py"
-CONSUMER_TOOLS = ("obsreport", "sloreport", "driftreport")
+CONSUMER_TOOLS = ("obsreport", "sloreport", "driftreport", "incidentreport")
 
 #: Call leaves that emit a structured event; the event name is the
 #: FIRST positional arg unless listed in _SECOND_ARG_EMITTERS.

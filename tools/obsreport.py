@@ -193,6 +193,10 @@ def render(bundle, run_id: str | None) -> str:
     if dispatch:
         lines.append("")
         lines.extend(dispatch)
+    incidents = render_incidents(bundle)
+    if incidents:
+        lines.append("")
+        lines.extend(incidents)
     return "\n".join(lines)
 
 
@@ -241,6 +245,56 @@ def render_telemetry(bundle) -> list[str]:
             f"  profile {marker} {event} {_fmt_ts(rec.get('t'))} "
             f"mode={rec.get('mode', '?')} "
             f"artifact={rec.get('artifact', '?')}"
+        )
+    return lines
+
+
+def render_incidents(bundle) -> list[str]:
+    """The incident-intelligence section (0.24.0): current state per
+    incident from the bundle's durable ``incidents.jsonl`` (last record
+    per id), plus the anomaly_detected / incident_opened /
+    incident_resolved ledger tallies. Empty on clean bundles — an
+    unfaulted run never creates the sink. Deep postmortems live in
+    ``python -m tools.incidentreport``."""
+    from yuma_simulation_tpu.telemetry.incident import latest_incidents
+
+    anomalies = sum(
+        1
+        for r in bundle.ledger
+        if r.get("event") == "anomaly_detected"
+    )
+    opened = sum(
+        1 for r in bundle.ledger if r.get("event") == "incident_opened"
+    )
+    resolved = sum(
+        1 for r in bundle.ledger if r.get("event") == "incident_resolved"
+    )
+    current = latest_incidents(bundle.incidents)
+    if not (current or anomalies or opened or resolved):
+        return []
+    lines = ["incident intelligence:"]
+    lines.append(
+        f"  ledger: anomalies={anomalies} opened={opened} "
+        f"resolved={resolved}"
+    )
+    last = bundle.metrics[-1] if bundle.metrics else {}
+    counters = last.get("counters", {}) if isinstance(last, dict) else {}
+    if "anomalies_total" in counters:
+        lines.append(
+            f"  metrics: anomalies_total={counters['anomalies_total']}"
+        )
+    for rec in current:
+        flag = "!" if rec.get("state") == "open" else " "
+        cause = rec.get("cause") or {}
+        lines.append(
+            f"  [{flag}] {rec.get('incident')} [{rec.get('state')}] "
+            f"cause={cause.get('event', '?')} "
+            f"symptoms={len(rec.get('symptoms') or ())}"
+            + (
+                f" resolution={rec.get('resolution')}"
+                if rec.get("resolution")
+                else ""
+            )
         )
     return lines
 
@@ -777,6 +831,195 @@ def _num(v):
     return int(v) if isinstance(v, float) and v.is_integer() else v
 
 
+class _FileCursor:
+    """Byte-offset tail over one append-only JSONL sink: each
+    :meth:`read_new` returns only the COMPLETE lines appended since the
+    last call, reading only the new bytes. A torn tail (a concurrent
+    ``append_durable`` mid-write) is buffered until its newline lands.
+    A file that SHRANK (atomic republish that dropped a torn middle
+    line) triggers a rescan that skips the lines already returned."""
+
+    def __init__(self, path):
+        self.path = path
+        self.offset = 0
+        self.lines_seen = 0
+        self.bytes_read = 0
+        self._partial = b""
+
+    def read_new(self) -> list[dict]:
+        try:
+            size = self.path.stat().st_size
+        except OSError:
+            return []
+        skip = 0
+        if size < self.offset:
+            skip, self.lines_seen = self.lines_seen, 0
+            self.offset = 0
+            self._partial = b""
+        if size <= self.offset:
+            return []
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(self.offset)
+                chunk = fh.read(size - self.offset)
+        except OSError:
+            return []
+        self.offset += len(chunk)
+        self.bytes_read += len(chunk)
+        pieces = (self._partial + chunk).split(b"\n")
+        self._partial = pieces.pop()
+        self.lines_seen += len(pieces)
+        out: list[dict] = []
+        for raw in pieces:
+            if skip > 0:
+                skip -= 1
+                continue
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue  # torn/garbled line: tolerated, like the loader
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
+
+
+class BundleTailer:
+    """Incremental reader behind ``--follow``: per-file byte cursors
+    over the bundle's append-only sinks (root ledger / profiles /
+    incidents plus every rotation segment's spans), so one tick costs
+    O(new bytes) — not O(bundle) — however many sealed segments the
+    rotating bundle has accumulated. New segment directories get their
+    cursor on first sight; seals are reported once. The monolithic
+    (non-rotating) spans file is whole-file REPUBLISHED by its writer,
+    so it alone is re-read on size change, deduped by span identity —
+    the segmented path never touches it."""
+
+    def __init__(self, directory):
+        import pathlib as _pathlib
+
+        from yuma_simulation_tpu.telemetry import flight
+
+        self.directory = _pathlib.Path(directory)
+        self._flight = flight
+        self._cursors: dict = {}
+        self._seen_spans: set = set()
+        self._seen_seals: set = set()
+        self._mono_spans_size = -1
+        self.spans = self.ledger = self.profiles = 0
+        self.incidents = 0
+
+    def _cursor(self, path) -> _FileCursor:
+        cur = self._cursors.get(path)
+        if cur is None:
+            cur = self._cursors[path] = _FileCursor(path)
+        return cur
+
+    @property
+    def bytes_read(self) -> int:
+        """Total bytes read off disk across every cursor so far — the
+        regression surface the O(new bytes) test pins."""
+        return sum(c.bytes_read for c in self._cursors.values())
+
+    def _poll_segments(self) -> list[tuple[str, dict]]:
+        events: list[tuple[str, dict]] = []
+        root = self.directory / self._flight.SEGMENTS_DIR
+        if not root.is_dir():
+            return events
+        for seg in sorted(p for p in root.iterdir() if p.is_dir()):
+            seal_path = seg / self._flight.SEAL_NAME
+            if seg.name not in self._seen_seals and seal_path.exists():
+                try:
+                    seal = json.loads(seal_path.read_text())
+                except (OSError, ValueError):
+                    seal = None
+                if isinstance(seal, dict):
+                    self._seen_seals.add(seg.name)
+                    events.append(("seal", seal))
+            for rec in self._cursor(
+                seg / self._flight.SPANS_NAME
+            ).read_new():
+                key = (rec.get("run_id"), rec.get("span_id"))
+                if key in self._seen_spans:
+                    continue  # closed form re-appends the open span
+                self._seen_spans.add(key)
+                events.append(("span", rec))
+        return events
+
+    def _poll_mono_spans(self) -> list[tuple[str, dict]]:
+        path = self.directory / self._flight.SPANS_NAME
+        try:
+            size = path.stat().st_size
+        except OSError:
+            return []
+        if size == self._mono_spans_size:
+            return []
+        self._mono_spans_size = size
+        from yuma_simulation_tpu.utils.checkpoint import (
+            read_jsonl_tolerant,
+        )
+
+        events: list[tuple[str, dict]] = []
+        for rec in read_jsonl_tolerant(path):
+            key = (rec.get("run_id"), rec.get("span_id"))
+            if key in self._seen_spans:
+                continue
+            self._seen_spans.add(key)
+            events.append(("span", rec))
+        return events
+
+    def poll(self) -> list[tuple[str, dict]]:
+        """One tick: every newly landed record as ``(kind, record)`` —
+        kind in seal / span / ledger / profile / incident."""
+        events = self._poll_segments()
+        events.extend(self._poll_mono_spans())
+        for kind, name in (
+            ("ledger", self._flight.LEDGER_NAME),
+            ("profile", self._flight.PROFILES_NAME),
+            ("incident", self._flight.INCIDENTS_NAME),
+        ):
+            for rec in self._cursor(self.directory / name).read_new():
+                events.append((kind, rec))
+        self.spans += sum(1 for k, _ in events if k == "span")
+        self.ledger += sum(1 for k, _ in events if k == "ledger")
+        self.profiles += sum(1 for k, _ in events if k == "profile")
+        self.incidents += sum(1 for k, _ in events if k == "incident")
+        return events
+
+
+def _follow_line(kind: str, rec: dict) -> str:
+    if kind == "seal":
+        return (
+            f"{_fmt_ts(rec.get('t'))}  segment_sealed "
+            f"{rec.get('segment')} {_fmt_bytes(rec.get('bytes'))} "
+            f"runs={len(rec.get('run_ids', ()))}"
+        )
+    if kind == "span":
+        return (
+            f"{_fmt_ts(rec.get('t_start'))}  span {rec.get('name')} "
+            f"[{rec.get('span_id')}] run={rec.get('run_id')}"
+        )
+    if kind == "profile":
+        return (
+            f"{_fmt_ts(rec.get('t'))}  "
+            f"{rec.get('event', 'profile_published')} "
+            f"mode={rec.get('mode', '?')} "
+            f"artifact={rec.get('artifact', '?')}"
+        )
+    if kind == "incident":
+        return (
+            f"{_fmt_ts(rec.get('t'))}  incident {rec.get('incident')} "
+            f"[{rec.get('state')}] cause="
+            f"{(rec.get('cause') or {}).get('event', '?')}"
+        )
+    return (
+        f"{_fmt_ts(rec.get('t'))}  {rec.get('event')} "
+        f"{_fmt_fields(rec)}".rstrip()
+    )
+
+
 def follow(
     directory: str,
     *,
@@ -784,66 +1027,24 @@ def follow(
     max_seconds: float = 0.0,
     out=None,
 ) -> int:
-    """``--follow``: tail a LIVE bundle — poll-reload `directory` every
-    `interval` seconds and print each newly landed span, ledger record,
-    sealed segment and registered profile as one line. Built for the
-    segmented rotation mode (the live segment's appended tail becomes
-    visible between polls; `load_bundle` already tolerates the torn
-    tail a concurrent writer may leave), but works on monolithic
-    bundles too. Runs until Ctrl-C, or for `max_seconds` when given
+    """``--follow``: tail a LIVE bundle — print each newly landed span,
+    ledger record, incident transition, sealed segment and registered
+    profile as one line. Incremental since 0.24.0: a
+    :class:`BundleTailer` keeps per-file byte cursors, so each tick
+    reads only the new bytes instead of re-loading the whole segmented
+    bundle (the torn tail a concurrent writer may leave is buffered
+    until complete). Runs until Ctrl-C, or for `max_seconds` when given
     (the CI-friendly bound)."""
     import time as _time
 
-    from yuma_simulation_tpu.telemetry.flight import load_bundle
-
     out = out or sys.stdout
-    seen_spans: set = set()
-    seen_segments: set = set()
-    seen_ledger = seen_profiles = 0
+    tailer = BundleTailer(directory)
     deadline = _time.monotonic() + max_seconds if max_seconds > 0 else None
     print(f"following {directory} (interval {interval}s)", file=out)
     try:
         while True:
-            bundle = load_bundle(directory)
-            for seal in bundle.segments:
-                name = seal.get("segment")
-                if name in seen_segments:
-                    continue
-                seen_segments.add(name)
-                print(
-                    f"{_fmt_ts(seal.get('t'))}  segment_sealed {name} "
-                    f"{_fmt_bytes(seal.get('bytes'))} "
-                    f"runs={len(seal.get('run_ids', ()))}",
-                    file=out,
-                )
-            for s in sorted(
-                bundle.spans, key=lambda s: float(s.get("t_start") or 0.0)
-            ):
-                key = (s.get("run_id"), s.get("span_id"))
-                if key in seen_spans:
-                    continue
-                seen_spans.add(key)
-                print(
-                    f"{_fmt_ts(s.get('t_start'))}  span {s.get('name')} "
-                    f"[{s.get('span_id')}] run={s.get('run_id')}",
-                    file=out,
-                )
-            for rec in bundle.ledger[seen_ledger:]:
-                print(
-                    f"{_fmt_ts(rec.get('t'))}  {rec.get('event')} "
-                    f"{_fmt_fields(rec)}".rstrip(),
-                    file=out,
-                )
-            seen_ledger = len(bundle.ledger)
-            for rec in bundle.profiles[seen_profiles:]:
-                print(
-                    f"{_fmt_ts(rec.get('t'))}  "
-                    f"{rec.get('event', 'profile_published')} "
-                    f"mode={rec.get('mode', '?')} "
-                    f"artifact={rec.get('artifact', '?')}",
-                    file=out,
-                )
-            seen_profiles = len(bundle.profiles)
+            for kind, rec in tailer.poll():
+                print(_follow_line(kind, rec), file=out)
             out.flush()
             if deadline is not None and _time.monotonic() >= deadline:
                 break
@@ -851,9 +1052,10 @@ def follow(
     except KeyboardInterrupt:
         pass
     print(
-        f"followed: {len(seen_spans)} span(s), {seen_ledger} ledger "
-        f"record(s), {len(seen_segments)} sealed segment(s), "
-        f"{seen_profiles} profile(s)",
+        f"followed: {tailer.spans} span(s), {tailer.ledger} ledger "
+        f"record(s), {len(tailer._seen_seals)} sealed segment(s), "
+        f"{tailer.profiles} profile(s), {tailer.incidents} incident "
+        f"transition(s) ({tailer.bytes_read} bytes read)",
         file=out,
     )
     return 0

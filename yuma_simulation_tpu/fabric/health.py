@@ -62,6 +62,10 @@ FLEET_CROSS_CHECKED_COUNTS = (
     # lack the keys and are skipped by the `key in published` guard).
     "canaries_run",
     "drift_events",
+    # 0.24.0 — incident-intelligence accounting (additive, same guard).
+    "anomalies_detected",
+    "incidents_opened",
+    "incidents_resolved",
 )
 
 
@@ -106,6 +110,11 @@ class FleetHealthReport:
     #: paths never show which engine actually ran" gap: the merged
     #: ledgers now answer it unit by unit.
     unit_engines: tuple = ()
+    #: incident intelligence (0.24.0, additive): detector firings and
+    #: correlated incident transitions across the merged host ledgers.
+    anomalies_detected: int = 0
+    incidents_opened: int = 0
+    incidents_resolved: int = 0
 
     @property
     def clean(self) -> bool:
@@ -256,6 +265,15 @@ def build_fleet_report(
         unit_engines=tuple(
             (unit, str(last_ok[unit].get("engine", "?")))
             for unit in sorted(last_ok)
+        ),
+        anomalies_detected=sum(
+            1 for r in records if r.get("event") == "anomaly_detected"
+        ),
+        incidents_opened=sum(
+            1 for r in records if r.get("event") == "incident_opened"
+        ),
+        incidents_resolved=sum(
+            1 for r in records if r.get("event") == "incident_resolved"
         ),
     )
 

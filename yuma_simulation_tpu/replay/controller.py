@@ -337,7 +337,19 @@ class ReplayController:
             self.bundle_dir, rotation=self.rotation
         )
         self.run = RunContext()
+        #: Run ids a prior incarnation registered open and never closed
+        #: — a clean shutdown always closes its run, so a stale marker
+        #: means SIGKILL/crash. The first cycle ledgers one
+        #: ``controller_restarted`` per stale run INSIDE its span (the
+        #: ledger stamps trace context from the active run), which is
+        #: the typed cause behind process-loss incidents.
+        self._stale_runs: list[str] = []
         if self.rotation is not None:
+            self._stale_runs = [
+                r
+                for r in self.recorder.open_run_ids()
+                if r != self.run.run_id
+            ]
             self.recorder.mark_run_open(self.run.run_id)
         from yuma_simulation_tpu.telemetry.slo import get_slo_engine
 
@@ -382,6 +394,17 @@ class ReplayController:
         self._quarantine_counter = registry.counter(
             "snapshots_quarantined_total",
             help="corrupt snapshot blobs quarantined by the controller",
+        )
+        from yuma_simulation_tpu.telemetry.incident import IncidentEngine
+
+        #: Incident intelligence: per-cycle tick feeds the time-series
+        #: store from the live registry, ledgers detector anomalies,
+        #: and appends correlated incident state to incidents.jsonl.
+        self.incidents = IncidentEngine(
+            self.ledger,
+            self.recorder,
+            registry=registry,
+            source=self.run.run_id,
         )
 
     # -- quarantine -----------------------------------------------------
@@ -849,6 +872,19 @@ class ReplayController:
                         spec.blocks[-1],
                     )
                 )
+            # Incident intelligence, inside the cycle span so every
+            # anomaly_detected / incident_* ledger record resolves to a
+            # recorded span: first surface any crash a prior
+            # incarnation left behind, then tick the engine over this
+            # cycle's ledger + registry state.
+            try:
+                for stale_run in self._stale_runs:
+                    self.ledger.append("controller_restarted", run=stale_run)
+                    log_event(logger, "controller_restarted", run=stale_run)
+                self._stale_runs = []
+                self.incidents.tick()
+            except Exception:  # noqa: BLE001 — observation only
+                logger.exception("incident tick failed")
         report.snapshots_quarantined = len(self._quarantined)
         try:
             engine = get_slo_engine()

@@ -39,7 +39,16 @@ when the durable artifacts prove self-healing end to end:
   re-simulation of the full (quarantine-filtered) timelines;
 - the flight bundles and every window's fleet store pass the same
   ``obsreport --check`` / ``driftreport --check --require`` /
-  ``sloreport --check`` gates as every other drill.
+  ``sloreport --check`` gates as every other drill;
+- incident intelligence correlated every injected fault to exactly
+  one durable incident with the right typed cause (torn blob ->
+  snapshot-corruption on the corrupted subnet only, starved subnet ->
+  subnet-stall, controller SIGKILL -> process-loss resolved by
+  post-restart progress); the downtime legitimately starves EVERY
+  subnet past the stall deadline, so those restart-collateral stalls
+  are true positives that must all come out resolved — while the
+  serve control arm shows ZERO incidents and ``incidentreport
+  --check`` gates the record of truth.
 """
 
 from __future__ import annotations
@@ -689,7 +698,90 @@ def run_soak(args) -> int:
                 f"bitwise the full re-simulation",
             )
 
-    # 7. The same artifact gates every other drill bundle passes.
+    # 7. Incident intelligence: every injected fault class correlated
+    # to exactly one durable incident with the right typed cause, and
+    # the unfaulted control arms stayed at zero. This is the proof the
+    # correlation engine attributes rather than pattern-matches.
+    from yuma_simulation_tpu.telemetry.incident import load_incidents
+
+    incidents = load_incidents(store_dir)
+    by_class = Counter(r.get("cause_class") for r in incidents)
+    corruption = [
+        r
+        for r in incidents
+        if r.get("cause_class") == "snapshot-corruption"
+    ]
+    expect(
+        len(corruption) == 1
+        and corruption[0].get("subject") == f"netuid={corrupt_netuid}"
+        and (corruption[0].get("cause") or {}).get("event")
+        == "subnet_quarantined"
+        and corruption[0].get("state") == "resolved",
+        f"torn blob -> exactly one resolved snapshot-corruption "
+        f"incident on netuid={corrupt_netuid} "
+        f"(got {[r.get('incident') for r in corruption]})",
+    )
+    stalls = {
+        r.get("subject"): r
+        for r in incidents
+        if r.get("cause_class") == "subnet-stall"
+    }
+    starved = stalls.get(f"netuid={stall_netuid}")
+    expect(
+        starved is not None
+        and (starved.get("cause") or {}).get("event") == "subnet_stalled",
+        f"starved subnet -> a subnet-stall incident on "
+        f"netuid={stall_netuid} caused by subnet_stalled "
+        f"(got {sorted(stalls)})",
+    )
+    # The downtime starves EVERY subnet past the stall deadline —
+    # those restart-collateral stalls are TRUE positives (the feed
+    # really was stale), deduped to one incident per subject by
+    # identity, and the drain must have resolved every one of them.
+    unresolved = [
+        s for s, r in stalls.items() if r.get("state") != "resolved"
+    ]
+    expect(
+        not unresolved,
+        f"every subnet-stall incident resolved by the drain "
+        f"({len(stalls)} stalled subject(s), "
+        f"unresolved={unresolved})",
+    )
+    losses = [
+        r for r in incidents if r.get("cause_class") == "process-loss"
+    ]
+    expect(
+        len(losses) == 1
+        and (losses[0].get("cause") or {}).get("event")
+        == "controller_restarted",
+        f"controller SIGKILL -> exactly one process-loss incident "
+        f"(got {[r.get('incident') for r in losses]})",
+    )
+    expect(
+        all(
+            r.get("subject") == f"netuid={corrupt_netuid}"
+            for r in corruption
+        )
+        and not any(
+            r.get("subject") == "netuid=0" for r in corruption
+        ),
+        "corruption blamed on the corrupted subnet only "
+        f"(classes={dict(by_class)})",
+    )
+    expect(
+        _gate("incidentreport", [str(store_dir), "--check"]) == 0,
+        "incidentreport --check green on the controller bundle",
+    )
+    expect(
+        _gate(
+            "incidentreport", [str(target / "serve"), "--expect-none"]
+        )
+        == 0,
+        "serve control arm: zero incidents (incidentreport "
+        "--expect-none)",
+    )
+
+    # 8. The same artifact gates every other drill bundle passes.
     expect(
         _gate("obsreport", [str(store_dir), "--check"]) == 0,
         "obsreport --check green on the controller bundle",
@@ -732,6 +824,11 @@ def run_soak(args) -> int:
                 "quarantined_block": corrupt_block,
                 "stalled_netuid": stall_netuid,
                 "sealed_segments": len(sealed_segments),
+                "incidents": {
+                    str(cls): int(count)
+                    for cls, count in sorted(by_class.items())
+                    if cls
+                },
                 "failures": failures,
             },
             indent=2,

@@ -19,6 +19,8 @@ bounding, so the transport stays dumb:
 - ``GET /debug/spans?run=RUN_ID`` — one run's span tree stitched from
   the sealed bundle plus the live run context (defaults to the
   service's own run);
+- ``GET /debug/incidents`` — durable correlated-incident state from
+  the bundle's ``incidents.jsonl`` (empty list on a clean host);
 - ``POST /debug/profile`` — ``{"seconds": N, "mode": "trace"}`` kicks
   one guarded on-demand ``jax.profiler`` window (single-flight; a
   concurrent request gets a typed 409, the artifact registers into the
@@ -105,6 +107,8 @@ class _Handler(BaseHTTPRequestHandler):
                 # Pure reads under short locks — answering during load
                 # never blocks the dispatcher.
                 self._send_json(200, self.service.ops.debug_vars())
+            elif self.path == "/debug/incidents":
+                self._send_json(200, self.service.ops.debug_incidents())
             elif self.path.startswith("/debug/spans"):
                 import urllib.parse
 
@@ -566,6 +570,11 @@ class SimulationClient:
     def debug_vars(self) -> ServeResponse:
         """GET /debug/vars — the live ops snapshot."""
         return self._request("GET", "/debug/vars")
+
+    def debug_incidents(self) -> ServeResponse:
+        """GET /debug/incidents — durable incident state (postmortems
+        live in ``tools/incidentreport.py``; this is the live view)."""
+        return self._request("GET", "/debug/incidents")
 
     def debug_spans(self, run_id: Optional[str] = None) -> ServeResponse:
         """GET /debug/spans[?run=RUN_ID] — one run's live span tree."""

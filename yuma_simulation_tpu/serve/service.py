@@ -1451,7 +1451,30 @@ class SimulationService:
                 "degraded": degraded,
             },
             "canary": self._canary_snapshot(),
+            # Open-incident count from the bundle's durable
+            # incidents.jsonl; the incidents_open gauge mirrors it so
+            # /metrics scrapes agree with /healthz. 0 on a clean host
+            # (the sink is never created without an incident).
+            "incidents_open": self._incidents_open(),
         }
+
+    def _incidents_open(self) -> int:
+        from yuma_simulation_tpu.telemetry.incident import (
+            open_incident_count,
+        )
+
+        if self.config.bundle_dir is None:
+            return 0
+        try:
+            count = open_incident_count(self.config.bundle_dir)
+        except Exception:  # noqa: BLE001 — health must answer anyway
+            logger.warning("incident count failed", exc_info=True)
+            return 0
+        self.registry.gauge(
+            "incidents_open",
+            help="correlated incidents currently open in this bundle",
+        ).set(count)
+        return count
 
     def warm_buckets(self) -> list[str]:
         """The `ExVxM` shape buckets this process holds warm (warmup

@@ -29,7 +29,14 @@ or rates). The modules:
 - :mod:`.slo` — mergeable log-bucketed latency sketches, declarative
   `SLOSpec` objectives, and the burn-rate engine whose fast-burn
   alerts drive the serving tier's admission degradation
-  (``python -m tools.sloreport`` renders and gates the state).
+  (``python -m tools.sloreport`` renders and gates the state);
+- :mod:`.timeseries` / :mod:`.anomaly` / :mod:`.incident` — incident
+  intelligence (0.24.0): bounded per-key time-series rings folded from
+  the metric snapshot stream, robust anomaly detectors (MAD,
+  rate-of-change, counter-stall, saturation), and the correlation
+  engine that joins anomalies, SLO transitions, and typed fault ledger
+  events into durable ``incidents.jsonl`` postmortem records
+  (``python -m tools.incidentreport`` renders and gates them).
 
 Everything is host-side: the layer adds zero compiles (the warm-repeat
 budgets of tests/unit/test_recompilation.py stay at 0) and no reads
@@ -58,6 +65,14 @@ from yuma_simulation_tpu.telemetry.device import (  # noqa: F401
     record_device_telemetry,
     sample_device_telemetry,
 )
+from yuma_simulation_tpu.telemetry.anomaly import (  # noqa: F401
+    Anomaly,
+    AnomalyEngine,
+    CounterStallDetector,
+    MadDetector,
+    RateOfChangeDetector,
+    SaturationDetector,
+)
 from yuma_simulation_tpu.telemetry.flight import (  # noqa: F401
     Bundle,
     FlightRecorder,
@@ -67,6 +82,16 @@ from yuma_simulation_tpu.telemetry.flight import (  # noqa: F401
     ledger_counts,
     load_bundle,
     merge_bundles,
+)
+from yuma_simulation_tpu.telemetry.incident import (  # noqa: F401
+    CAUSE_EVENTS,
+    Incident,
+    IncidentEngine,
+    correlate,
+    correlate_bundle,
+    latest_incidents,
+    load_incidents,
+    open_incident_count,
 )
 from yuma_simulation_tpu.telemetry.metrics import (  # noqa: F401
     Counter,
@@ -102,4 +127,8 @@ from yuma_simulation_tpu.telemetry.slo import (  # noqa: F401
     get_slo_engine,
     observe_duration,
     set_slo_engine,
+)
+from yuma_simulation_tpu.telemetry.timeseries import (  # noqa: F401
+    TimeSeriesStore,
+    store_from_metrics,
 )

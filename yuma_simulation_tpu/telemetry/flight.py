@@ -94,6 +94,7 @@ OPEN_NAME = "open.json"
 COMPACTED_NAME = "compacted.json"
 OPEN_RUNS_NAME = "open_runs.json"
 PROFILES_NAME = "profiles.jsonl"
+INCIDENTS_NAME = "incidents.jsonl"
 
 #: Env opt-in for rotation (see :class:`RotationPolicy`): "1"/"true"
 #: turns it on with defaults for processes whose construction the
@@ -459,6 +460,21 @@ class FlightRecorder:
             (json.dumps(line, sort_keys=True) + "\n").encode(),
         )
 
+    def record_incident(self, record: dict) -> None:
+        """Append one incident state record (``incidents.jsonl``): an
+        append-only root sink like ``profiles.jsonl`` — incidents are
+        rare, span segment rotations, and re-append their full state on
+        every transition, so readers keep the LAST record per incident
+        id and a torn tail costs one transition, never history."""
+        from yuma_simulation_tpu.utils.checkpoint import append_durable
+
+        line = dict(record)
+        line.setdefault("t", round(time.time(), 6))
+        append_durable(
+            self.directory / INCIDENTS_NAME,
+            (json.dumps(line, sort_keys=True) + "\n").encode(),
+        )
+
     def record(
         self,
         run: RunContext,
@@ -752,6 +768,10 @@ class Bundle:
     segments: list = dataclasses.field(default_factory=list)
     #: registered profiler captures (``profiles.jsonl``).
     profiles: list = dataclasses.field(default_factory=list)
+    #: raw incident state records (``incidents.jsonl``), append order —
+    #: every transition re-appends the incident's full state; dedupe to
+    #: current state via :func:`..incident.latest_incidents`.
+    incidents: list = dataclasses.field(default_factory=list)
     #: the retention tombstone (``compacted.json``) when compaction has
     #: reclaimed sealed segments, else None.
     compacted: Optional[dict] = None
@@ -839,6 +859,7 @@ def load_bundle(directory: Union[str, pathlib.Path]) -> Bundle:
         numerics=numerics,
         segments=segments,
         profiles=_read_jsonl(directory / PROFILES_NAME),
+        incidents=_read_jsonl(directory / INCIDENTS_NAME),
         compacted=_json_file(COMPACTED_NAME),
     )
 
@@ -980,6 +1001,7 @@ def merge_bundles(bundles, directory=None) -> Bundle:
     numerics: list = []
     segments: list = []
     profiles: list = []
+    incidents: list = []
     report = None
     slo = None
     compacted = None
@@ -992,6 +1014,7 @@ def merge_bundles(bundles, directory=None) -> Bundle:
         numerics.extend(b.numerics)
         segments.extend(b.segments)
         profiles.extend(b.profiles)
+        incidents.extend(b.incidents)
         if report is None:
             report = b.report
         if slo is None:
@@ -1032,6 +1055,7 @@ def merge_bundles(bundles, directory=None) -> Bundle:
         numerics=numerics,
         segments=segments,
         profiles=profiles,
+        incidents=incidents,
         compacted=compacted,
     )
 

@@ -56,6 +56,23 @@ DEFAULT_BUCKETS = (
 )
 
 
+_SEQ_LOCK = threading.Lock()
+_SEQ = 0
+
+
+def _next_seq() -> int:
+    """Monotone per-process sequence number stamped into every snapshot
+    record (additive, 0.24.0): cumulative snapshots carry no ordering of
+    their own once bundles from several processes/segments merge, and
+    wall clocks can collide or step backwards across hosts. ``(source,
+    seq)`` gives the time-series store (:mod:`.timeseries`) an exact
+    dedupe identity so merges are order-independent."""
+    global _SEQ
+    with _SEQ_LOCK:
+        _SEQ += 1
+        return _SEQ
+
+
 def _check_name(name: str) -> str:
     if not _NAME_RE.match(name):
         raise ValueError(
@@ -255,7 +272,12 @@ class MetricsRegistry:
         )
 
         path = pathlib.Path(path)
-        record = {"t": round(time.time(), 6), **meta, **self.snapshot()}
+        record = {
+            "t": round(time.time(), 6),
+            "seq": _next_seq(),
+            **meta,
+            **self.snapshot(),
+        }
         records = read_jsonl_tolerant(path)
         records.append(record)
         payload = "".join(
@@ -278,7 +300,12 @@ class MetricsRegistry:
         line; returns the appended record."""
         from yuma_simulation_tpu.utils.checkpoint import append_durable
 
-        record = {"t": round(time.time(), 6), **meta, **self.snapshot()}
+        record = {
+            "t": round(time.time(), 6),
+            "seq": _next_seq(),
+            **meta,
+            **self.snapshot(),
+        }
         append_durable(
             pathlib.Path(path),
             (json.dumps(record, sort_keys=True) + "\n").encode(),

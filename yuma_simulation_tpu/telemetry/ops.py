@@ -423,6 +423,27 @@ class OpsPlane:
                     bundle.spans.append(s)
         return build_timeline(bundle, run_id)
 
+    # -- /debug/incidents ----------------------------------------------
+
+    def debug_incidents(self) -> dict:
+        """Current incident state from the bundle's durable
+        ``incidents.jsonl`` (last record per incident id). A host with
+        no bundle — or a clean one that never opened an incident —
+        reports an empty list, which is the control-arm contract."""
+        from yuma_simulation_tpu.telemetry.incident import load_incidents
+
+        incidents = (
+            load_incidents(self.bundle_dir)
+            if self.bundle_dir is not None
+            else []
+        )
+        return {
+            "incidents": incidents,
+            "open": sum(
+                1 for r in incidents if r.get("state") == "open"
+            ),
+        }
+
     # -- /debug/profile ------------------------------------------------
 
     def debug_profile(self, seconds: float, mode: str = "trace") -> dict:

@@ -37,7 +37,7 @@ from __future__ import annotations
 import dataclasses
 
 #: Report tools (under ``tools/``) that may be named as consumers.
-REPORT_TOOLS = ("obsreport", "sloreport", "driftreport")
+REPORT_TOOLS = ("obsreport", "sloreport", "driftreport", "incidentreport")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -424,6 +424,30 @@ EVENTS = {
         "registered into the bundle's profiles.jsonl",
         consumers=("obsreport",),
     ),
+    # -- incident intelligence (telemetry.incident / telemetry.anomaly) ---
+    "anomaly_detected": EventSpec(
+        "a robust detector (MAD / rate-of-change / counter-stall / "
+        "saturation) fired on a metric time series; record carries "
+        "kind, series, value, baseline, threshold, window",
+        consumers=("incidentreport", "obsreport", "telemetry.incident"),
+    ),
+    "incident_opened": EventSpec(
+        "the correlation engine opened an incident around a typed "
+        "fault ledger event (record carries incident id, cause_class, "
+        "cause_event, subject); full state rides incidents.jsonl",
+        consumers=("incidentreport", "obsreport", "fabric.health"),
+    ),
+    "incident_resolved": EventSpec(
+        "an open incident's cause class observed its recovery event "
+        "(record carries incident id, resolution)",
+        consumers=("incidentreport", "obsreport", "fabric.health"),
+    ),
+    "controller_restarted": EventSpec(
+        "a restarting replay controller found a stale open-run marker "
+        "from a prior incarnation that never closed (SIGKILL/crash) — "
+        "the typed cause behind process-loss incidents",
+        consumers=("incidentreport", "telemetry.incident"),
+    ),
 }
 
 
@@ -622,6 +646,16 @@ METRICS = {
     ),
     "slo_slow_burn_active": MetricSpec(
         "gauge", "SLOs currently in slow burn",
+    ),
+    # -- incident intelligence (telemetry.incident) ----------------------
+    "incidents_open": MetricSpec(
+        "gauge", "correlated incidents currently open in this bundle "
+        "(also the open-incident count /healthz reports)",
+        consumers=("incidentreport", "serve.service"),
+    ),
+    "anomalies_total": MetricSpec(
+        "counter", "detector firings ledgered as anomaly_detected",
+        consumers=("incidentreport", "obsreport"),
     ),
 }
 
