@@ -223,6 +223,37 @@ class TestDetectors:
         # the 40 resets the run: only the second streak reaches 3
         assert len(fired) == 1 and fired[0].t == 6.0
 
+    def test_detectors_survive_ring_eviction(self):
+        """Scanning must cursor by sample IDENTITY, not an index into
+        the ring: once the bounded ring fills (with scans interleaved so
+        eviction happens between them), a 1000x level shift must still
+        fire — an index cursor pins at len(series) and goes blind."""
+        store = TimeSeriesStore(capacity=16)
+        det = MadDetector("gauge:replay_staleness_seconds", window=8,
+                          min_samples=4, threshold=8.0, mad_floor=1.0)
+        seq = 0
+
+        def feed(n, gauge):
+            nonlocal seq
+            for _ in range(n):
+                seq += 1
+                store.ingest_snapshot(
+                    {"t": float(seq), "seq": seq,
+                     "gauges": {"replay_staleness_seconds": gauge}},
+                    source="ctl",
+                )
+
+        feed(8, 5.0)
+        assert det.scan(store) == []
+        for _ in range(6):  # 96 more clean samples through 16 slots
+            feed(16, 5.0)
+            assert det.scan(store) == []
+        feed(3, 5000.0)
+        fired = det.scan(store)
+        assert len(fired) == 1 and fired[0].kind == "mad", (
+            "detector went blind after ring eviction"
+        )
+
     def test_default_replay_engine_quiet_on_clean_feed(self):
         """The clean false-positive bound: steady staleness jitter on
         the default controller wiring produces ZERO anomalies."""
@@ -408,6 +439,29 @@ class TestDurableState:
         assert len(records) == 1
         assert records[0]["series"] == "gauge:replay_staleness_seconds"
         assert reg.snapshot()["counters"]["anomalies_total"] == 1
+
+    def test_live_snapshots_dedupe_by_seq_not_clock(self, tmp_path):
+        """feed_snapshot stamps the same monotone seq the persisted
+        snapshot paths use, so two snapshots landing on the same
+        rounded wall clock (coarse or stepped clock) are both retained
+        instead of collapsing as (source, t) duplicates."""
+        from yuma_simulation_tpu.resilience.supervisor import FailureLedger
+        from yuma_simulation_tpu.telemetry.flight import FlightRecorder
+        from yuma_simulation_tpu.telemetry.metrics import MetricsRegistry
+
+        ledger = FailureLedger(tmp_path / "ledger.jsonl")
+        reg = MetricsRegistry()
+        gauge = reg.gauge("replay_staleness_seconds")
+        engine = IncidentEngine(
+            ledger, FlightRecorder(tmp_path), registry=reg,
+            anomaly_engine=AnomalyEngine(),
+        )
+        gauge.set(1.0)
+        engine.feed_snapshot(now=100.0)
+        gauge.set(2.0)
+        engine.feed_snapshot(now=100.0)  # clock did not advance
+        series = engine.store.series("gauge:replay_staleness_seconds")
+        assert [v for _t, v in series] == [1.0, 2.0]
 
 
 # ----------------------------------------------------- controller restart
@@ -640,3 +694,33 @@ class TestSurfaces:
             "re-reading history"
         )
         del events
+
+    def test_follow_shrink_rescan_dedupes_by_content(self, tmp_path):
+        """When a sink SHRINKS (atomic republish that repaired a line
+        before the cursor), the rescan must dedupe re-read records by
+        content — a fixed skip count misaligns the moment the rewrite
+        changed any line, swallowing the repaired record or replaying
+        an old one."""
+        from tools.obsreport import _FileCursor
+
+        path = tmp_path / "ledger.jsonl"
+        path.write_bytes(
+            b'{"event": "a", "t": 1.0}\n'
+            b'xxxx garbled beyond saving, longer than its repair xxxx\n'
+            b'{"event": "c", "t": 3.0}\n'
+        )
+        cur = _FileCursor(path)
+        assert [r["event"] for r in cur.read_new()] == ["a", "c"]
+        # the writer repairs the garbled middle line: the file shrinks
+        path.write_bytes(
+            b'{"event": "a", "t": 1.0}\n'
+            b'{"event": "b", "t": 2.0}\n'
+            b'{"event": "c", "t": 3.0}\n'
+        )
+        assert [r["event"] for r in cur.read_new()] == ["b"], (
+            "shrink rescan must emit exactly the repaired record"
+        )
+        # the tail keeps working after the rescan retires
+        with open(path, "ab") as fh:
+            fh.write(b'{"event": "d", "t": 4.0}\n')
+        assert [r["event"] for r in cur.read_new()] == ["d"]

@@ -836,24 +836,30 @@ class _FileCursor:
     :meth:`read_new` returns only the COMPLETE lines appended since the
     last call, reading only the new bytes. A torn tail (a concurrent
     ``append_durable`` mid-write) is buffered until its newline lands.
-    A file that SHRANK (atomic republish that dropped a torn middle
-    line) triggers a rescan that skips the lines already returned."""
+    A file that SHRANK (atomic republish that dropped or repaired a
+    line) triggers a rescan from zero that suppresses, by line CONTENT,
+    the records already emitted — a fixed skip count misaligns the
+    moment the rewrite changed any line before the old cursor, silently
+    dropping or re-emitting a record."""
 
     def __init__(self, path):
         self.path = path
         self.offset = 0
-        self.lines_seen = 0
         self.bytes_read = 0
         self._partial = b""
+        #: hashes of every raw line emitted so far — the identity the
+        #: shrink-rescan dedupes against (one small int per record the
+        #: follow session already processed, like _seen_spans).
+        self._emitted: set = set()
+        self._rescan = None  #: emitted-hash snapshot while rescanning
 
     def read_new(self) -> list[dict]:
         try:
             size = self.path.stat().st_size
         except OSError:
             return []
-        skip = 0
         if size < self.offset:
-            skip, self.lines_seen = self.lines_seen, 0
+            self._rescan = set(self._emitted)
             self.offset = 0
             self._partial = b""
         if size <= self.offset:
@@ -868,20 +874,25 @@ class _FileCursor:
         self.bytes_read += len(chunk)
         pieces = (self._partial + chunk).split(b"\n")
         self._partial = pieces.pop()
-        self.lines_seen += len(pieces)
+        # Survivors of the rewrite all land in this one read (the
+        # rescan starts at 0 and reads to current size), so the dedupe
+        # set retires here — after it, identical future lines are new
+        # records, not replays.
+        dedupe, self._rescan = self._rescan, None
         out: list[dict] = []
         for raw in pieces:
-            if skip > 0:
-                skip -= 1
-                continue
             raw = raw.strip()
             if not raw:
+                continue
+            key = hash(raw)
+            if dedupe is not None and key in dedupe:
                 continue
             try:
                 rec = json.loads(raw)
             except ValueError:
                 continue  # torn/garbled line: tolerated, like the loader
             if isinstance(rec, dict):
+                self._emitted.add(key)
                 out.append(rec)
         return out
 

@@ -36,6 +36,23 @@ from typing import Optional
 from yuma_simulation_tpu.telemetry.timeseries import TimeSeriesStore
 
 
+def _fresh_samples(
+    store: TimeSeriesStore, key: str, last: Optional[tuple]
+) -> tuple:
+    """``(samples, cursor)``: the samples of `key` strictly after the
+    ``(t, order)`` identity `last`, and the advanced cursor. Cursoring
+    is by sample IDENTITY, never by index into the series — the store's
+    rings evict once full, so an index cursor pins at ``len(series)``
+    forever and the detector goes silently blind in exactly the
+    long-running regime it exists for."""
+    samples = store.samples(key)
+    if last is not None:
+        samples = tuple(s for s in samples if (s[0], s[1]) > last)
+    if not samples:
+        return (), last
+    return samples, (samples[-1][0], samples[-1][1])
+
+
 @dataclasses.dataclass(frozen=True)
 class Anomaly:
     """One detector firing on one series sample."""
@@ -83,7 +100,7 @@ class MadDetector:
         self.threshold = float(threshold)
         self.mad_floor = float(mad_floor)
         self._baseline: list[float] = []
-        self._cursor = 0
+        self._last: Optional[tuple] = None
         self._latched = False
 
     def observe(self, t: float, value: float) -> Optional[Anomaly]:
@@ -125,12 +142,11 @@ class MadDetector:
 
     def scan(self, store: TimeSeriesStore) -> list[Anomaly]:
         out = []
-        samples = store.series(self.series)
-        for t, v in samples[self._cursor:]:
+        fresh, self._last = _fresh_samples(store, self.series, self._last)
+        for t, _order, v in fresh:
             a = self.observe(t, v)
             if a is not None:
                 out.append(a)
-        self._cursor = len(samples)
         return out
 
 
@@ -152,7 +168,7 @@ class RateOfChangeDetector:
         self.series = series
         self.max_per_second = float(max_per_second)
         self.min_samples = int(min_samples)
-        self._cursor = 0
+        self._last: Optional[tuple] = None
         self._prev: Optional[tuple] = None
         self._seen = 0
         self._latched = False
@@ -185,12 +201,11 @@ class RateOfChangeDetector:
 
     def scan(self, store: TimeSeriesStore) -> list[Anomaly]:
         out = []
-        samples = store.series(self.series)
-        for t, v in samples[self._cursor:]:
+        fresh, self._last = _fresh_samples(store, self.series, self._last)
+        for t, _order, v in fresh:
             a = self.observe(t, v)
             if a is not None:
                 out.append(a)
-        self._cursor = len(samples)
         return out
 
 
@@ -286,15 +301,15 @@ class SaturationDetector:
         self.capacity = float(capacity)
         self.high_fraction = float(high_fraction)
         self.min_samples = int(min_samples)
-        self._cursor = 0
+        self._last: Optional[tuple] = None
         self._run = 0
         self._latched = False
 
     def scan(self, store: TimeSeriesStore) -> list[Anomaly]:
         out = []
         bound = self.high_fraction * self.capacity
-        samples = store.series(self.series)
-        for t, v in samples[self._cursor:]:
+        fresh, self._last = _fresh_samples(store, self.series, self._last)
+        for t, _order, v in fresh:
             if v >= bound:
                 self._run += 1
                 if self._run >= self.min_samples and not self._latched:
@@ -316,7 +331,6 @@ class SaturationDetector:
             else:
                 self._run = 0
                 self._latched = False
-        self._cursor = len(samples)
         return out
 
 

@@ -98,6 +98,20 @@ DEFAULT_CAUSAL_WINDOW = 120.0
 #: Most symptom-timeline entries one incident record retains.
 MAX_SYMPTOMS = 32
 
+#: Every ledger event type correlate() can act on — causes, symptoms,
+#: resolvers. The runtime engine feeds ONLY these into its correlation
+#: window; everything else in the ledger is noise to the join.
+CORRELATION_EVENTS = (
+    frozenset(CAUSE_EVENTS)
+    | frozenset(SYMPTOM_EVENTS)
+    | frozenset(ev for evs in RESOLVE_EVENTS.values() for ev in evs)
+)
+
+#: Most records the runtime engine keeps in its correlation window.
+#: Bounds tick() cost on long soaks; cause records are never trimmed
+#: (dropping one would flip its incident back to unseen).
+MAX_CORRELATE_RECORDS = 4096
+
 
 def _subject(record: dict) -> str:
     for key in SUBJECT_KEYS:
@@ -344,6 +358,13 @@ class IncidentEngine:
         self.source = source
         self.store = TimeSeriesStore()
         self._known: dict[str, str] = {}  # incident id -> last state
+        # Incremental correlation window: tick() consumes only the
+        # ledger entries appended since the last tick (the in-memory
+        # ledger is append-only, so an index cursor is exact) and keeps
+        # the correlation-relevant ones, bounded — NOT the full ledger,
+        # which would make every cycle O(ledger) and the run quadratic.
+        self._ledger_cursor = 0
+        self._window: list[dict] = []
         self._open_gauge = self.registry.gauge(
             "incidents_open",
             help="correlated incidents currently open in this bundle",
@@ -367,11 +388,17 @@ class IncidentEngine:
         """Fold one live registry snapshot (+ dispatch sketches) into
         the time-series store; returns how many anomalies fired and
         were ledgered."""
+        from yuma_simulation_tpu.telemetry.metrics import _next_seq
         from yuma_simulation_tpu.telemetry.slo import dispatch_snapshot
         from yuma_simulation_tpu.utils.logging import log_event
 
+        # Same seq counter as the persisted snapshot paths (metrics.py),
+        # so live and bundle records share one dedupe identity — without
+        # it the store falls back to (source, rounded t) and two
+        # snapshots on a coarse/stepped clock silently collapse.
         record = {
             "t": round(now if now is not None else time.time(), 6),
+            "seq": _next_seq(),
             **self.registry.snapshot(),
         }
         sketches = dispatch_snapshot()
@@ -400,17 +427,39 @@ class IncidentEngine:
             self._anomaly_counter.inc()
         return len(fired)
 
+    def _advance_window(self) -> list[dict]:
+        """Fold ledger entries appended since the last tick into the
+        bounded correlation window and return it."""
+        entries = self.ledger.entries()
+        for rec in entries[self._ledger_cursor:]:
+            if isinstance(rec, dict) and \
+                    rec.get("event") in CORRELATION_EVENTS:
+                self._window.append(rec)
+        self._ledger_cursor = len(entries)
+        if len(self._window) > MAX_CORRELATE_RECORDS:
+            causes = [
+                r for r in self._window if r.get("event") in CAUSE_EVENTS
+            ]
+            rest = [
+                r for r in self._window
+                if r.get("event") not in CAUSE_EVENTS
+            ]
+            keep = max(MAX_CORRELATE_RECORDS - len(causes), 0)
+            self._window = causes + rest[len(rest) - keep:]
+        return self._window
+
     def tick(self, now: Optional[float] = None) -> list[Incident]:
         """One correlation pass: feed the snapshot, re-derive incidents
-        from the full ledger (pure + idempotent — the soak-scale ledger
-        is hundreds of records), durably append every state transition,
+        from the correlation window (pure + idempotent; fed
+        incrementally and bounded, so a cycle costs O(window), not
+        O(ledger lifetime)), durably append every state transition,
         ledger the typed open/resolve events, refresh the gauge.
         Returns the current incident set."""
         from yuma_simulation_tpu.utils.logging import log_event
 
         self.feed_snapshot(now)
         incidents = correlate(
-            self.ledger.entries(), causal_window=self.causal_window
+            self._advance_window(), causal_window=self.causal_window
         )
         for inc in incidents:
             prior = self._known.get(inc.incident)

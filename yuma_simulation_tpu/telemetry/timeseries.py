@@ -140,13 +140,19 @@ class TimeSeriesStore:
 
     def series(self, key: str) -> tuple:
         """``((t, value), ...)`` for `key`, sorted by ``(t, seq)`` —
-        the order-independent read surface the detectors scan."""
+        the order-independent read surface."""
+        return tuple((t, v) for t, _order, v in self.samples(key))
+
+    def samples(self, key: str) -> tuple:
+        """``((t, order, value), ...)`` for `key`, sorted by
+        ``(t, order)``. The read surface for stateful scanners: a ring
+        EVICTS once full, so an index into :meth:`series` stops
+        advancing the moment old samples fall off — ``(t, order)`` is a
+        per-sample identity a cursor can compare against instead."""
         ring = self._series.get(key)
         if not ring:
             return ()
-        return tuple(
-            (t, v) for t, _order, v in sorted(ring, key=lambda s: (s[0], s[1]))
-        )
+        return tuple(sorted(ring, key=lambda s: (s[0], s[1])))
 
     def latest(self, key: str) -> Optional[tuple]:
         s = self.series(key)
